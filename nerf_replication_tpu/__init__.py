@@ -1,0 +1,17 @@
+"""nerf_replication_tpu — a TPU-native NeRF training & rendering framework.
+
+A brand-new JAX/XLA/Pallas implementation with the capability surface of the
+PyTorch reference `echo636/nerf-replication` (see SURVEY.md): a config-driven
+plugin registry selecting dataset / network / renderer / loss / evaluator
+modules per task from YAML, a Blender-synthetic ray pipeline, coarse+fine NeRF
+MLPs with pluggable encoders (frequency + multiresolution hash grid), a
+jittable volume renderer, an occupancy-grid accelerated ray marcher, and a
+data/tensor-parallel training loop over a `jax.sharding.Mesh`.
+
+Nothing here is a port: the compute path is pure functional JAX designed for
+XLA's compilation model (static shapes, `lax` control flow, MXU-sized
+matmuls), and parallelism rides XLA collectives over ICI/DCN rather than
+NCCL/DDP.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,229 @@
+"""Config construction: defaults, YAML inheritance, CLI overrides, dir layout.
+
+Capability parity with the reference's `src/config/config.py:134-209` (global
+yacs ``cfg`` + argparse built at import time), redesigned functionally: nothing
+is constructed at import time, and the returned config is **frozen** so its
+values can be safely closed over as jit-static constants (SURVEY.md §7 "Hard
+parts": cfg keys read inside the reference's render loop become trace-time
+constants here).
+
+Schema compatibility: the YAML keys are the reference's — ``task_arg``,
+``network.nerf.{W,D,skips}``, ``train.scheduler``, ``*_module`` plugin keys,
+``parent_cfg`` recursive inheritance (config.py:177-188), trailing CLI ``opts``
+with the ``other_opts`` sentinel cutoff (config.py:190-194), and the derived
+``{task}/{scene}/{exp_name}`` output-dir layout (config.py:161-170) — so the
+reference's configs port over unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+from typing import Sequence
+
+import yaml
+
+from .node import ConfigNode
+
+
+def default_cfg() -> ConfigNode:
+    """Template defaults covering the live capability surface (SURVEY.md §2.1)."""
+    cfg = ConfigNode()
+
+    # experiment identity / layout
+    cfg.task = ""
+    cfg.scene = "default"
+    cfg.exp_name = "default"
+    cfg.exp_name_tag = ""
+    cfg.save_tag = "default"
+    cfg.gpus = [0]  # accepted for config parity; device selection is JAX's
+    cfg.resume = True
+    cfg.pretrain = ""
+    cfg.distributed = False
+    cfg.fix_random = False
+    cfg.skip_eval = False
+    cfg.save_result = False
+    cfg.clear_result = False
+
+    # plugin registry keys — resolved through nerf_replication_tpu.registry
+    cfg.train_dataset_module = "nerf_replication_tpu.datasets.blender"
+    cfg.test_dataset_module = "nerf_replication_tpu.datasets.blender"
+    cfg.network_module = "nerf_replication_tpu.models.nerf.network"
+    cfg.renderer_module = "nerf_replication_tpu.renderer.volume"
+    cfg.loss_module = "nerf_replication_tpu.train.loss"
+    cfg.evaluator_module = "nerf_replication_tpu.evaluators.nerf"
+
+    # epoch cadence (reference config.py:77-81; log_interval only via YAML there)
+    cfg.ep_iter = -1
+    cfg.save_ep = 100000
+    cfg.save_latest_ep = 1
+    cfg.eval_ep = 1
+    cfg.log_interval = 20
+
+    cfg.task_arg = ConfigNode()
+
+    cfg.train = ConfigNode(
+        {
+            "epoch": 10000,
+            "batch_size": 4,
+            "num_workers": 0,
+            "collator": "default",
+            "batch_sampler": "default",
+            "sampler_meta": {},
+            "shuffle": True,
+            "optim": "adam",
+            "lr": 5e-4,
+            "eps": 1e-8,
+            "weight_decay": 0.0,
+            "scheduler": {
+                "type": "multi_step",
+                "milestones": [80, 120, 200, 240],
+                "gamma": 0.5,
+            },
+        }
+    )
+    cfg.test = ConfigNode(
+        {
+            "batch_size": 1,
+            "epoch": -1,
+            "collator": "default",
+            "batch_sampler": "default",
+            "sampler_meta": {},
+        }
+    )
+
+    # output roots (specialized by parse_cfg into per-experiment dirs)
+    cfg.trained_model_dir = "data/trained_model"
+    cfg.trained_config_dir = "data/trained_config"
+    cfg.record_dir = "data/record"
+    cfg.result_dir = "data/result"
+
+    # mesh extraction (reference config.py:11-12)
+    cfg.level = 32.0
+    cfg.resolution = 256
+
+    # parallelism — TPU-native addition (SURVEY.md §2.3): axis sizes for the
+    # device mesh. -1 on the data axis means "all remaining devices".
+    cfg.parallel = ConfigNode(
+        {
+            "data_axis": -1,
+            "model_axis": 1,
+            "mesh_axes": ["data", "model"],
+            "multihost": False,
+        }
+    )
+
+    # precision knobs (TPU-native: bfloat16 compute, f32 params/accumulation)
+    cfg.precision = ConfigNode({"compute_dtype": "float32", "param_dtype": "float32"})
+
+    return cfg
+
+
+def _load_yaml(path: str) -> dict:
+    with open(path, "r") as f:
+        return yaml.safe_load(f) or {}
+
+
+def _merge_with_parents(cfg: ConfigNode, cfg_file: str, _depth: int = 0) -> None:
+    """Recursive ``parent_cfg`` inheritance (reference config.py:177-188)."""
+    if _depth > 16:
+        raise RecursionError(f"parent_cfg chain too deep at {cfg_file}")
+    data = _load_yaml(cfg_file)
+    parent = data.pop("parent_cfg", None)
+    if parent is not None:
+        if not os.path.isabs(parent):
+            # Parents are repo-root-relative in the reference; also try
+            # relative to the child file and to this repo's root so configs
+            # resolve regardless of cwd.
+            repo_root = os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            )
+            for base in (
+                os.getcwd(),
+                os.path.dirname(os.path.abspath(cfg_file)),
+                repo_root,
+            ):
+                cand = os.path.join(base, parent)
+                if os.path.exists(cand):
+                    parent = cand
+                    break
+        _merge_with_parents(cfg, parent, _depth + 1)
+    cfg.merge(data)
+
+
+def _git_describe(args_: Sequence[str]) -> str:
+    try:
+        out = subprocess.run(
+            ["git", *args_], capture_output=True, text=True, timeout=5
+        )
+        return out.stdout.strip()
+    except Exception:
+        return ""
+
+
+def parse_cfg(cfg: ConfigNode, slurm_local_rank: int = 0) -> None:
+    """Derive experiment name templates and output dirs (config.py:134-175)."""
+    if not cfg.task:
+        raise ValueError("task must be specified")
+
+    if cfg.exp_name_tag:
+        cfg.exp_name = f"{cfg.exp_name}_{cfg.exp_name_tag}"
+    if "gitbranch" in cfg.exp_name:
+        branch = _git_describe(["describe", "--all"])
+        cfg.exp_name = cfg.exp_name.replace("gitbranch", branch[6:] or "nobranch")
+    if "gitcommit" in cfg.exp_name:
+        commit = _git_describe(["describe", "--tags", "--always"])
+        cfg.exp_name = cfg.exp_name.replace("gitcommit", commit or "nocommit")
+
+    exp = os.path.join(cfg.task, cfg.scene, cfg.exp_name)
+    cfg.trained_model_dir = os.path.join(cfg.trained_model_dir, exp)
+    cfg.trained_config_dir = os.path.join(cfg.trained_config_dir, exp)
+    cfg.record_dir = os.path.join(cfg.record_dir, exp)
+    cfg.result_dir = os.path.join(cfg.result_dir, exp, cfg.save_tag)
+    cfg.local_rank = slurm_local_rank
+
+
+def make_cfg(
+    cfg_file: str,
+    opts: Sequence[str] = (),
+    freeze: bool = True,
+    default_task: str = "",
+    local_rank: int = 0,
+) -> ConfigNode:
+    """Build a config: defaults ← parent chain ← cfg_file ← CLI opts."""
+    cfg = default_cfg()
+    _merge_with_parents(cfg, cfg_file)
+    opts = list(opts)
+    if "other_opts" in opts:  # reference's sentinel cutoff (config.py:190-194)
+        opts = opts[: opts.index("other_opts")]
+    cfg.merge_from_list(opts)
+    if not cfg.task and default_task:
+        cfg.task = default_task
+    parse_cfg(cfg, slurm_local_rank=local_rank)
+    if freeze:
+        cfg.freeze()
+    return cfg
+
+
+def make_parser() -> argparse.ArgumentParser:
+    """CLI surface shared by train.py / run.py (reference config.py:199-205)."""
+    parser = argparse.ArgumentParser(description="nerf_replication_tpu")
+    parser.add_argument("--cfg_file", default="configs/nerf/lego.yaml", type=str)
+    parser.add_argument("--test", action="store_true", default=False)
+    parser.add_argument("--type", type=str, default="")
+    parser.add_argument("--local_rank", type=int, default=0)
+    parser.add_argument("opts", default=None, nargs=argparse.REMAINDER)
+    return parser
+
+
+def cfg_from_args(args: argparse.Namespace, freeze: bool = True) -> ConfigNode:
+    # `--type X` runs work with task-less configs (reference config.py:207-208
+    # sets task="run" before the merge for exactly this case).
+    return make_cfg(
+        args.cfg_file,
+        args.opts or (),
+        freeze=freeze,
+        default_task="run" if getattr(args, "type", "") else "",
+        local_rank=getattr(args, "local_rank", 0),
+    )

@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX import.
+
+Mirrors SURVEY.md §4's test-strategy note: multi-device (DP/TP `psum`) paths
+run in CI without TPU hardware via XLA's host-platform device-count emulation.
+Must run before anything imports jax, hence env mutation at conftest import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

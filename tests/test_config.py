@@ -1,0 +1,165 @@
+"""Config-system tests: node semantics, inheritance, CLI opts, dir layout."""
+
+import os
+import textwrap
+
+import pytest
+import yaml
+
+from nerf_replication_tpu.config import ConfigNode, make_cfg
+from nerf_replication_tpu.config.node import _coerce
+
+
+def test_attr_access_and_nesting():
+    cfg = ConfigNode({"a": 1, "b": {"c": [1, 2], "d": "x"}})
+    assert cfg.a == 1
+    assert cfg.b.c == [1, 2]
+    cfg.b.e = 5
+    assert cfg["b"]["e"] == 5
+    with pytest.raises(AttributeError):
+        _ = cfg.missing
+
+
+def test_deep_merge_scalar_and_dict():
+    cfg = ConfigNode({"train": {"lr": 5e-4, "epoch": 10}})
+    cfg.merge({"train": {"lr": 1e-3}})
+    assert cfg.train.lr == 1e-3
+    assert cfg.train.epoch == 10
+
+
+def test_merge_type_coercion():
+    cfg = ConfigNode({"lr": 5e-4, "white_bkgd": True, "n": 4})
+    cfg.merge({"lr": 1, "white_bkgd": 1, "n": 8})
+    assert isinstance(cfg.lr, float) and cfg.lr == 1.0
+    assert cfg.white_bkgd is True
+    assert cfg.n == 8
+    with pytest.raises(TypeError):
+        cfg.merge({"lr": "fast"})
+
+
+def test_merge_from_list_dotted_and_literals():
+    cfg = ConfigNode({"train": {"lr": 5e-4}, "flag": False})
+    cfg.merge_from_list(["train.lr", "1e-3", "flag", "True", "new.key", "[1,2]"])
+    assert cfg.train.lr == 1e-3
+    assert cfg.flag is True
+    assert cfg.new.key == [1, 2]
+
+
+def test_freeze_blocks_mutation():
+    cfg = ConfigNode({"a": {"b": 1}})
+    cfg.freeze()
+    with pytest.raises(AttributeError):
+        cfg.a.b = 2
+    cfg.defrost()
+    cfg.a.b = 2
+    assert cfg.a.b == 2
+
+
+def test_coerce_subtree_replacement_rejected():
+    with pytest.raises(TypeError):
+        _coerce(3, ConfigNode({"x": 1}), "k")
+
+
+def test_parent_cfg_inheritance(tmp_path):
+    parent = tmp_path / "parent.yaml"
+    parent.write_text(
+        textwrap.dedent(
+            """
+            task: nerf
+            scene: base
+            train: {lr: 1.0e-3, epoch: 5}
+            """
+        )
+    )
+    child = tmp_path / "child.yaml"
+    child.write_text(
+        textwrap.dedent(
+            f"""
+            parent_cfg: {parent}
+            scene: lego
+            train: {{epoch: 7}}
+            """
+        )
+    )
+    cfg = make_cfg(str(child), freeze=False)
+    assert cfg.scene == "lego"
+    assert cfg.train.lr == 1e-3
+    assert cfg.train.epoch == 7
+
+
+def test_opts_override_and_other_opts_sentinel(tmp_path):
+    f = tmp_path / "c.yaml"
+    f.write_text("task: nerf\nscene: lego\n")
+    cfg = make_cfg(
+        str(f),
+        ["train.lr", "2e-3", "other_opts", "train.lr", "9.0"],
+        freeze=False,
+    )
+    assert cfg.train.lr == 2e-3
+
+
+def test_dir_layout_and_freeze(tmp_path):
+    f = tmp_path / "c.yaml"
+    f.write_text("task: nerf\nscene: lego\nexp_name: exp\n")
+    cfg = make_cfg(str(f))
+    assert cfg.trained_model_dir.endswith(os.path.join("nerf", "lego", "exp"))
+    assert cfg.record_dir.endswith(os.path.join("nerf", "lego", "exp"))
+    assert cfg.result_dir.endswith(os.path.join("nerf", "lego", "exp", "default"))
+    assert cfg.is_frozen()
+
+
+def test_shipped_lego_config_parses():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = make_cfg(os.path.join(root, "configs", "nerf", "lego.yaml"))
+    assert cfg.task == "nerf"
+    assert cfg.task_arg.N_samples == 64
+    assert cfg.task_arg.N_importance == 128
+    assert cfg.network.nerf.W == 256
+    assert cfg.network.nerf.skips == [4]
+    assert cfg.train.scheduler.type == "exponential"
+    # round-trips through yaml
+    assert yaml.safe_load(cfg.dump())["task"] == "nerf"
+
+
+def test_merge_from_list_rejects_scalar_traversal_and_subtree_clobber():
+    cfg = ConfigNode({"train": {"lr": 5e-4}})
+    with pytest.raises(TypeError):
+        cfg.merge_from_list(["train.lr.min", "1e-5"])
+    with pytest.raises(TypeError):
+        cfg.merge_from_list(["train", "5"])
+
+
+def test_frozen_blocks_dict_mutators():
+    cfg = ConfigNode({"a": 1})
+    cfg.freeze()
+    with pytest.raises(AttributeError):
+        cfg.update({"a": 2})
+    with pytest.raises(AttributeError):
+        cfg.pop("a")
+    with pytest.raises(AttributeError):
+        del cfg["a"]
+    cfg.defrost()
+    cfg.update({"b": {"c": 3}})
+    assert isinstance(cfg.b, ConfigNode) and cfg.b.c == 3
+
+
+def test_float_into_int_slot_rejected():
+    cfg = ConfigNode({"epoch": 10})
+    with pytest.raises(TypeError):
+        cfg.merge({"epoch": 2.5})
+
+
+def test_local_rank_and_default_task(tmp_path):
+    f = tmp_path / "c.yaml"
+    f.write_text("scene: lego\n")
+    cfg = make_cfg(str(f), freeze=False, default_task="run", local_rank=3)
+    assert cfg.task == "run"
+    assert cfg.local_rank == 3
+
+
+def test_reference_module_names_alias():
+    from nerf_replication_tpu.registry import _ALIASES, resolve_module
+
+    assert _ALIASES["src.models.nerf.network"].startswith("nerf_replication_tpu")
+    with pytest.raises(ImportError):
+        resolve_module("definitely.not.a.module")

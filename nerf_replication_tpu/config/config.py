@@ -43,7 +43,9 @@ def default_cfg() -> ConfigNode:
     cfg.distributed = False
     cfg.fix_random = False
     cfg.skip_eval = False
-    cfg.save_result = False
+    # the reference evaluator always dumps per-view pred/gt PNGs
+    # (src/evaluators/nerf.py:29-38)
+    cfg.save_result = True
     cfg.clear_result = False
 
     # plugin registry keys — resolved through nerf_replication_tpu.registry

@@ -1,0 +1,19 @@
+"""Dataset factory: resolves the ``*_dataset_module`` plugin key.
+
+Parity with the reference's `make_data_loader` (src/datasets/make_dataset.py:
+73-100); the returned object is a Dataset exposing the ray-bank/TPU contract
+rather than a torch DataLoader (see datasets.blender module docstring).
+"""
+
+from __future__ import annotations
+
+from ..registry import load_attr
+
+
+def make_dataset(cfg, split: str = "train"):
+    key = "train_dataset_module" if split == "train" else "test_dataset_module"
+    dataset_cls = load_attr(cfg[key], "Dataset")
+    return dataset_cls.from_cfg(cfg, split)
+
+
+from . import rays, sampling  # noqa: E402,F401  (re-export submodules)

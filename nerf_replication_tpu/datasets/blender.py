@@ -1,0 +1,176 @@
+"""Blender-synthetic (NeRF) dataset: transforms_{split}.json + PNG frames.
+
+Capability parity with the reference's `src/datasets/nerf/blender.py:33-166`,
+redesigned for the TPU data path (SURVEY.md §7): instead of a torch DataLoader
+feeding per-item random rays, the dataset precomputes the full ray bank as
+flat NumPy arrays (the reference does the same precompute, blender.py:105-108)
+and exposes:
+
+* :meth:`ray_bank` — ``(rays [N,6], rgbs [N,3])`` host arrays that the trainer
+  moves to device once; per-step random batches are then drawn *inside* the
+  jitted train step (no host↔device traffic in the hot loop), and
+* test-time :meth:`image_batch` — one whole image's rays + ``H/W/focal`` meta,
+  matching the reference's test ``__getitem__`` contract (blender.py:132-139).
+
+Also implements precrop center-cropping warm-up (``precrop_iters`` /
+``precrop_frac``), which the reference configures but never reads
+(SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .rays import focal_from_fov, get_rays_np
+
+
+def _load_image(path: str) -> np.ndarray:
+    import imageio.v2 as imageio
+
+    return np.asarray(imageio.imread(path))
+
+
+def _resize_area(img: np.ndarray, W: int, H: int) -> np.ndarray:
+    import cv2
+
+    return cv2.resize(img, (W, H), interpolation=cv2.INTER_AREA)
+
+
+@dataclass
+class Dataset:
+    """One split of a Blender-format scene, fully materialized in host RAM."""
+
+    data_root: str
+    scene: str
+    split: str = "train"
+    input_ratio: float = 1.0
+    cams: tuple | list | None = None
+    H: int = 800
+    W: int = 800
+    near: float = 2.0
+    far: float = 6.0
+
+    # populated by __post_init__
+    focal: float = field(init=False)
+    rays: np.ndarray = field(init=False)  # [N_total, 6]
+    rgbs: np.ndarray = field(init=False)  # [N_total, 3]
+    poses: np.ndarray = field(init=False)  # [n_images, 4, 4]
+    n_images: int = field(init=False)
+
+    def __post_init__(self):
+        path = os.path.join(self.data_root, self.scene, f"transforms_{self.split}.json")
+        with open(path, "r") as f:
+            meta = json.load(f)
+
+        frames = meta["frames"]
+        if self.cams is not None:
+            start, stop, step = self.cams
+            if stop == -1:
+                stop = len(frames)
+            frames = frames[start:stop:step]
+        if not frames:
+            raise ValueError(f"cams={self.cams} selected no frames from {path}")
+
+        H_orig, W_orig = self.H, self.W
+        self.H = int(H_orig * self.input_ratio)
+        self.W = int(W_orig * self.input_ratio)
+        self.focal = focal_from_fov(W_orig, float(meta["camera_angle_x"])) * (
+            self.input_ratio
+        )
+
+        rays_list, rgb_list, pose_list = [], [], []
+        for frame in frames:
+            img_path = os.path.join(
+                self.data_root, self.scene, frame["file_path"] + ".png"
+            )
+            img = _load_image(img_path)
+            if self.input_ratio != 1.0:
+                img = _resize_area(img, self.W, self.H)
+            img = (img / 255.0).astype(np.float32)
+            if img.shape[-1] == 4:
+                # RGBA → composite onto white (blender.py:92-93)
+                img = img[..., :3] * img[..., 3:] + (1.0 - img[..., 3:])
+
+            pose = np.asarray(frame["transform_matrix"], dtype=np.float32)
+            rays_o, rays_d = get_rays_np(self.H, self.W, self.focal, pose)
+            rays_list.append(
+                np.concatenate([rays_o, rays_d], axis=-1).reshape(-1, 6)
+            )
+            rgb_list.append(img[..., :3].reshape(-1, 3))
+            pose_list.append(pose)
+
+        self.rays = np.concatenate(rays_list, axis=0)
+        self.rgbs = np.concatenate(rgb_list, axis=0)
+        self.poses = np.stack(pose_list, axis=0)
+        self.n_images = len(pose_list)
+
+    @classmethod
+    def from_cfg(cls, cfg, split: str) -> "Dataset":
+        """Build from the reference-schema config (train_dataset/test_dataset)."""
+        node = cfg.train_dataset if split == "train" else cfg.test_dataset
+        return cls(
+            data_root=node.data_root,
+            scene=cfg.scene,
+            split=node.get("split", split),
+            input_ratio=float(node.get("input_ratio", 1.0)),
+            cams=node.get("cams", None),
+            H=int(node.get("H", 800)),
+            W=int(node.get("W", 800)),
+            near=float(cfg.task_arg.near),
+            far=float(cfg.task_arg.far),
+        )
+
+    # ---- TPU data path ----------------------------------------------------
+    def ray_bank(self):
+        """Flat ``(rays, rgbs)`` host arrays for on-device batch sampling."""
+        return self.rays, self.rgbs
+
+    def precrop_index_pool(self, precrop_frac: float) -> np.ndarray:
+        """Flat ray indices inside the center crop of every image
+        (precrop_frac of H and W, as in the original NeRF's warm-up)."""
+        H, W, n = self.H, self.W, self.n_images
+        dH = int(H // 2 * precrop_frac)
+        dW = int(W // 2 * precrop_frac)
+        rows = np.arange(H // 2 - dH, H // 2 + dH)
+        cols = np.arange(W // 2 - dW, W // 2 + dW)
+        rr, cc = np.meshgrid(rows, cols, indexing="ij")
+        per_image = (rr * W + cc).reshape(-1)
+        offsets = np.arange(n, dtype=np.int64)[:, None] * (H * W)
+        return (offsets + per_image[None, :]).reshape(-1)
+
+    # ---- test-split contract ----------------------------------------------
+    def __len__(self) -> int:
+        if self.split == "train":
+            return 1_000_000  # nominal epoch length (blender.py:163)
+        return self.n_images
+
+    def image_batch(self, index: int) -> dict:
+        """One whole image's rays (the reference's test ``__getitem__``)."""
+        n_pix = self.H * self.W
+        sl = slice(index * n_pix, (index + 1) * n_pix)
+        return {
+            "rays": self.rays[sl],
+            "rgbs": self.rgbs[sl],
+            "near": np.float32(self.near),
+            "far": np.float32(self.far),
+            "i": index,
+            "meta": {"H": self.H, "W": self.W, "focal": self.focal},
+        }
+
+    def __getitem__(self, index: int) -> dict:
+        if self.split == "train":
+            # Host-side random batch (used by the smoke CLI; the trainer's hot
+            # path samples on device instead).
+            idx = np.random.randint(0, self.rays.shape[0], size=(1024,))
+            return {
+                "rays": self.rays[idx],
+                "rgbs": self.rgbs[idx],
+                "near": np.float32(self.near),
+                "far": np.float32(self.far),
+                "i": index,
+            }
+        return self.image_batch(index)

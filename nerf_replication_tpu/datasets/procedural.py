@@ -1,0 +1,140 @@
+"""Procedural Blender-format scene generator.
+
+The reference assumes the NeRF synthetic dataset has been downloaded
+(`scripts/download_blender.sh`); no data ships with either repo. This module
+writes a *valid* Blender-format scene (transforms_{split}.json + RGBA PNGs) by
+analytically ray-tracing a small solid scene, giving tests, demos, and
+quality benchmarks a learnable ground truth without any download.
+
+The scene: a diffuse unit-ish sphere (normal-colored) plus an axis-aligned
+cube, on a transparent background — exercising the RGBA→white compositing,
+near/far bounds, and view-dependence of the real pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .rays import get_rays_np, pose_spherical
+
+CAMERA_ANGLE_X = 0.6911112070083618  # Blender synthetic default fov
+
+
+def _intersect_sphere(rays_o, rays_d, center, radius):
+    """Smallest positive t of ray/sphere hit, inf where missed."""
+    oc = rays_o - center
+    b = np.sum(oc * rays_d, -1)
+    a = np.sum(rays_d * rays_d, -1)
+    c = np.sum(oc * oc, -1) - radius**2
+    disc = b * b - a * c
+    hit = disc > 0
+    sq = np.sqrt(np.maximum(disc, 0.0))
+    t0 = (-b - sq) / a
+    t1 = (-b + sq) / a
+    t = np.where(t0 > 1e-3, t0, t1)
+    return np.where(hit & (t > 1e-3), t, np.inf)
+
+
+def _intersect_box(rays_o, rays_d, lo, hi):
+    """Slab-method ray/AABB intersection; inf where missed."""
+    inv = 1.0 / np.where(np.abs(rays_d) < 1e-9, 1e-9, rays_d)
+    t_lo = (lo - rays_o) * inv
+    t_hi = (hi - rays_o) * inv
+    t_near = np.max(np.minimum(t_lo, t_hi), -1)
+    t_far = np.min(np.maximum(t_lo, t_hi), -1)
+    hit = (t_far > np.maximum(t_near, 1e-3))
+    t = np.where(t_near > 1e-3, t_near, t_far)
+    return np.where(hit & (t > 1e-3), t, np.inf)
+
+
+SPHERE_C = np.array([0.35, 0.0, 0.25], dtype=np.float32)
+SPHERE_R = 0.55
+BOX_LO = np.array([-0.9, -0.5, -0.5], dtype=np.float32)
+BOX_HI = np.array([-0.1, 0.3, 0.3], dtype=np.float32)
+LIGHT_DIR = np.array([0.4, 0.35, 0.85], dtype=np.float32) / np.linalg.norm(
+    [0.4, 0.35, 0.85]
+)
+
+
+def render_view(H: int, W: int, focal: float, c2w: np.ndarray) -> np.ndarray:
+    """Analytic RGBA render of the scene from one camera. [H, W, 4] uint8."""
+    rays_o, rays_d = get_rays_np(H, W, focal, c2w)
+    o = rays_o.reshape(-1, 3)
+    d = rays_d.reshape(-1, 3)
+
+    t_s = _intersect_sphere(o, d, SPHERE_C, SPHERE_R)
+    t_b = _intersect_box(o, d, BOX_LO, BOX_HI)
+    t = np.minimum(t_s, t_b)
+    hit = np.isfinite(t)
+    which_sphere = hit & (t_s <= t_b)
+
+    p = o + np.where(hit, t, 0.0)[:, None] * d
+    # normals
+    n_sphere = (p - SPHERE_C) / SPHERE_R
+    center_box = (BOX_LO + BOX_HI) / 2
+    half = (BOX_HI - BOX_LO) / 2
+    rel = (p - center_box) / half
+    axis = np.argmax(np.abs(rel), -1)
+    n_box = np.zeros_like(p)
+    n_box[np.arange(len(p)), axis] = np.sign(
+        rel[np.arange(len(p)), axis]
+    )
+    n = np.where(which_sphere[:, None], n_sphere, n_box)
+
+    lambert = np.clip(np.sum(n * LIGHT_DIR, -1), 0.0, 1.0)[:, None]
+    albedo_sphere = 0.5 * (n_sphere + 1.0)
+    albedo_box = np.broadcast_to(
+        np.array([0.9, 0.35, 0.2], dtype=np.float32), p.shape
+    )
+    albedo = np.where(which_sphere[:, None], albedo_sphere, albedo_box)
+    rgb = albedo * (0.25 + 0.75 * lambert)
+
+    rgba = np.zeros((H * W, 4), dtype=np.float32)
+    rgba[:, :3] = np.where(hit[:, None], rgb, 0.0)
+    rgba[:, 3] = hit.astype(np.float32)
+    return (np.clip(rgba.reshape(H, W, 4), 0, 1) * 255).astype(np.uint8)
+
+
+def generate_scene(
+    root: str,
+    scene: str = "procedural",
+    H: int = 64,
+    W: int = 64,
+    n_train: int = 20,
+    n_test: int = 4,
+    radius: float = 4.0,
+    seed: int = 0,
+) -> str:
+    """Write a Blender-format scene dir; returns its path."""
+    import imageio.v2 as imageio
+
+    rng = np.random.default_rng(seed)
+    scene_dir = os.path.join(root, scene)
+    focal = 0.5 * W / np.tan(0.5 * CAMERA_ANGLE_X)
+
+    for split, n in (("train", n_train), ("val", n_test), ("test", n_test)):
+        frames = []
+        img_dir = os.path.join(scene_dir, split)
+        os.makedirs(img_dir, exist_ok=True)
+        for k in range(n):
+            if split == "train":
+                theta = float(rng.uniform(-180, 180))
+                phi = float(rng.uniform(-60, -10))
+            else:
+                theta = -180.0 + 360.0 * k / max(n, 1)
+                phi = -30.0
+            c2w = pose_spherical(theta, phi, radius)
+            img = render_view(H, W, focal, c2w)
+            rel = f"./{split}/r_{k}"
+            imageio.imwrite(os.path.join(scene_dir, rel + ".png"), img)
+            frames.append(
+                {"file_path": rel, "transform_matrix": c2w.tolist()}
+            )
+        with open(
+            os.path.join(scene_dir, f"transforms_{split}.json"), "w"
+        ) as f:
+            json.dump({"camera_angle_x": CAMERA_ANGLE_X, "frames": frames}, f)
+    return scene_dir

@@ -1,0 +1,43 @@
+"""On-device ray-batch sampling — the TPU-native replacement for the torch
+DataLoader + sampler stack (SURVEY.md §2.1 "Data-loader factory", §2.3).
+
+The reference's `DistributedSampler` shards a permutation across ranks with
+epoch seeding (samplers.py:75-131); on TPU each process draws independent
+random ray batches from the device-resident ray bank, with the RNG key folded
+over (step, process_index) so streams are disjoint and deterministic — the
+sampler semantics the reference actually needs (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_step_key(base_key: jax.Array, step, process_index: int = 0) -> jax.Array:
+    """Deterministic per-(step, process) RNG stream."""
+    key = jax.random.fold_in(base_key, jnp.asarray(step, jnp.uint32))
+    if process_index:
+        key = jax.random.fold_in(key, process_index)
+    return key
+
+
+def sample_rays(
+    key: jax.Array,
+    rays: jax.Array,
+    rgbs: jax.Array,
+    n_rays: int,
+    index_pool: jax.Array | None = None,
+):
+    """Draw ``n_rays`` random rays from the bank (jit-safe, static n_rays).
+
+    ``index_pool`` restricts sampling to a subset of flat ray indices (used
+    for precrop warm-up). Mirrors blender.py:124-131's uniform-with-replacement
+    draw.
+    """
+    if index_pool is None:
+        idx = jax.random.randint(key, (n_rays,), 0, rays.shape[0])
+    else:
+        pool_draw = jax.random.randint(key, (n_rays,), 0, index_pool.shape[0])
+        idx = index_pool[pool_draw]
+    return jnp.take(rays, idx, axis=0), jnp.take(rgbs, idx, axis=0)

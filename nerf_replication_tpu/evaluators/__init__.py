@@ -1,0 +1,11 @@
+"""Evaluator factory: resolves the ``evaluator_module`` plugin key
+(parity: src/evaluators/make_evaluator.py:5-16)."""
+
+from __future__ import annotations
+
+from ..registry import load_attr
+
+
+def make_evaluator(cfg):
+    factory = load_attr(cfg.evaluator_module, "make_evaluator", "Evaluator")
+    return factory(cfg)

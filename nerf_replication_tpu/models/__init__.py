@@ -1,0 +1,11 @@
+"""Network factory: resolves the ``network_module`` plugin key
+(parity: src/models/make_network.py:4-8)."""
+
+from __future__ import annotations
+
+from ..registry import load_attr
+
+
+def make_network(cfg):
+    factory = load_attr(cfg.network_module, "make_network", "Network")
+    return factory(cfg)

@@ -1,0 +1,45 @@
+"""Encoder dispatch — parity with the reference's `get_encoder`
+(src/models/encoding/__init__.py:6-86), which keys 14 encoder types off
+``cfg.type``.
+
+Param-free encoders (frequency) return ``(callable, out_dim)``. Parametric
+encoders (hash grids, triplanes, deformation fields) return
+``(flax_module, out_dim)`` — the network binds them as submodules so their
+tables train with the MLP. Types not yet implemented raise with a clear
+message naming the round they're planned for.
+"""
+
+from __future__ import annotations
+
+from .freq import frequency_encoder
+
+
+def get_encoder(enc_cfg):
+    """``enc_cfg`` is a config node with at least ``type`` and ``input_dim``."""
+    enc_type = enc_cfg.type
+
+    if enc_type == "frequency":
+        return frequency_encoder(
+            input_dim=int(enc_cfg.input_dim),
+            n_freqs=int(enc_cfg.freq),
+            include_input=True,
+            log_sampling=True,
+        )
+
+    if enc_type in ("hashgrid", "cuda_hashgrid", "grid_hash"):
+        from .hashgrid import HashGridEncoder
+
+        module = HashGridEncoder.from_cfg(enc_cfg)
+        return module, module.out_dim
+
+    if enc_type in ("triplane", "cuda_triplane"):
+        from .triplane import TriPlaneEncoder
+
+        module = TriPlaneEncoder.from_cfg(enc_cfg)
+        return module, module.out_dim
+
+    raise NotImplementedError(
+        f"Encoder type {enc_type!r} is not implemented yet "
+        f"(reference parity list: frequency, hashgrid, triplane, dnerf & "
+        f"variants; see SURVEY.md §2.2)"
+    )

@@ -1,0 +1,3 @@
+from .network import Network, NeRFMLP, init_params, make_network
+
+__all__ = ["Network", "NeRFMLP", "init_params", "make_network"]

@@ -1,0 +1,21 @@
+"""Renderer factory: resolves the ``renderer_module`` plugin key
+(parity: src/models/nerf/renderer/make_renderer.py:4-8)."""
+
+from __future__ import annotations
+
+from ..registry import load_attr
+from .volume import RenderOptions, Renderer, raw2outputs, render_rays, sample_pdf
+
+__all__ = [
+    "RenderOptions",
+    "Renderer",
+    "make_renderer",
+    "raw2outputs",
+    "render_rays",
+    "sample_pdf",
+]
+
+
+def make_renderer(cfg, network):
+    factory = load_attr(cfg.renderer_module, "make_renderer", "Renderer")
+    return factory(cfg, network)

@@ -1,0 +1,18 @@
+from .loss import NeRFLoss, make_loss
+from .optim import make_lr_schedule, make_optimizer
+from .recorder import Recorder, SmoothedValue, make_recorder
+from .trainer import Trainer, fit, make_train_state, make_trainer
+
+__all__ = [
+    "NeRFLoss",
+    "Recorder",
+    "SmoothedValue",
+    "Trainer",
+    "fit",
+    "make_loss",
+    "make_lr_schedule",
+    "make_optimizer",
+    "make_recorder",
+    "make_train_state",
+    "make_trainer",
+]

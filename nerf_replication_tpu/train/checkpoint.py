@@ -1,0 +1,154 @@
+"""Checkpoint I/O on Orbax with the reference's retention semantics.
+
+Parity with `src/utils/net_utils.py:288-457`: bundle {params, opt_state, step,
+epoch, recorder} per save; ``latest`` updated every ``save_latest_ep`` epochs;
+numbered epoch checkpoints every ``save_ep`` epochs with rolling retention of
+the most recent 5 (net_utils.py:337-343); full resume restores the bundle and
+begin-epoch; weights-only load with epoch selection for eval
+(net_utils.py:346-379); ``pretrain`` warm-start loading params only.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+KEEP_EPOCHS = 5  # net_utils.py:337-343
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(path)
+
+
+def _bundle(state, epoch: int, recorder_state: dict | None):
+    rs = recorder_state or {}
+    return {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "step": np.asarray(state.step),
+        "epoch": np.asarray(epoch),
+        # fixed schema so save/restore templates always structure-match
+        "recorder": {
+            "step": np.asarray(int(rs.get("step", 0))),
+            "epoch": np.asarray(int(rs.get("epoch", 0))),
+        },
+    }
+
+
+def save_model(model_dir: str, state, epoch: int, recorder_state=None,
+               latest: bool = False) -> str:
+    """Save a checkpoint bundle; prune numbered checkpoints to KEEP_EPOCHS."""
+    os.makedirs(model_dir, exist_ok=True)
+    name = "latest" if latest else str(epoch)
+    path = _abs(os.path.join(model_dir, name))
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, _bundle(state, epoch, recorder_state))
+    ckptr.wait_until_finished()
+
+    if not latest:
+        numbered = sorted(
+            (int(d) for d in os.listdir(model_dir) if re.fullmatch(r"\d+", d))
+        )
+        for old in numbered[:-KEEP_EPOCHS]:
+            shutil.rmtree(os.path.join(model_dir, str(old)), ignore_errors=True)
+    return path
+
+
+def _available_epochs(model_dir: str) -> list[int]:
+    if not os.path.isdir(model_dir):
+        return []
+    return sorted(
+        int(d) for d in os.listdir(model_dir) if re.fullmatch(r"\d+", d)
+    )
+
+
+def load_model(model_dir: str, state, epoch: int = -1):
+    """Full resume (net_utils.py:288-320). Returns (state, begin_epoch,
+    recorder_state) or (state, 0, None) when nothing to resume."""
+    target = None
+    if os.path.isdir(os.path.join(model_dir, "latest")) and epoch == -1:
+        target = os.path.join(model_dir, "latest")
+    else:
+        epochs = _available_epochs(model_dir)
+        if epochs:
+            pick = epoch if epoch != -1 and epoch in epochs else epochs[-1]
+            target = os.path.join(model_dir, str(pick))
+    if target is None:
+        return state, 0, None
+
+    ckptr = ocp.StandardCheckpointer()
+    template = _bundle(state, 0, {})
+    restored = ckptr.restore(_abs(target), target=template)
+    new_state = state.replace(
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        step=int(restored["step"]),
+    )
+    recorder = {k: int(v) for k, v in restored["recorder"].items()}
+    return new_state, int(restored["epoch"]) + 1, recorder
+
+
+def load_network(model_dir: str, params, epoch: int = -1):
+    """Weights-only load with epoch selection (net_utils.py:346-379).
+    Returns (params, loaded_epoch) — params unchanged if no checkpoint."""
+    target, picked = None, -1
+    epochs = _available_epochs(model_dir)
+    if epoch == -1:
+        if os.path.isdir(os.path.join(model_dir, "latest")):
+            target, picked = os.path.join(model_dir, "latest"), -1
+        elif epochs:
+            target, picked = os.path.join(model_dir, str(epochs[-1])), epochs[-1]
+    elif epochs and epoch in epochs:
+        target, picked = os.path.join(model_dir, str(epoch)), epoch
+    if target is None:
+        return params, -1
+
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(_abs(target))
+    # accept either the raw param tree or the {"params": ...} wrapper
+    wrapped = isinstance(params, dict) and set(params.keys()) == {"params"}
+    inner = params["params"] if wrapped else params
+    loaded = jax.tree.map(
+        lambda t, r: np.asarray(r).astype(t.dtype).reshape(t.shape),
+        inner,
+        restored["params"],
+    )
+    return ({"params": loaded} if wrapped else loaded), picked
+
+
+def save_pretrain(pretrain_dir: str, params):
+    os.makedirs(pretrain_dir, exist_ok=True)
+    path = _abs(os.path.join(pretrain_dir, "pretrain"))
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, {"params": params})
+    ckptr.wait_until_finished()
+
+
+def load_pretrain(pretrain_dir: str, params):
+    """Warm-start params only (net_utils.py:429-450)."""
+    path = os.path.join(pretrain_dir, "pretrain")
+    if not os.path.isdir(path):
+        return params, False
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(_abs(path), target={"params": params})
+    return restored["params"], True
+
+
+def save_trained_config(cfg):
+    """Provenance snapshot: merged YAML + command line (net_utils.py:418-426)."""
+    import sys
+
+    if not os.environ.get("JAX_DISABLE_SAVE_CONFIG"):
+        os.makedirs(cfg.trained_config_dir, exist_ok=True)
+        with open(os.path.join(cfg.trained_config_dir, "train_config.yaml"), "w") as f:
+            f.write("# cmd: " + " ".join(sys.argv) + "\n")
+            f.write(cfg.dump())

@@ -1,0 +1,80 @@
+"""Optimizer + LR-schedule factories on optax.
+
+Parity with the reference's `make_optimizer` (src/train/optimizer.py:5-28:
+adam/radam/sgd with weight decay) and `make_lr_scheduler`/`set_lr_scheduler`
+(src/train/scheduler.py:9-30; src/utils/optimizer/lr_scheduler.py:7-79):
+
+* ``exponential``: lr·gamma^(epoch/decay_epochs) — the reference's continuous
+  per-epoch decay (lr_scheduler.py:68-79), expressed here per *step* as
+  gamma^(step/(decay_epochs·ep_iter)) so the jitted step needs no epoch state.
+* ``multi_step`` / ``warmup_multi_step``: piecewise-constant decay at epoch
+  milestones (+ linear warmup).
+* gradient clipping **by value** at 40, applied before the optimizer update
+  (trainer.py:61's `clip_grad_value_(·, 40)`).
+
+The whole update is one optax chain, so it lives inside the jitted train step.
+"""
+
+from __future__ import annotations
+
+import optax
+
+GRAD_CLIP_VALUE = 40.0
+
+
+def make_lr_schedule(cfg) -> optax.Schedule:
+    sched = cfg.train.scheduler
+    base_lr = float(cfg.train.lr)
+    ep_iter = max(int(cfg.get("ep_iter", -1)), 1)
+    stype = sched.get("type", "multi_step")
+
+    if stype == "exponential":
+        gamma = float(sched.gamma)
+        decay_steps = float(sched.decay_epochs) * ep_iter
+
+        def schedule(step):
+            return base_lr * gamma ** (step / decay_steps)
+
+        return schedule
+
+    if stype in ("multi_step", "warmup_multi_step"):
+        gamma = float(sched.gamma)
+        milestones = [int(m) * ep_iter for m in sched.milestones]
+        boundaries = {m: gamma for m in milestones}
+        base = optax.piecewise_constant_schedule(base_lr, boundaries)
+        if stype == "warmup_multi_step":
+            warmup_steps = int(sched.get("warmup_epochs", 1)) * ep_iter
+            warmup_factor = float(sched.get("warmup_factor", 1.0 / 3))
+            warm = optax.linear_schedule(
+                base_lr * warmup_factor, base_lr, warmup_steps
+            )
+            return optax.join_schedules([warm, base], [warmup_steps])
+        return base
+
+    raise NotImplementedError(f"scheduler type {stype!r}")
+
+
+def make_optimizer(cfg) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """Returns (tx, schedule); schedule is exposed for logging the current lr."""
+    schedule = make_lr_schedule(cfg)
+    name = cfg.train.get("optim", "adam")
+    wd = float(cfg.train.get("weight_decay", 0.0))
+    eps = float(cfg.train.get("eps", 1e-8))
+
+    if name == "adam":
+        opt = (
+            optax.adamw(schedule, eps=eps, weight_decay=wd)
+            if wd > 0
+            else optax.adam(schedule, eps=eps)
+        )
+    elif name == "radam":
+        opt = optax.radam(schedule, eps=eps)
+        if wd > 0:
+            opt = optax.chain(optax.add_decayed_weights(wd), opt)
+    elif name == "sgd":
+        opt = optax.sgd(schedule, momentum=0.9)
+    else:
+        raise NotImplementedError(f"optimizer {name!r}")
+
+    tx = optax.chain(optax.clip(GRAD_CLIP_VALUE), opt)
+    return tx, schedule

@@ -1,0 +1,142 @@
+"""Metrics recorder: windowed smoothing + TensorBoard + console lines.
+
+Parity with the reference's `Recorder`/`SmoothedValue` (src/train/recorder.py:
+10-138): median/avg/global-avg over a sliding window, scalar and image
+TensorBoard logging, process-0 guard on every method, checkpointable state,
+and log-dir wiping when starting fresh. The console line format mirrors the
+reference trainer's (trainer.py:79-92: eta / epoch / step / losses / lr /
+data+batch time / max-mem) so log-parsing tooling (plot_loss) works on both.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from collections import defaultdict, deque
+
+import numpy as np
+
+
+def _is_chief() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class SmoothedValue:
+    """Track a window of values with median/avg plus a global average
+    (recorder.py:10-37)."""
+
+    def __init__(self, window_size: int = 20):
+        self.deque = deque(maxlen=window_size)
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, value: float):
+        v = float(value)
+        self.deque.append(v)
+        self.count += 1
+        self.total += v
+
+    @property
+    def median(self) -> float:
+        return float(np.median(self.deque)) if self.deque else 0.0
+
+    @property
+    def avg(self) -> float:
+        return float(np.mean(self.deque)) if self.deque else 0.0
+
+    @property
+    def global_avg(self) -> float:
+        return self.total / max(self.count, 1)
+
+    def __str__(self):
+        return f"{self.median:.4f} ({self.global_avg:.4f})"
+
+
+class Recorder:
+    def __init__(self, cfg, window_size: int = 20):
+        self.chief = _is_chief()
+        self.record_dir = cfg.record_dir
+        self.step = 0
+        self.epoch = 0
+        self.loss_stats = defaultdict(lambda: SmoothedValue(window_size))
+        self.batch_time = SmoothedValue(window_size)
+        self.data_time = SmoothedValue(window_size)
+        self._writer = None
+
+        if not self.chief:
+            return
+        if not cfg.get("resume", True) and os.path.exists(self.record_dir):
+            shutil.rmtree(self.record_dir, ignore_errors=True)  # recorder.py:56-57
+        os.makedirs(self.record_dir, exist_ok=True)
+
+    @property
+    def writer(self):
+        if self._writer is None and self.chief:
+            from tensorboardX import SummaryWriter
+
+            self._writer = SummaryWriter(log_dir=self.record_dir)
+        return self._writer
+
+    def update_loss_stats(self, stats: dict):
+        if not self.chief:
+            return
+        for k, v in stats.items():
+            self.loss_stats[k].update(float(v))
+
+    def record(self, prefix: str, step: int | None = None, stats: dict | None = None,
+               images: dict | None = None):
+        """Write window-median scalars (recorder.py:89-107) and images."""
+        if not self.chief:
+            return
+        step = self.step if step is None else step
+        pattern = prefix + "/{}"
+        if stats is None:
+            for k, sv in self.loss_stats.items():
+                self.writer.add_scalar(pattern.format(k), sv.median, step)
+        else:
+            for k, v in stats.items():
+                v = v.median if isinstance(v, SmoothedValue) else float(v)
+                self.writer.add_scalar(pattern.format(k), v, step)
+        if images:
+            for k, img in images.items():
+                # HWC float [0,1] → CHW
+                arr = np.asarray(img)
+                if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):
+                    arr = np.transpose(arr, (2, 0, 1))
+                self.writer.add_image(pattern.format(k), arr, step)
+
+    # -- checkpointable state (recorder.py:109-119) -------------------------
+    def state_dict(self) -> dict:
+        return {"step": self.step, "epoch": self.epoch}
+
+    def load_state_dict(self, state: dict):
+        self.step = int(state.get("step", 0))
+        self.epoch = int(state.get("epoch", 0))
+
+    # -- console ------------------------------------------------------------
+    def console_line(self, epoch: int, it: int, max_iter: int, lr: float,
+                     max_mem_mb: float | None = None) -> str:
+        eta_sec = self.batch_time.global_avg * (max_iter - it)
+        h, rem = divmod(int(eta_sec), 3600)
+        m, s = divmod(rem, 60)
+        parts = [
+            f"eta: {h}:{m:02d}:{s:02d}",
+            f"epoch: {epoch}",
+            f"step: {self.step}",
+            *[f"{k}: {v}" for k, v in self.loss_stats.items()],
+            f"lr: {lr:.6f}",
+            f"data: {self.data_time.avg:.4f}",
+            f"batch: {self.batch_time.avg:.4f}",
+        ]
+        if max_mem_mb is not None:
+            parts.append(f"max_mem: {max_mem_mb:.0f}")
+        return "  ".join(parts)
+
+
+def make_recorder(cfg) -> Recorder:
+    return Recorder(cfg, window_size=20)

@@ -1,0 +1,266 @@
+"""Trainer: the jitted hot loop, epoch cadence, validation, checkpointing.
+
+Parity with the reference trainer/train-entry (src/train/trainers/trainer.py:
+11-130, train.py:31-98) redesigned for TPU (SURVEY.md §7):
+
+* The whole per-step pipeline — random ray draw from the device-resident ray
+  bank, stratified sampling, coarse+fine MLP sweeps, compositing, MSE, grads,
+  value-clip(40), adam update — is ONE jitted function. The reference pays
+  ~0.2 s/iter of Python/DataLoader overhead for this (BASELINE.md); here the
+  hot loop never touches the host.
+* RNG: a base key folded with (step, process_index) per step — deterministic,
+  resumable, and distinct across data-parallel processes.
+* Precrop warm-up (precrop_iters/precrop_frac — configured but dead in the
+  reference, SURVEY.md §2.5) is honored via a restricted index pool for the
+  first N steps (a second compiled variant of the same step function).
+* Validation renders whole test images through the chunked eval path and
+  feeds the evaluator (trainer.py:98-130).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.training.train_state import TrainState
+
+from ..datasets.sampling import sample_rays, sample_step_key
+from ..models.nerf.network import init_params
+from .checkpoint import load_model, load_pretrain, save_model, save_trained_config
+from .optim import make_optimizer
+from .recorder import Recorder
+
+
+def make_train_state(cfg, network, key) -> tuple[TrainState, "optax.Schedule"]:
+    params = init_params(network, key)
+    tx, schedule = make_optimizer(cfg)
+    state = TrainState.create(
+        apply_fn=network.apply, params=params["params"], tx=tx
+    )
+    return state, schedule
+
+
+class Trainer:
+    def __init__(self, cfg, network, loss, evaluator=None):
+        self.cfg = cfg
+        self.network = network
+        self.loss = loss  # NeRFLoss: (params, batch, key, train) -> (out, loss, stats)
+        self.evaluator = evaluator
+        self.n_rays = int(cfg.task_arg.get("N_rays", 1024))
+        self.near = float(cfg.task_arg.near)
+        self.far = float(cfg.task_arg.far)
+        self.precrop_iters = int(cfg.task_arg.get("precrop_iters", 0))
+        self.ep_iter = int(cfg.get("ep_iter", 500))
+        self.process_index = jax.process_index()
+        self._step_fn = None
+        self._step_fn_pool = None
+
+    def epoch_iters(self, bank_size: int) -> int:
+        """Steps per epoch. ep_iter=-1 (the reference's 'no resampling'
+        sentinel, make_dataset.py:64-65) means one natural pass over the ray
+        bank at N_rays per step."""
+        if self.ep_iter > 0:
+            return self.ep_iter
+        return max(1, bank_size // self.n_rays)
+
+    # -- jitted step construction ------------------------------------------
+    def _loss_for_grad(self, params, rays, rgbs, key):
+        batch = {"rays": rays, "rgbs": rgbs, "near": self.near, "far": self.far}
+        _, loss, stats = self.loss(
+            {"params": params}, batch, key=key, train=True
+        )
+        return loss, stats
+
+    def _build_step(self, with_pool: bool):
+        n_rays = self.n_rays
+        process_index = self.process_index
+
+        @jax.jit
+        def step_fn(state, bank_rays, bank_rgbs, base_key, *pool):
+            key = sample_step_key(base_key, state.step, process_index)
+            k_sample, k_render = jax.random.split(key)
+            rays, rgbs = sample_rays(
+                k_sample, bank_rays, bank_rgbs, n_rays,
+                index_pool=pool[0] if pool else None,
+            )
+            (loss, stats), grads = jax.value_and_grad(
+                self._loss_for_grad, has_aux=True
+            )(state.params, rays, rgbs, k_render)
+            new_state = state.apply_gradients(grads=grads)
+            return new_state, stats
+
+        return step_fn
+
+    def step(self, state, bank_rays, bank_rgbs, base_key, index_pool=None):
+        """One optimization step; dispatches to the precrop or full variant."""
+        if index_pool is not None:
+            if self._step_fn_pool is None:
+                self._step_fn_pool = self._build_step(with_pool=True)
+            return self._step_fn_pool(
+                state, bank_rays, bank_rgbs, base_key, index_pool
+            )
+        if self._step_fn is None:
+            self._step_fn = self._build_step(with_pool=False)
+        return self._step_fn(state, bank_rays, bank_rgbs, base_key)
+
+    # -- epoch loops ---------------------------------------------------------
+    def train_epoch(
+        self, state, epoch: int, bank, base_key, recorder: Recorder,
+        schedule, index_pool=None, log=print,
+    ):
+        bank_rays, bank_rgbs, pool = bank[0], bank[1], index_pool
+        max_iter = self.epoch_iters(int(bank_rays.shape[0]))
+        end = time.time()
+        log_interval = int(self.cfg.get("log_interval", 20))
+        stats = None
+        # track the step on the host: int(state.step) would block on the
+        # in-flight device step and serialize async dispatch
+        host_step = int(state.step)
+        for it in range(max_iter):
+            data_time = time.time() - end
+            use_pool = pool is not None and host_step < self.precrop_iters
+            state, stats = self.step(
+                state, bank_rays, bank_rgbs, base_key,
+                index_pool=pool if use_pool else None,
+            )
+            host_step += 1
+            if it % log_interval == 0 or it == max_iter - 1:
+                # host sync only at the logging cadence
+                stats_host = {k: float(v) for k, v in stats.items()}
+                recorder.update_loss_stats(stats_host)
+            recorder.step = host_step
+            recorder.batch_time.update(time.time() - end)
+            recorder.data_time.update(data_time)
+            end = time.time()
+            if it % log_interval == 0 or it == max_iter - 1:
+                lr = float(schedule(host_step))
+                mem = _device_mem_mb()
+                log(recorder.console_line(epoch, it, max_iter, lr, mem))
+                recorder.record("train")
+        return state, stats
+
+    def val(self, state, epoch: int, test_dataset, recorder: Recorder | None = None,
+            max_images: int | None = None, log=print):
+        """Epoch-boundary validation (trainer.py:98-130): render whole test
+        images via the chunked path, run the evaluator per image."""
+        renderer = self.loss.renderer
+        params = {"params": state.params}
+        n = len(test_dataset)
+        if max_images is not None:
+            n = min(n, max_images)
+        for i in range(n):
+            batch = test_dataset.image_batch(i)
+            out = renderer.render_chunked(
+                params,
+                {
+                    "rays": jnp.asarray(batch["rays"]),
+                    "near": batch["near"],
+                    "far": batch["far"],
+                },
+            )
+            out = {k: np.asarray(v) for k, v in out.items()}
+            if self.evaluator is not None:
+                self.evaluator.evaluate(out, batch)
+        result = {}
+        if self.evaluator is not None:
+            result = self.evaluator.summarize()
+            if recorder is not None and result:
+                recorder.record("val", step=epoch, stats=result)
+            if result:
+                log(f"val epoch {epoch}: " + "  ".join(
+                    f"{k}: {v:.4f}" for k, v in result.items()
+                ))
+        return result
+
+
+def _device_mem_mb() -> float | None:
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "peak_bytes_in_use" in stats:
+            return stats["peak_bytes_in_use"] / 2**20
+    except Exception:
+        pass
+    return None
+
+
+def fit(cfg, network=None, log=print):
+    """Full training entry (parity: train.py:31-98): build everything from
+    cfg, resume if available, run the epoch loop with save/eval cadence."""
+    from ..datasets import make_dataset
+    from ..evaluators import make_evaluator
+    from ..registry import load_attr
+    from .recorder import make_recorder
+
+    if network is None:
+        from ..models import make_network
+
+        network = make_network(cfg)
+
+    loss_factory = load_attr(cfg.loss_module, "make_loss", "NetworkWrapper")
+    loss = loss_factory(cfg, network)
+    evaluator = None if cfg.get("skip_eval", False) else make_evaluator(cfg)
+    trainer = Trainer(cfg, network, loss, evaluator)
+    recorder = make_recorder(cfg)
+
+    seed = int(cfg.get("seed", 0))
+    key = jax.random.PRNGKey(seed)
+    k_init, base_key = jax.random.split(key)
+    state, schedule = make_train_state(cfg, network, k_init)
+
+    begin_epoch = 0
+    if cfg.get("resume", True):
+        state, begin_epoch, rec_state = load_model(cfg.trained_model_dir, state)
+        if rec_state:
+            recorder.load_state_dict(rec_state)
+    if begin_epoch == 0 and cfg.get("pretrain", ""):
+        params, ok = load_pretrain(cfg.pretrain, {"params": state.params})
+        if ok:
+            state = state.replace(params=params["params"])
+
+    if jax.process_index() == 0:
+        save_trained_config(cfg)
+
+    train_ds = make_dataset(cfg, "train")
+    test_ds = make_dataset(cfg, "test")
+    bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+    pool = None
+    if trainer.precrop_iters > 0:
+        frac = float(cfg.task_arg.get("precrop_frac", 0.5))
+        pool = jax.device_put(train_ds.precrop_index_pool(frac))
+
+    epochs = int(cfg.train.epoch)
+    save_ep = int(cfg.get("save_ep", 40))
+    save_latest_ep = int(cfg.get("save_latest_ep", 10))
+    eval_ep = int(cfg.get("eval_ep", 10))
+
+    for epoch in range(begin_epoch, epochs):
+        recorder.epoch = epoch
+        state, _ = trainer.train_epoch(
+            state, epoch, bank, base_key, recorder, schedule, index_pool=pool,
+            log=log,
+        )
+        chief = jax.process_index() == 0
+        if chief and (epoch + 1) % save_ep == 0:
+            save_model(cfg.trained_model_dir, state, epoch,
+                       recorder.state_dict(), latest=False)
+        if chief and (epoch + 1) % save_latest_ep == 0:
+            save_model(cfg.trained_model_dir, state, epoch,
+                       recorder.state_dict(), latest=True)
+        if (epoch + 1) % eval_ep == 0 and evaluator is not None:
+            trainer.val(state, epoch, test_ds, recorder, log=log)
+    return state
+
+
+def make_trainer(cfg, network) -> Trainer:
+    """Reference-style factory (make_trainer.py:5-14): wraps the network in
+    the configured loss module and returns the Trainer."""
+    from ..evaluators import make_evaluator
+    from ..registry import load_attr
+
+    loss_factory = load_attr(cfg.loss_module, "make_loss", "NetworkWrapper")
+    loss = loss_factory(cfg, network)
+    evaluator = None if cfg.get("skip_eval", False) else make_evaluator(cfg)
+    return Trainer(cfg, network, loss, evaluator)

@@ -1,0 +1,72 @@
+"""Image metrics and I/O helpers (PSNR, SSIM, PNG writing).
+
+The reference uses skimage for SSIM (src/evaluators/nerf.py:43); that
+dependency is replaced by a native implementation of Wang et al. SSIM
+(gaussian 11×11 window, sigma 1.5, K1=0.01, K2=0.03) over float images with
+``data_range=1`` — fixing the reference's nonstandard
+``data_range=pred.max()-pred.min()`` quirk (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def psnr(pred: np.ndarray, gt: np.ndarray) -> float:
+    """-10·log10(mse) on float images in [0, 1] (src/evaluators/nerf.py:23-26)."""
+    mse = float(np.mean((pred.astype(np.float64) - gt.astype(np.float64)) ** 2))
+    if mse == 0:
+        return float("inf")
+    return float(-10.0 * np.log10(mse))
+
+
+def _gaussian_kernel(size: int = 11, sigma: float = 1.5) -> np.ndarray:
+    x = np.arange(size, dtype=np.float64) - (size - 1) / 2
+    g = np.exp(-(x**2) / (2 * sigma**2))
+    return g / g.sum()
+
+
+def _filter2d_sep(img: np.ndarray, k: np.ndarray) -> np.ndarray:
+    """Separable 'valid' gaussian filtering over the two leading axes."""
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    w = k.size
+    out = sliding_window_view(img, w, axis=0) @ k
+    out = sliding_window_view(out, w, axis=1) @ k
+    return out
+
+
+def ssim(pred: np.ndarray, gt: np.ndarray, data_range: float = 1.0) -> float:
+    """Mean SSIM; channels averaged. Inputs [H, W] or [H, W, C] floats."""
+    pred = np.asarray(pred, np.float64)
+    gt = np.asarray(gt, np.float64)
+    if pred.ndim == 2:
+        pred, gt = pred[..., None], gt[..., None]
+    k = _gaussian_kernel()
+    c1 = (0.01 * data_range) ** 2
+    c2 = (0.03 * data_range) ** 2
+    vals = []
+    for c in range(pred.shape[-1]):
+        x, y = pred[..., c], gt[..., c]
+        mu_x = _filter2d_sep(x, k)
+        mu_y = _filter2d_sep(y, k)
+        xx = _filter2d_sep(x * x, k) - mu_x**2
+        yy = _filter2d_sep(y * y, k) - mu_y**2
+        xy = _filter2d_sep(x * y, k) - mu_x * mu_y
+        s = ((2 * mu_x * mu_y + c1) * (2 * xy + c2)) / (
+            (mu_x**2 + mu_y**2 + c1) * (xx + yy + c2)
+        )
+        vals.append(s.mean())
+    return float(np.mean(vals))
+
+
+def write_png(path: str, img: np.ndarray):
+    """Write a float [0,1] or uint8 image as PNG."""
+    import imageio.v2 as imageio
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if img.dtype != np.uint8:
+        img = (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+    imageio.imwrite(path, img)

@@ -7,13 +7,22 @@ Must run before anything imports jax, hence env mutation at conftest import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the machine environment pins JAX to the real TPU tunnel
+# (axon, which is monoclient) — tests must never attach to it. The axon
+# sitecustomize calls jax.config.update("jax_platforms", "axon,cpu") at
+# interpreter boot, which beats env vars, so we must update the config AFTER
+# importing jax, not just set JAX_PLATFORMS.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,261 @@
+"""Training subsystem tests: schedules, optimizer, jitted step, end-to-end
+learning on the procedural scene, checkpoint round-trip."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nerf_replication_tpu.config import make_cfg
+from nerf_replication_tpu.datasets.blender import Dataset
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.train import (
+    Trainer,
+    make_loss,
+    make_lr_schedule,
+    make_optimizer,
+    make_train_state,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg(scene_root, extra=()):
+    """A miniature lego-schema config that compiles fast on 1-core CPU."""
+    return make_cfg(
+        os.path.join(ROOT, "configs", "nerf", "lego.yaml"),
+        [
+            "scene", "procedural",
+            "train_dataset.data_root", str(scene_root),
+            "test_dataset.data_root", str(scene_root),
+            "train_dataset.H", "16", "train_dataset.W", "16",
+            "test_dataset.H", "16", "test_dataset.W", "16",
+            "task_arg.N_rays", "128",
+            "task_arg.N_samples", "24",
+            "task_arg.N_importance", "24",
+            "task_arg.chunk_size", "256",
+            "task_arg.precrop_iters", "0",
+            "network.nerf.W", "64",
+            "network.nerf.D", "3",
+            "network.nerf.skips", "[1]",
+            "network.xyz_encoder.freq", "6",
+            "network.dir_encoder.freq", "2",
+            "ep_iter", "25",
+            *extra,
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def scene_root(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=6, n_test=2)
+    return root
+
+
+def test_exponential_schedule_matches_reference_formula(scene_root):
+    cfg = tiny_cfg(scene_root)
+    sched = make_lr_schedule(cfg)
+    lr0 = float(cfg.train.lr)
+    gamma, decay_epochs, ep_iter = 0.1, 500.0, 25
+    for step in (0, 25, 1000):
+        epoch = step / ep_iter
+        expected = lr0 * gamma ** (epoch / decay_epochs)
+        np.testing.assert_allclose(float(sched(step)), expected, rtol=1e-6)
+
+
+def test_multistep_schedule(scene_root):
+    cfg = tiny_cfg(
+        scene_root,
+        ["train.scheduler.type", "multi_step",
+         "train.scheduler.milestones", "[2, 4]",
+         "train.scheduler.gamma", "0.5"],
+    )
+    sched = make_lr_schedule(cfg)  # ep_iter=25 → boundaries at 50, 100
+    lr0 = float(cfg.train.lr)
+    assert np.isclose(float(sched(0)), lr0)
+    assert np.isclose(float(sched(60)), lr0 * 0.5)
+    assert np.isclose(float(sched(120)), lr0 * 0.25)
+
+
+def test_grad_clip_by_value():
+    import optax
+
+    from nerf_replication_tpu.train.optim import GRAD_CLIP_VALUE
+
+    assert GRAD_CLIP_VALUE == 40.0
+    clip = optax.clip(GRAD_CLIP_VALUE)
+    g = {"w": jnp.array([100.0, -100.0, 3.0])}
+    out, _ = clip.update(g, clip.init(g))
+    np.testing.assert_allclose(out["w"], [40.0, -40.0, 3.0])
+
+
+def test_train_step_runs_and_descends(scene_root):
+    cfg = tiny_cfg(scene_root)
+    net = make_network(cfg)
+    loss = make_loss(cfg, net)
+    trainer = Trainer(cfg, net, loss)
+    state, schedule = make_train_state(cfg, net, jax.random.PRNGKey(0))
+
+    ds = Dataset(
+        data_root=scene_root, scene="procedural", split="train", H=16, W=16
+    )
+    bank_rays, bank_rgbs = (jnp.asarray(a) for a in ds.ray_bank())
+    base_key = jax.random.PRNGKey(1)
+
+    losses = []
+    for _ in range(30):
+        state, stats = trainer.step(state, bank_rays, bank_rgbs, base_key)
+        losses.append(float(stats["loss"]))
+    assert int(state.step) == 30
+    assert np.all(np.isfinite(losses))
+    # loss should clearly descend on this easy scene
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8
+
+
+def test_end_to_end_learning_psnr_climbs(scene_root):
+    """The minimum end-to-end slice (SURVEY.md §7 step 5): PSNR improves."""
+    cfg = tiny_cfg(scene_root)
+    net = make_network(cfg)
+    loss = make_loss(cfg, net)
+    trainer = Trainer(cfg, net, loss)
+    state, schedule = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    ds = Dataset(
+        data_root=scene_root, scene="procedural", split="train", H=16, W=16
+    )
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    base_key = jax.random.PRNGKey(1)
+
+    psnr_first = None
+    for i in range(150):
+        state, stats = trainer.step(state, bank[0], bank[1], base_key)
+        if i == 0:
+            psnr_first = float(stats["psnr"])
+    psnr_last = float(stats["psnr"])
+    assert psnr_last > psnr_first + 3.0, (psnr_first, psnr_last)
+    assert psnr_last > 12.0
+
+
+def test_epoch_iters_sentinel(scene_root):
+    """ep_iter=-1 must mean one natural pass over the bank, never 0 steps."""
+    cfg = tiny_cfg(scene_root, ["ep_iter", "-1"])
+    net = make_network(cfg)
+    trainer = Trainer(cfg, net, make_loss(cfg, net))
+    assert trainer.epoch_iters(bank_size=1536) == 1536 // 128
+    assert trainer.epoch_iters(bank_size=10) == 1  # never zero
+    cfg2 = tiny_cfg(scene_root)
+    trainer2 = Trainer(cfg2, net, make_loss(cfg2, net))
+    assert trainer2.epoch_iters(bank_size=10**9) == 25
+
+
+def test_step_rng_distinct_across_processes(scene_root):
+    """Data-parallel processes must draw different ray batches."""
+    cfg = tiny_cfg(scene_root)
+    net = make_network(cfg)
+    loss = make_loss(cfg, net)
+    ds = Dataset(
+        data_root=scene_root, scene="procedural", split="train", H=16, W=16
+    )
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(0)
+
+    t0 = Trainer(cfg, net, loss)
+    t1 = Trainer(cfg, net, loss)
+    t1.process_index = 1  # simulate rank 1
+    state0, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    state1, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    _, s0 = t0.step(state0, bank[0], bank[1], key)
+    _, s1 = t1.step(state1, bank[0], bank[1], key)
+    # same params, same step, different process → different batch → loss
+    assert float(s0["loss"]) != float(s1["loss"])
+
+
+def test_precrop_pool_step_variant(scene_root):
+    cfg = tiny_cfg(scene_root, ["task_arg.precrop_iters", "10"])
+    net = make_network(cfg)
+    loss = make_loss(cfg, net)
+    trainer = Trainer(cfg, net, loss)
+    state, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    ds = Dataset(
+        data_root=scene_root, scene="procedural", split="train", H=16, W=16
+    )
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    pool = jnp.asarray(ds.precrop_index_pool(0.5))
+    state, stats = trainer.step(
+        state, bank[0], bank[1], jax.random.PRNGKey(1), index_pool=pool
+    )
+    assert np.isfinite(float(stats["loss"]))
+
+
+def test_checkpoint_roundtrip(scene_root, tmp_path):
+    from nerf_replication_tpu.train.checkpoint import (
+        load_model,
+        load_network,
+        save_model,
+    )
+
+    cfg = tiny_cfg(scene_root)
+    net = make_network(cfg)
+    state, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    state = state.replace(step=123)
+    model_dir = str(tmp_path / "ckpt")
+
+    save_model(model_dir, state, epoch=7, recorder_state={"step": 123}, latest=True)
+    save_model(model_dir, state, epoch=7, recorder_state={"step": 123})
+
+    state2, _ = make_train_state(cfg, net, jax.random.PRNGKey(42))
+    restored, begin_epoch, rec = load_model(model_dir, state2)
+    assert begin_epoch == 8
+    assert int(restored.step) == 123
+    assert rec["step"] == 123
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b),
+        restored.params, state.params,
+    )
+
+    params_only, picked = load_network(model_dir, {"params": state2.params})
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b),
+        params_only["params"], state.params,
+    )
+
+
+def test_checkpoint_retention(scene_root, tmp_path):
+    from nerf_replication_tpu.train.checkpoint import KEEP_EPOCHS, save_model
+
+    cfg = tiny_cfg(scene_root)
+    net = make_network(cfg)
+    state, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    model_dir = str(tmp_path / "ckpt")
+    for ep in range(8):
+        save_model(model_dir, state, epoch=ep)
+    kept = sorted(
+        int(d) for d in os.listdir(model_dir) if d.isdigit()
+    )
+    assert kept == list(range(8 - KEEP_EPOCHS, 8))
+
+
+def test_recorder_smoothing_and_console(tmp_path):
+    from nerf_replication_tpu.config import ConfigNode
+    from nerf_replication_tpu.train.recorder import Recorder, SmoothedValue
+
+    sv = SmoothedValue(window_size=3)
+    for v in (1.0, 2.0, 9.0):
+        sv.update(v)
+    assert sv.median == 2.0
+    assert np.isclose(sv.avg, 4.0)
+    assert np.isclose(sv.global_avg, 4.0)
+    sv.update(2.0)  # window drops 1.0
+    assert sv.median == 2.0
+    assert np.isclose(sv.global_avg, 3.5)
+
+    cfg = ConfigNode({"record_dir": str(tmp_path / "rec"), "resume": False})
+    rec = Recorder(cfg)
+    rec.update_loss_stats({"loss": 0.5, "psnr": 20.0})
+    rec.step = 10
+    line = rec.console_line(epoch=1, it=5, max_iter=25, lr=5e-4)
+    for token in ("eta:", "epoch: 1", "step: 10", "loss:", "psnr:", "lr: 0.000500"):
+        assert token in line

@@ -1,0 +1,39 @@
+"""Parallelism & distributed communication (SURVEY.md §2.3).
+
+The explicit architectural seat of the capabilities the reference gets from
+`torch.distributed` + NCCL + DDP (reference train.py:116-120,
+trainer.py:17-22): mesh construction over ICI/DCN, named-axis collectives,
+sharding rules, and sharded train steps (shard_map DP, GSPMD dp×tp).
+"""
+
+from .collectives import (  # noqa: F401
+    all_gather,
+    axis_index,
+    barrier,
+    broadcast_from_chief,
+    device_count,
+    pmean,
+    ppermute,
+    process_count,
+    psum,
+    tree_pmean,
+)
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    is_chief,
+    make_mesh,
+    make_mesh_from_cfg,
+    multihost_init,
+)
+from .sharding import (  # noqa: F401
+    data_sharding,
+    shard_bank,
+    tree_shardings,
+    tree_specs,
+)
+from .step import (  # noqa: F401
+    build_dp_step,
+    build_gspmd_step,
+    shard_train_state,
+)

@@ -1,0 +1,71 @@
+"""Named-axis collective wrappers + host-level synchronization.
+
+The reference's collective layer is NCCL behind DDP plus an explicit barrier
+helper (reference train.py:100-112); JAX has no user-visible backend object,
+but the framework still exposes the capability surface here (SURVEY.md §2.3):
+in-graph collectives over the mesh axes for code running under
+``shard_map``, and host-level barrier/broadcast for the processes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import DATA_AXIS
+
+
+def psum(x, axis: str = DATA_AXIS):
+    """All-reduce sum over a mesh axis (≙ NCCL all_reduce inside DDP
+    backward, reference trainer.py:59-62)."""
+    return jax.lax.psum(x, axis)
+
+
+def pmean(x, axis: str = DATA_AXIS):
+    """All-reduce mean — the gradient reduction DDP performs implicitly."""
+    return jax.lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str = DATA_AXIS, tiled: bool = False):
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def ppermute(x, perm, axis: str = DATA_AXIS):
+    """Ring shift — the building block for ring-style sequence parallelism."""
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str = DATA_AXIS):
+    return jax.lax.axis_index(axis)
+
+
+def barrier(name: str = "barrier") -> None:
+    """Host-level barrier across processes (parity: `synchronize()`,
+    reference train.py:100-112). No-op single-process."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_chief(x):
+    """Broadcast host data from process 0 to all processes."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(x)
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def tree_pmean(tree, axis: str = DATA_AXIS):
+    """pmean over every leaf of a pytree (gradients, metrics)."""
+    return jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis), tree)

@@ -1,0 +1,110 @@
+"""Device-mesh construction and multi-host process topology.
+
+This module is the explicit architectural seat of the reference's distributed
+backend (SURVEY.md §2.3): where the reference calls
+`torch.distributed.init_process_group("nccl", env://)` (reference
+train.py:116-120) and shards work by `RANK`, the TPU-native design builds one
+`jax.sharding.Mesh` over the chips and lets XLA place the collectives on
+ICI/DCN. Axis names:
+
+* ``"data"`` — data parallelism over the ray batch (the reference's only
+  parallelism: DDP gradient all-reduce ≙ `psum` over this axis).
+* ``"model"`` — tensor parallelism over MLP hidden width (no referent in the
+  reference; a TPU-native capability extension used when ``model_axis > 1``).
+
+Mesh axes map to the physical topology by `mesh_utils.create_device_mesh`,
+which orders axes so the innermost ("model", most communication-hungry) rides
+ICI neighbours first — the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+_multihost_initialized = False
+
+
+def multihost_init(cfg=None) -> None:
+    """Initialize the multi-host JAX runtime (parity: the NCCL process-group
+    init, reference train.py:116-120).
+
+    Must be called before any other JAX API touches the backend (the same
+    contract as `jax.distributed.initialize` itself). Gated on a coordinator
+    env var, mirroring the reference's `args.launcher == "pytorch"` gate
+    (train.py:116); real initialization failures propagate rather than being
+    swallowed, so a multi-host job can never silently degrade into N
+    disconnected single-host runs.
+    """
+    global _multihost_initialized
+    import os
+
+    if _multihost_initialized:
+        return
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    ):
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:
+            if "already" not in str(e).lower():
+                raise
+    _multihost_initialized = True
+
+
+def is_chief() -> bool:
+    """Rank-0 guard (parity: `local_rank == 0` checks, reference
+    trainer.py:64-65, recorder.py:51)."""
+    return jax.process_index() == 0
+
+
+def make_mesh(
+    data_axis: int = -1,
+    model_axis: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh.
+
+    ``data_axis == -1`` means "all remaining devices" (the common case:
+    pure DP over every chip). ``model_axis`` > 1 carves tensor-parallel
+    groups out of the device set first.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if model_axis < 1 or n % model_axis != 0:
+        raise ValueError(
+            f"model_axis={model_axis} does not divide device count {n}"
+        )
+    data = n // model_axis if data_axis == -1 else data_axis
+    if data * model_axis != n:
+        # allow a sub-mesh (fewer devices than available)
+        devices = devices[: data * model_axis]
+        if len(devices) != data * model_axis:
+            raise ValueError(
+                f"mesh {data}x{model_axis} needs {data * model_axis} devices, "
+                f"have {n}"
+            )
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            (data, model_axis), devices=devices
+        )
+    except (ValueError, AssertionError):
+        # non-toroidal device sets (CPU emulation, sub-meshes): plain reshape
+        dev_array = np.asarray(devices).reshape(data, model_axis)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def make_mesh_from_cfg(cfg) -> Mesh:
+    par = cfg.get("parallel", None)
+    if par is None:
+        return make_mesh()
+    return make_mesh(
+        data_axis=int(par.get("data_axis", -1)),
+        model_axis=int(par.get("model_axis", 1)),
+    )

@@ -1,0 +1,84 @@
+"""Parameter/array partition rules over the ``(data, model)`` mesh.
+
+The reference has no tensor parallelism to mirror (SURVEY.md §2.3: data
+parallel only) — these rules are the TPU-native capability extension: MLP
+hidden width is column-sharded over the ``model`` axis (kernels
+``P(None, "model")``, biases ``P("model")``), output heads and scalar state
+replicated, and GSPMD propagates/inserts the collectives. Rules are keyed on
+parameter *path names*, so they apply uniformly to params and to optimizer
+moments (adam ``mu``/``nu`` carry the same sub-paths).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+# path-regex → (kernel spec, bias spec); first match wins.
+_TP_RULES: list[tuple[str, tuple[P, P]]] = [
+    # output heads stay replicated: tiny, and compositing wants full vectors
+    (r"(alpha_linear|rgb_linear|output_linear)", (P(), P())),
+    # trunk / feature / view branches: column-parallel over hidden width
+    (r"(pts_linear_\d+|feature_linear|views_linear_\d+)", (P(None, MODEL_AXIS), P(MODEL_AXIS))),
+    # hash/grid embedding tables: shard the (large) entries dim over model
+    (r"(embeddings|table)", (P(MODEL_AXIS), P(MODEL_AXIS))),
+]
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+        for p in path
+    )
+
+
+def spec_for_path(path, leaf) -> P:
+    """PartitionSpec for one pytree leaf, keyed on its path."""
+    s = _path_str(path)
+    ndim = getattr(leaf, "ndim", 0)
+    if ndim == 0:
+        return P()
+    for pattern, (kernel_spec, bias_spec) in _TP_RULES:
+        if re.search(pattern, s):
+            spec = kernel_spec if ndim >= 2 else bias_spec
+            # trim spec to rank
+            return P(*tuple(spec)[:ndim]) if len(tuple(spec)) > ndim else spec
+    return P()
+
+
+def tree_specs(tree):
+    """PartitionSpec pytree matching ``tree`` (params, TrainState, …)."""
+    return jax.tree_util.tree_map_with_path(spec_for_path, tree)
+
+
+def tree_shardings(tree, mesh):
+    """NamedSharding pytree for ``tree`` over ``mesh``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, spec_for_path(path, leaf)), tree
+    )
+
+
+def data_sharding(mesh) -> NamedSharding:
+    """Batch/bank sharding: leading dim over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_bank(bank_rays, bank_rgbs, mesh):
+    """Place the ray bank sharded over the data axis (each chip holds
+    1/n of the rays — memory scaling the reference's full-bank-per-GPU
+    precompute lacks, blender.py:105-108). Truncates to a divisible size."""
+    n_data = mesh.shape[DATA_AXIS]
+    n = (bank_rays.shape[0] // n_data) * n_data
+    sh = data_sharding(mesh)
+    return (
+        jax.device_put(bank_rays[:n], sh),
+        jax.device_put(bank_rgbs[:n], sh),
+    )

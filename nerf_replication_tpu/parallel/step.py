@@ -1,0 +1,114 @@
+"""Sharded train-step builders over the device mesh.
+
+Two idioms, both producing a single compiled step that never touches the host
+(SURVEY.md §7 "DDP/NCCL → mesh + shard_map/pjit"):
+
+* :func:`build_dp_step` — ``shard_map`` data parallelism with *explicit*
+  collectives: each device samples its own ray batch from its local bank
+  shard (disjoint RNG via the mesh axis index), computes grads, and
+  ``pmean``s them over the ``data`` axis — the exact seat of the reference's
+  DDP all-reduce (reference trainer.py:59-62) as an in-graph collective.
+* :func:`build_gspmd_step` — ``jit`` + ``NamedSharding`` constraints (GSPMD):
+  one global batch sharded over ``data``, params column-sharded over
+  ``model`` (TP), and XLA inserts the collectives. This is the dp×tp path
+  `dryrun_multichip` exercises.
+
+Both builders emit the SAME traced program on every controller process
+(multi-controller SPMD requires it): per-shard RNG decorrelation comes from
+`lax.axis_index` inside the graph, never from host-side `process_index`.
+The step semantics live in train/step_core.py, shared with the single-chip
+trainer.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..datasets.sampling import sample_rays, sample_step_key
+from ..train.step_core import sampled_grad_step
+from .collectives import tree_pmean
+from .mesh import DATA_AXIS
+from .sharding import data_sharding, tree_shardings
+
+
+def build_dp_step(
+    mesh: Mesh,
+    loss,
+    n_rays_global: int,
+    near: float,
+    far: float,
+):
+    """shard_map DP step: ``(state, bank_rays, bank_rgbs, base_key) ->
+    (state, stats)`` with the bank sharded over the data axis."""
+    n_data = mesh.shape[DATA_AXIS]
+    n_local = max(1, n_rays_global // n_data)
+
+    def body(state, bank_rays, bank_rgbs, base_key):
+        # disjoint stream per (step, device-shard) — axis_index is global
+        # across processes, so this is multi-controller-safe
+        key = sample_step_key(base_key, state.step)
+        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+        k_sample, k_render = jax.random.split(key)
+        grads, stats = sampled_grad_step(
+            loss, state.params, bank_rays, bank_rgbs, n_local, near, far,
+            k_sample, k_render,
+        )
+        grads = tree_pmean(grads, DATA_AXIS)
+        stats = tree_pmean(stats, DATA_AXIS)
+        new_state = state.apply_gradients(grads=grads)
+        return new_state, stats
+
+    smap = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(smap, donate_argnums=(0,))
+
+
+def build_gspmd_step(
+    mesh: Mesh,
+    loss,
+    n_rays: int,
+    near: float,
+    far: float,
+):
+    """GSPMD dp×tp step: sharding constraints on the batch (data axis) and on
+    params (model axis, via sharding rules); XLA derives the collectives."""
+    batch_sh = data_sharding(mesh)
+
+    def step(state, bank_rays, bank_rgbs, base_key):
+        key = sample_step_key(base_key, state.step)
+        k_sample, k_render = jax.random.split(key)
+
+        # one global batch, sharded over the data axis
+        rays, rgbs = sample_rays(k_sample, bank_rays, bank_rgbs, n_rays)
+        rays = jax.lax.with_sharding_constraint(rays, batch_sh)
+        rgbs = jax.lax.with_sharding_constraint(rgbs, batch_sh)
+
+        def loss_fn(p):
+            _, l, stats = loss(
+                {"params": p},
+                {"rays": rays, "rgbs": rgbs, "near": near, "far": far},
+                key=k_render,
+                train=True,
+            )
+            return l, stats
+
+        (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        new_state = state.apply_gradients(grads=grads)
+        return new_state, stats
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def shard_train_state(state, mesh: Mesh):
+    """Place a TrainState on the mesh per the partition rules (params and
+    optimizer moments column-sharded over ``model``; scalars replicated)."""
+    return jax.device_put(state, tree_shardings(state, mesh))

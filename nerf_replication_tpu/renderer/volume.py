@@ -250,6 +250,9 @@ class Renderer:
         self.network = network
         self.train_options = RenderOptions.from_cfg(cfg, train=True)
         self.eval_options = RenderOptions.from_cfg(cfg, train=False)
+        # jitted chunked-render executables, keyed by (n_chunks, chunk) so
+        # repeated validation images reuse one compilation
+        self._chunked_fns: dict = {}
 
     def _apply_fn(self, params):
         return lambda pts, viewdirs, model: self.network.apply(
@@ -271,7 +274,8 @@ class Renderer:
     def render_chunked(self, params, batch: dict, key=None) -> dict:
         """Full-image eval: `lax.map` over fixed-size chunks with padding —
         the XLA idiom for the reference's python chunk loop
-        (volume_renderer.py:160)."""
+        (volume_renderer.py:160). The jitted executable is cached per
+        (n_chunks, chunk) shape, so validation doesn't re-trace per image."""
         rays = batch["rays"]
         n = rays.shape[0]
         chunk = min(self.eval_options.chunk_size, n)
@@ -279,18 +283,31 @@ class Renderer:
         pad = n_chunks * chunk - n
         rays_p = jnp.pad(rays, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 6)
 
-        apply_fn = self._apply_fn(params)
-        options = self.eval_options
-        near, far = batch["near"], batch["far"]
+        fn = self._chunked_fns.get((n_chunks, chunk))
+        if fn is None:
+            options = self.eval_options
+            network = self.network
 
-        def body(idx_and_rays):
-            idx, rays_chunk = idx_and_rays
-            # distinct stream per chunk, else every chunk repeats the same
-            # jitter/noise draws and the image shows chunk-periodic stripes
-            chunk_key = None if key is None else jax.random.fold_in(key, idx)
-            return render_rays(apply_fn, rays_chunk, near, far, chunk_key, options)
+            @jax.jit
+            def fn(params, rays_p, near, far, key):
+                apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+                    params, pts, vd, model=model
+                )
 
-        out = jax.lax.map(body, (jnp.arange(n_chunks), rays_p))
+                def body(idx_and_rays):
+                    idx, rays_chunk = idx_and_rays
+                    # distinct stream per chunk, else every chunk repeats the
+                    # same jitter/noise draws → chunk-periodic stripes
+                    ck = None if key is None else jax.random.fold_in(key, idx)
+                    return render_rays(
+                        apply_fn, rays_chunk, near, far, ck, options
+                    )
+
+                return jax.lax.map(body, (jnp.arange(n_chunks), rays_p))
+
+            self._chunked_fns[(n_chunks, chunk)] = fn
+
+        out = fn(params, rays_p, batch["near"], batch["far"], key)
         return {
             k: v.reshape((n_chunks * chunk,) + v.shape[2:])[:n]
             for k, v in out.items()

@@ -1,0 +1,44 @@
+"""The shared train-step core: sample a ray batch from the device-resident
+bank, render it through the loss module, and return gradients + stats.
+
+Single-chip (train/trainer.py), shard_map DP, and GSPMD dp×tp steps
+(parallel/step.py) all wrap this one function — parallelism only changes
+where the RNG key is decorrelated and which collectives/constraints surround
+the call, never the step semantics (reference contract: trainer.py:55-62).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..datasets.sampling import sample_rays
+
+
+def sampled_grad_step(
+    loss,
+    params,
+    bank_rays,
+    bank_rgbs,
+    n_rays: int,
+    near: float,
+    far: float,
+    k_sample,
+    k_render,
+    index_pool=None,
+):
+    """Draw ``n_rays`` from the bank and compute (grads, stats) of the loss."""
+    rays, rgbs = sample_rays(
+        k_sample, bank_rays, bank_rgbs, n_rays, index_pool=index_pool
+    )
+
+    def loss_fn(p):
+        _, l, stats = loss(
+            {"params": p},
+            {"rays": rays, "rgbs": rgbs, "near": near, "far": far},
+            key=k_render,
+            train=True,
+        )
+        return l, stats
+
+    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    return grads, stats

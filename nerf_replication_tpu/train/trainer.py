@@ -27,9 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 from flax.training.train_state import TrainState
 
-from ..datasets.sampling import sample_rays, sample_step_key
+from ..datasets.sampling import sample_step_key
 from ..models.nerf.network import init_params
 from .checkpoint import load_model, load_pretrain, save_model, save_trained_config
+from .step_core import sampled_grad_step
 from .optim import make_optimizer
 from .recorder import Recorder
 
@@ -67,28 +68,19 @@ class Trainer:
         return max(1, bank_size // self.n_rays)
 
     # -- jitted step construction ------------------------------------------
-    def _loss_for_grad(self, params, rays, rgbs, key):
-        batch = {"rays": rays, "rgbs": rgbs, "near": self.near, "far": self.far}
-        _, loss, stats = self.loss(
-            {"params": params}, batch, key=key, train=True
-        )
-        return loss, stats
-
     def _build_step(self, with_pool: bool):
         n_rays = self.n_rays
         process_index = self.process_index
+        near, far, loss = self.near, self.far, self.loss
 
         @jax.jit
         def step_fn(state, bank_rays, bank_rgbs, base_key, *pool):
             key = sample_step_key(base_key, state.step, process_index)
             k_sample, k_render = jax.random.split(key)
-            rays, rgbs = sample_rays(
-                k_sample, bank_rays, bank_rgbs, n_rays,
-                index_pool=pool[0] if pool else None,
+            grads, stats = sampled_grad_step(
+                loss, state.params, bank_rays, bank_rgbs, n_rays, near, far,
+                k_sample, k_render, index_pool=pool[0] if pool else None,
             )
-            (loss, stats), grads = jax.value_and_grad(
-                self._loss_for_grad, has_aux=True
-            )(state.params, rays, rgbs, k_render)
             new_state = state.apply_gradients(grads=grads)
             return new_state, stats
 
@@ -249,7 +241,9 @@ def fit(cfg, network=None, log=print):
         if chief and (epoch + 1) % save_latest_ep == 0:
             save_model(cfg.trained_model_dir, state, epoch,
                        recorder.state_dict(), latest=True)
-        if (epoch + 1) % eval_ep == 0 and evaluator is not None:
+        # chief-only: validation renders/writes artifacts on one process
+        # (the reference runs val on rank 0 only, train.py:84-85)
+        if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
             trainer.val(state, epoch, test_ds, recorder, log=log)
     return state
 

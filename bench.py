@@ -1,0 +1,91 @@
+"""Headline benchmark: training ray throughput on the flagship lego model.
+
+Runs the full jitted train step (on-device ray sampling → coarse+fine NeRF
+render at 64+128 samples/ray, the reference's per-ray work — configs/nerf/
+lego.yaml:20-23 ≙ reference lego.yaml — → MSE → grads → clip(40) → adam) on
+one chip and reports rays/second.
+
+Baseline: the reference trains 1024 rays/iter at a measured mean 0.222 s/iter
+(/root/reference/log.txt; BASELINE.md) ≈ 4612 rays/s on its CUDA GPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_RAYS_PER_SEC = 1024 / 0.222  # reference log.txt mean iter time
+
+
+def main():
+    from nerf_replication_tpu.config import make_cfg
+    from nerf_replication_tpu.models.nerf.network import make_network
+    from nerf_replication_tpu.train.loss import make_loss
+    from nerf_replication_tpu.train.trainer import Trainer, make_train_state
+
+    n_rays = int(os.environ.get("BENCH_N_RAYS", 4096))
+    n_steps = int(os.environ.get("BENCH_STEPS", 50))
+
+    cfg = make_cfg(
+        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        [
+            "task_arg.N_rays", str(n_rays),
+            "task_arg.precrop_iters", "0",
+            # TPU-native precision: bf16 MXU matmuls, f32 params/heads/compositing
+            "precision.compute_dtype", "bfloat16",
+        ],
+    )
+    network = make_network(cfg)
+    loss = make_loss(cfg, network)
+    trainer = Trainer(cfg, network, loss)
+
+    key = jax.random.PRNGKey(0)
+    k_init, k_bank, base_key = jax.random.split(key, 3)
+    state, _ = make_train_state(cfg, network, k_init)
+
+    # synthetic ray bank: throughput is content-independent, so the bench
+    # needs no dataset download (rays point at the scene volume).
+    n_bank = 1 << 20
+    k1, k2, k3 = jax.random.split(k_bank, 3)
+    origins = jax.random.normal(k1, (n_bank, 3)) * 0.5 + jnp.asarray(
+        [0.0, 0.0, -4.0]
+    )
+    dirs = jax.random.normal(k2, (n_bank, 3))
+    dirs = dirs / jnp.linalg.norm(dirs, axis=-1, keepdims=True)
+    bank_rays = jnp.concatenate([origins, dirs], axis=-1).astype(jnp.float32)
+    bank_rgbs = jax.random.uniform(k3, (n_bank, 3), jnp.float32)
+
+    # warmup: compile + 3 steps
+    state, stats = trainer.step(state, bank_rays, bank_rgbs, base_key)
+    for _ in range(3):
+        state, stats = trainer.step(state, bank_rays, bank_rgbs, base_key)
+    jax.block_until_ready(stats)
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, stats = trainer.step(state, bank_rays, bank_rgbs, base_key)
+    jax.block_until_ready(stats)
+    dt = time.perf_counter() - t0
+
+    rays_per_sec = n_rays * n_steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "train_rays_per_sec",
+                "value": round(rays_per_sec, 1),
+                "unit": "rays/s",
+                "vs_baseline": round(rays_per_sec / BASELINE_RAYS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -36,20 +36,25 @@ def multihost_init(cfg=None) -> None:
     init, reference train.py:116-120).
 
     Must be called before any other JAX API touches the backend (the same
-    contract as `jax.distributed.initialize` itself). Gated on a coordinator
-    env var, mirroring the reference's `args.launcher == "pytorch"` gate
-    (train.py:116); real initialization failures propagate rather than being
-    swallowed, so a multi-host job can never silently degrade into N
-    disconnected single-host runs.
+    contract as `jax.distributed.initialize` itself). Runs when the config
+    opts in (``parallel.multihost: true`` — the analogue of the reference's
+    ``args.launcher == "pytorch"`` gate, train.py:116) or when a coordinator
+    address is present in the environment; `initialize()` itself auto-detects
+    the coordinator from TPU pod metadata. Real initialization failures
+    propagate rather than being swallowed, so a multi-host job can never
+    silently degrade into N disconnected single-host runs.
     """
     global _multihost_initialized
     import os
 
     if _multihost_initialized:
         return
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
-        "COORDINATOR_ADDRESS"
-    ):
+    want = bool(cfg is not None and cfg.get("parallel", {}).get("multihost", False))
+    want = want or bool(
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+    )
+    if want:
         try:
             jax.distributed.initialize()
         except RuntimeError as e:
@@ -81,6 +86,8 @@ def make_mesh(
         raise ValueError(
             f"model_axis={model_axis} does not divide device count {n}"
         )
+    if data_axis != -1 and data_axis < 1:
+        raise ValueError(f"data_axis must be -1 or >= 1, got {data_axis}")
     data = n // model_axis if data_axis == -1 else data_axis
     if data * model_axis != n:
         # allow a sub-mesh (fewer devices than available)

@@ -67,10 +67,6 @@ def data_sharding(mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def replicated(mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
-
-
 def shard_bank(bank_rays, bank_rgbs, mesh):
     """Place the ray bank sharded over the data axis (each chip holds
     1/n of the rays — memory scaling the reference's full-bank-per-GPU

@@ -16,14 +16,7 @@ from collections import defaultdict, deque
 
 import numpy as np
 
-
-def _is_chief() -> bool:
-    try:
-        import jax
-
-        return jax.process_index() == 0
-    except Exception:
-        return True
+from ..parallel.mesh import is_chief as _is_chief
 
 
 class SmoothedValue:

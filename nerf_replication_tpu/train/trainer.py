@@ -183,8 +183,13 @@ def fit(cfg, network=None, log=print):
     cfg, resume if available, run the epoch loop with save/eval cadence."""
     from ..datasets import make_dataset
     from ..evaluators import make_evaluator
+    from ..parallel.mesh import is_chief, multihost_init
     from ..registry import load_attr
     from .recorder import make_recorder
+
+    # multi-host runtime first (parity: NCCL process-group init,
+    # reference train.py:116-120)
+    multihost_init(cfg)
 
     if network is None:
         from ..models import make_network
@@ -212,7 +217,7 @@ def fit(cfg, network=None, log=print):
         if ok:
             state = state.replace(params=params["params"])
 
-    if jax.process_index() == 0:
+    if is_chief():
         save_trained_config(cfg)
 
     train_ds = make_dataset(cfg, "train")
@@ -234,7 +239,7 @@ def fit(cfg, network=None, log=print):
             state, epoch, bank, base_key, recorder, schedule, index_pool=pool,
             log=log,
         )
-        chief = jax.process_index() == 0
+        chief = is_chief()
         if chief and (epoch + 1) % save_ep == 0:
             save_model(cfg.trained_model_dir, state, epoch,
                        recorder.state_dict(), latest=False)

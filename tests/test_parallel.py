@@ -142,16 +142,50 @@ def test_gspmd_dp_tp_step_compiles_and_descends(scene_root):
     assert losses[-1] < losses[0]
 
 
-def test_dp_equals_more_devices_semantics(scene_root):
-    """DP loss is a mean over per-shard batches — stats must be finite and
-    deterministic for a fixed key."""
+def test_dp_step_matches_host_emulation(scene_root):
+    """DP semantics: the shard_map step must equal a host-side emulation of
+    the same program — per-shard ray draw from the local bank slice (RNG
+    folded over the shard's data-axis index), per-shard grads, pmean across
+    shards, one optimizer update. Catches a dropped grad all-reduce or a
+    mis-scaled per-shard loss."""
+    from nerf_replication_tpu.datasets.sampling import sample_step_key
+    from nerf_replication_tpu.train.step_core import sampled_grad_step
+
     cfg, net, loss, state, ds = _setup(scene_root)
     mesh = make_mesh()
-    step = build_dp_step(mesh, loss, n_rays_global=128, near=2.0, far=6.0)
+    n_shards = mesh.shape[DATA_AXIS]
+    n_rays_global = 16 * n_shards
+    step = build_dp_step(mesh, loss, n_rays_global=n_rays_global, near=2.0, far=6.0)
     bank = shard_bank(*ds.ray_bank(), mesh)
     key = jax.random.PRNGKey(7)
-    _, s1 = step(state, bank[0], bank[1], key)
-    cfg2, net2, loss2, state2, _ = _setup(scene_root)
-    step2 = build_dp_step(mesh, loss2, n_rays_global=128, near=2.0, far=6.0)
-    _, s2 = step2(state2, bank[0], bank[1], key)
-    assert float(s1["loss"]) == pytest.approx(float(s2["loss"]), rel=1e-6)
+
+    # host emulation on replicated arrays (single-device math, no mesh)
+    rays_h = np.asarray(bank[0])
+    rgbs_h = np.asarray(bank[1])
+    n_local_bank = rays_h.shape[0] // n_shards
+    grads_acc, losses = None, []
+    for i in range(n_shards):
+        k = jax.random.fold_in(sample_step_key(key, state.step), i)
+        k_sample, k_render = jax.random.split(k)
+        sl = slice(i * n_local_bank, (i + 1) * n_local_bank)
+        grads, stats = sampled_grad_step(
+            loss, state.params, jnp.asarray(rays_h[sl]), jnp.asarray(rgbs_h[sl]),
+            16, 2.0, 6.0, k_sample, k_render,
+        )
+        losses.append(float(stats["loss"]))
+        grads_acc = grads if grads_acc is None else jax.tree.map(
+            lambda a, b: a + b, grads_acc, grads
+        )
+    grads_mean = jax.tree.map(lambda g: g / n_shards, grads_acc)
+    expected_state = state.apply_gradients(grads=grads_mean)
+    expected_loss = float(np.mean(losses))
+
+    new_state, s = step(state, bank[0], bank[1], key)
+    assert float(s["loss"]) == pytest.approx(expected_loss, rel=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+        ),
+        new_state.params,
+        expected_state.params,
+    )

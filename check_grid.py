@@ -1,0 +1,56 @@
+"""Sanity-check a baked occupancy grid.
+
+Parity with the reference's `check_grid.py:15-79`: assert dtype/shape, print
+the occupancy percentage, optionally scatter-plot the occupied voxels in 3-D.
+
+    python check_grid.py --cfg_file configs/nerf/lego.yaml [--visualize]
+"""
+
+from __future__ import annotations
+
+
+def main():
+    from nerf_replication_tpu.config import make_parser
+    from nerf_replication_tpu.renderer.occupancy import (
+        default_grid_path,
+        load_occupancy_grid,
+        occupancy_stats,
+    )
+
+    parser = make_parser()
+    parser.add_argument("--visualize", action="store_true", default=False)
+    args = parser.parse_args()
+
+    path = default_grid_path(args.cfg_file)
+    grid, bbox = load_occupancy_grid(path)
+    stats = occupancy_stats(grid)
+    print(f"grid: {path}")
+    print(f"shape: {stats['shape']}  dtype: {grid.dtype}")
+    print(
+        f"occupied: {stats['occupied']}/{stats['total']} "
+        f"({stats['occupancy_pct']:.2f}%)"
+    )
+    print(f"bbox: {bbox.tolist()}")
+
+    if args.visualize:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        import numpy as np
+
+        xs, ys, zs = np.nonzero(grid)
+        # subsample for plot responsiveness
+        if xs.size > 20000:
+            sel = np.random.default_rng(0).choice(xs.size, 20000, replace=False)
+            xs, ys, zs = xs[sel], ys[sel], zs[sel]
+        fig = plt.figure(figsize=(8, 8))
+        ax = fig.add_subplot(111, projection="3d")
+        ax.scatter(xs, ys, zs, s=0.5)
+        out = path.replace(".npz", "_vis.png")
+        fig.savefig(out, dpi=120)
+        print(f"visualization saved to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Occupancy-grid baking, I/O, and lookup.
+
+Capability parity with the reference's grid subsystem (occupancy_grid.py:15-82,
+volume_renderer.py:249-265): sample an R³ voxel grid of the scene bbox at
+2×2×2 sub-positions per voxel, query the coarse network's density, and mark a
+voxel occupied when ANY sub-sample's σ exceeds the threshold.
+
+TPU-native differences: the density sweep is a single jitted `lax.map` over
+fixed-size voxel batches (no host↔device loop over 4096-point batches like
+occupancy_grid.py:48-61), and the artifact is a compressed .npz carrying the
+grid together with its bbox/threshold provenance.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SUBSAMPLES = (2, 2, 2)  # occupancy_grid.py:28
+
+
+def voxel_sample_points(bbox: np.ndarray, resolution: int) -> np.ndarray:
+    """[R³, n_sub, 3] world-space sample positions: each voxel's base corner
+    plus a sub-grid spanning the voxel (occupancy_grid.py:30-41)."""
+    lo, hi = np.asarray(bbox[0], np.float32), np.asarray(bbox[1], np.float32)
+    voxel_size = (hi - lo) / resolution
+    axes = [np.linspace(0.0, 1.0, s) * voxel_size[d] for d, s in enumerate(SUBSAMPLES)]
+    sub = np.stack(np.meshgrid(*axes, indexing="ij"), -1).reshape(-1, 3)
+
+    ranges = [np.arange(resolution)] * 3
+    grid_idx = np.stack(np.meshgrid(*ranges, indexing="ij"), -1).astype(np.float32)
+    base = lo + grid_idx * voxel_size  # [R,R,R,3]
+    pts = base.reshape(-1, 1, 3) + sub[None, :, :]
+    return pts.astype(np.float32)
+
+
+def bake_occupancy_grid(params, network, cfg) -> np.ndarray:
+    """bool [R,R,R]: any sub-sample density over the threshold
+    (occupancy_grid.py:65-70). Densities come from the COARSE network with
+    zero viewdirs, as in the reference (occupancy_grid.py:57-59)."""
+    ta = cfg.task_arg
+    resolution = int(ta.occupancy_grid_res)
+    threshold = float(ta.occupancy_grid_threshold)
+    batch = int(ta.get("occupancy_grid_batch_size", 4096))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+
+    pts = voxel_sample_points(bbox, resolution)  # [V, n_sub, 3]
+    n_voxels, n_sub = pts.shape[0], pts.shape[1]
+    n_batches = -(-n_voxels // batch)
+    pad = n_batches * batch - n_voxels
+    pts_p = np.pad(pts, ((0, pad), (0, 0), (0, 0))).reshape(
+        n_batches, batch, n_sub, 3
+    )
+
+    @jax.jit
+    def sweep(params, pts_p):
+        def body(p):
+            dirs = jnp.zeros((p.shape[0], 3), jnp.float32)
+            raw = network.apply(params, p, dirs, model="coarse")
+            return jnp.any(jax.nn.relu(raw[..., 3]) > threshold, axis=-1)
+
+        return jax.lax.map(body, pts_p)
+
+    occupied = np.asarray(sweep(params, jnp.asarray(pts_p)))
+    occupied = occupied.reshape(-1)[:n_voxels]
+    return occupied.reshape(resolution, resolution, resolution)
+
+
+def default_grid_path(cfg_file: str) -> str:
+    """logs/<config_name>/occupancy_grid.npz — the reference's artifact layout
+    (occupancy_grid.py:72-75), with .npz instead of .pt."""
+    name = os.path.splitext(os.path.basename(cfg_file))[0]
+    return os.path.join("logs", name, "occupancy_grid.npz")
+
+
+def save_occupancy_grid(path: str, grid: np.ndarray, bbox, threshold: float) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez_compressed(
+        path,
+        grid=np.asarray(grid, bool),
+        bbox=np.asarray(bbox, np.float32),
+        threshold=np.float32(threshold),
+    )
+    return path
+
+
+def load_occupancy_grid(path: str):
+    """(grid bool [R,R,R], bbox [2,3]) or raises FileNotFoundError."""
+    with np.load(path) as z:
+        return np.asarray(z["grid"], bool), np.asarray(z["bbox"], np.float32)
+
+
+def occupancy_stats(grid: np.ndarray) -> dict:
+    """Sanity-check stats (parity: check_grid.py:20-31)."""
+    assert grid.dtype == np.bool_, f"grid dtype must be bool, got {grid.dtype}"
+    assert grid.ndim == 3, f"grid must be 3-D, got shape {grid.shape}"
+    total = grid.size
+    occupied = int(grid.sum())
+    return {
+        "shape": tuple(grid.shape),
+        "occupied": occupied,
+        "total": total,
+        "occupancy_pct": 100.0 * occupied / total,
+    }
+
+
+def world_to_voxel(pts: jax.Array, bbox: jax.Array, resolution: int) -> jax.Array:
+    """World points → integer voxel indices, clamped into the grid (the
+    reference clamps to the bbox before indexing, volume_renderer.py:261-265,
+    so out-of-bounds points land in boundary voxels)."""
+    lo, hi = bbox[0], bbox[1]
+    normalized = (jnp.clip(pts, lo, hi) - lo) / (hi - lo)
+    return jnp.clip(
+        (normalized * (resolution - 1)).astype(jnp.int32), 0, resolution - 1
+    )

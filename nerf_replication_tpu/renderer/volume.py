@@ -238,6 +238,28 @@ def render_rays(
     return out
 
 
+def _pad_to_chunks(rays: jax.Array, chunk_size: int):
+    """[N, 6] → ([n_chunks, chunk, 6], n, n_chunks, chunk) with zero-padding."""
+    n = rays.shape[0]
+    chunk = min(chunk_size, n)
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    return (
+        jnp.pad(rays, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 6),
+        n,
+        n_chunks,
+        chunk,
+    )
+
+
+def _unpad_outputs(out: dict, n: int) -> dict:
+    """Flatten chunked outputs back to [N, ...] (non-ray scalars pass through)."""
+    return {
+        k: v.reshape((-1,) + v.shape[2:])[:n] if v.ndim >= 2 else v
+        for k, v in out.items()
+    }
+
+
 class Renderer:
     """Config-bound renderer (parity: reference `Renderer` +
     `make_renderer(cfg, network)`, make_renderer.py:4-8).
@@ -253,6 +275,13 @@ class Renderer:
         # jitted chunked-render executables, keyed by (n_chunks, chunk) so
         # repeated validation images reuse one compilation
         self._chunked_fns: dict = {}
+        # occupancy-accelerated state (reference volume_renderer.py:249-259)
+        from .accelerated import MarchOptions
+
+        self.march_options = MarchOptions.from_cfg(cfg)
+        self.occupancy_grid = None
+        self.grid_bbox = None
+        self._march_fns: dict = {}
 
     def _apply_fn(self, params):
         return lambda pts, viewdirs, model: self.network.apply(
@@ -276,12 +305,9 @@ class Renderer:
         the XLA idiom for the reference's python chunk loop
         (volume_renderer.py:160). The jitted executable is cached per
         (n_chunks, chunk) shape, so validation doesn't re-trace per image."""
-        rays = batch["rays"]
-        n = rays.shape[0]
-        chunk = min(self.eval_options.chunk_size, n)
-        n_chunks = -(-n // chunk)
-        pad = n_chunks * chunk - n
-        rays_p = jnp.pad(rays, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 6)
+        rays_p, n, n_chunks, chunk = _pad_to_chunks(
+            batch["rays"], self.eval_options.chunk_size
+        )
 
         fn = self._chunked_fns.get((n_chunks, chunk))
         if fn is None:
@@ -308,10 +334,66 @@ class Renderer:
             self._chunked_fns[(n_chunks, chunk)] = fn
 
         out = fn(params, rays_p, batch["near"], batch["far"], key)
-        return {
-            k: v.reshape((n_chunks * chunk,) + v.shape[2:])[:n]
-            for k, v in out.items()
-        }
+        return _unpad_outputs(out, n)
+
+    # -- occupancy-accelerated path (ESS + ERT) -----------------------------
+    def load_occupancy_grid(self, grid_path: str) -> bool:
+        """Load a baked grid; missing file → slow-mode fallback, matching the
+        reference (volume_renderer.py:249-259). Returns True when loaded."""
+        import os
+
+        from .occupancy import load_occupancy_grid
+
+        if not os.path.exists(grid_path):
+            print(f"Occupancy grid file not found: {grid_path}, run in slow mode.")
+            return False
+        grid, bbox = load_occupancy_grid(grid_path)
+        self.occupancy_grid = jnp.asarray(grid)
+        self.grid_bbox = jnp.asarray(bbox)
+        return True
+
+    def render_accelerated(self, params, batch: dict) -> dict:
+        """Full-image ESS+ERT render; falls back to the vanilla chunked path
+        when no grid is loaded (volume_renderer.py:269-271)."""
+        if self.occupancy_grid is None:
+            return self.render_chunked(params, batch)
+
+        from .accelerated import march_rays_accelerated
+
+        near, far = float(batch["near"]), float(batch["far"])
+        rays_p, n, n_chunks, chunk = _pad_to_chunks(
+            batch["rays"], self.march_options.chunk_size
+        )
+
+        cache_key = (n_chunks, chunk, near, far)
+        fn = self._march_fns.get(cache_key)
+        if fn is None:
+            network = self.network
+            options = self.march_options
+
+            @jax.jit
+            def fn(params, rays_p, grid, bbox):
+                apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+                    params, pts, vd, model=model
+                )
+                return jax.lax.map(
+                    lambda rc: march_rays_accelerated(
+                        apply_fn, rc, near, far, grid, bbox, options
+                    ),
+                    rays_p,
+                )
+
+            self._march_fns[cache_key] = fn
+
+        out = fn(params, rays_p, self.occupancy_grid, self.grid_bbox)
+        n_truncated = int(jnp.sum(out.pop("n_truncated")))
+        if n_truncated:
+            print(
+                f"render_accelerated: {n_truncated} rays exceeded the "
+                f"max_march_samples={self.march_options.max_samples} budget "
+                f"while still transparent (far contributions truncated)"
+            )
+        return _unpad_outputs(out, n)
 
 
 def make_renderer(cfg, network) -> Renderer:

@@ -1,0 +1,98 @@
+"""Plot training curves from a captured console log.
+
+Parity with the reference's `plot_loss.py:7-134`: regex-parse the console
+lines (``eta: .. epoch: .. step: .. loss..``, plus validation ``PSNR``/``SSIM``
+summaries) into a table and write a 3-panel figure (total loss, PSNR, SSIM).
+Our recorder emits the same line format, so this works on either framework's
+logs.
+
+    python plot_loss.py --log_file data/record/train.log --out curves.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+
+LINE_RE = re.compile(r"step:\s*(\d+)")
+KV_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*):\s*([-+0-9.eE]+)")
+VAL_PSNR_RE = re.compile(r"(?:Average PSNR|psnr):\s*([-+0-9.eE]+)", re.IGNORECASE)
+VAL_SSIM_RE = re.compile(r"(?:Average SSIM|ssim):\s*([-+0-9.eE]+)", re.IGNORECASE)
+
+
+def parse_log_file(path: str):
+    """Returns (train_rows, val_rows): per-step loss stats and validation
+    metric samples (indexed by the last seen train step)."""
+    train, val = [], []
+    last_step = 0
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            m = LINE_RE.search(line)
+            if m and "eta:" in line:
+                row = {k: float(v) for k, v in KV_RE.findall(line)}
+                row["step"] = int(m.group(1))
+                last_step = row["step"]
+                train.append(row)
+                continue
+            pm, sm = VAL_PSNR_RE.search(line), VAL_SSIM_RE.search(line)
+            if pm or sm:
+                val.append(
+                    {
+                        "step": last_step,
+                        "psnr": float(pm.group(1)) if pm else None,
+                        "ssim": float(sm.group(1)) if sm else None,
+                    }
+                )
+    return train, val
+
+
+def plot_metrics(train, val, out_path: str):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 3, figsize=(16, 4))
+
+    steps = [r["step"] for r in train]
+    loss_key = next(
+        (k for k in ("total_loss", "loss", "loss_f", "loss_c")
+         if train and k in train[0]),
+        None,
+    )
+    if loss_key:
+        axes[0].plot(steps, [r[loss_key] for r in train], lw=0.7)
+        axes[0].set_yscale("log")
+    axes[0].set_title(f"train {loss_key or 'loss'}")
+    axes[0].set_xlabel("step")
+
+    vp = [(r["step"], r["psnr"]) for r in val if r.get("psnr") is not None]
+    if vp:
+        axes[1].plot(*zip(*vp), marker="o", ms=2)
+    axes[1].set_title("val PSNR (dB)")
+    axes[1].set_xlabel("step")
+
+    vs = [(r["step"], r["ssim"]) for r in val if r.get("ssim") is not None]
+    if vs:
+        axes[2].plot(*zip(*vs), marker="o", ms=2)
+    axes[2].set_title("val SSIM")
+    axes[2].set_xlabel("step")
+
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    return out_path
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--log_file", required=True)
+    parser.add_argument("--out", default="curves.png")
+    args = parser.parse_args()
+    train, val = parse_log_file(args.log_file)
+    print(f"parsed {len(train)} train lines, {len(val)} val samples")
+    out = plot_metrics(train, val, args.out)
+    print(f"figure saved to {out}")
+
+
+if __name__ == "__main__":
+    main()

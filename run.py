@@ -1,0 +1,136 @@
+"""Stage-by-stage debug/eval CLI.
+
+Parity with the reference's `run.py:5-91` — the operational smoke-test
+workflow (SURVEY.md §4): each subcommand exercises one pipeline stage
+end-to-end.
+
+    python run.py --type dataset  --cfg_file configs/nerf/lego.yaml
+    python run.py --type network  --cfg_file configs/nerf/lego.yaml
+    python run.py --type evaluate --cfg_file configs/nerf/lego.yaml
+
+* ``dataset``: iterate the loader contract (timed batch draws).
+* ``network``: timed full-image forward over the test set.
+* ``evaluate``: render + PSNR/SSIM metrics + per-image net_time / fps report,
+  using the occupancy-accelerated renderer when a baked grid exists
+  (reference run.py:64-67; missing grid falls back to the vanilla path).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def _load_eval_setup(cfg):
+    """network + params (from the trained checkpoint) + renderer + test set."""
+    import jax
+
+    from nerf_replication_tpu.datasets import make_dataset
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.models.nerf.network import init_params
+    from nerf_replication_tpu.renderer import make_renderer
+    from nerf_replication_tpu.train.checkpoint import load_network
+
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    params, epoch = load_network(
+        cfg.trained_model_dir, params, epoch=int(cfg.test.get("epoch", -1))
+    )
+    print(f"loaded network from {cfg.trained_model_dir} (epoch {epoch})")
+    renderer = make_renderer(cfg, network)
+    test_ds = make_dataset(cfg, "test")
+    return network, params, renderer, test_ds
+
+
+def run_dataset(cfg, args=None):
+    """Iterate the train loader contract (reference run.py:5-12)."""
+    from tqdm import tqdm
+
+    from nerf_replication_tpu.datasets import make_dataset
+
+    dataset = make_dataset(cfg, "train")
+    n = min(len(dataset), 1000)
+    t0 = time.time()
+    for i in tqdm(range(n)):
+        _ = dataset[i]
+    dt = time.time() - t0
+    print(f"iterated {n} batches in {dt:.2f}s ({n / dt:.1f} it/s)")
+
+
+def run_network(cfg, args=None):
+    """Timed full-image network forward over the test set (run.py:15-40)."""
+    import jax
+
+    from tqdm import tqdm
+
+    network, params, renderer, test_ds = _load_eval_setup(cfg)
+    total_time, net_times = 0.0, []
+    for i in tqdm(range(len(test_ds))):
+        batch = test_ds.image_batch(i)
+        t0 = time.time()
+        out = renderer.render_chunked(params, batch)
+        jax.block_until_ready(out)
+        net_times.append(time.time() - t0)
+        total_time += net_times[-1]
+    # first image excluded: it pays compilation (reference excludes it too,
+    # run.py:82-87, there for cache warmup)
+    times = net_times[1:] if len(net_times) > 1 else net_times
+    print(
+        f"mean net_time: {np.mean(times):.4f}s  fps: {1.0 / np.mean(times):.3f}"
+    )
+
+
+def run_evaluate(cfg, args=None):
+    """Full metric run: render every test view, PSNR/SSIM, summary.json
+    (reference run.py:43-87)."""
+    import jax
+
+    from tqdm import tqdm
+
+    from nerf_replication_tpu.evaluators import make_evaluator
+    from nerf_replication_tpu.renderer.occupancy import default_grid_path
+
+    network, params, renderer, test_ds = _load_eval_setup(cfg)
+    evaluator = make_evaluator(cfg)
+
+    accelerated = bool(cfg.task_arg.get("accelerated_renderer", False))
+    if accelerated:
+        grid_path = default_grid_path(getattr(args, "cfg_file", "config"))
+        renderer.load_occupancy_grid(grid_path)
+
+    net_times = []
+    for i in tqdm(range(len(test_ds))):
+        batch = test_ds.image_batch(i)
+        t0 = time.time()
+        out = renderer.render_accelerated(params, batch)
+        jax.block_until_ready(out)
+        net_times.append(time.time() - t0)
+        out = {k: np.asarray(v) for k, v in out.items()}
+        evaluator.evaluate(out, batch)
+
+    result = evaluator.summarize()
+    times = net_times[1:] if len(net_times) > 1 else net_times
+    print(
+        f"mean net_time: {np.mean(times):.4f}s  fps: {1.0 / np.mean(times):.3f}"
+    )
+    print(result)
+    return result
+
+
+def main():
+    from nerf_replication_tpu.config import cfg_from_args, make_parser
+
+    args = make_parser().parse_args()
+    cfg = cfg_from_args(args)
+    fn = globals().get("run_" + args.type)
+    if fn is None:
+        known = sorted(
+            n[len("run_"):] for n in globals() if n.startswith("run_")
+        )
+        raise SystemExit(f"unknown --type {args.type!r}; choose from {known}")
+    fn(cfg, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Training entry point.
+
+Parity with the reference's `train.py:31-135`: build everything from the YAML
+config, resume from the latest checkpoint, run the epoch loop with periodic
+save/eval, with multi-process (multi-host) support. The process topology is
+JAX's (`jax.distributed` + mesh collectives) rather than NCCL/DDP; there is no
+`kill -9` self-termination (reference train.py:131) because there are no
+dataloader workers to orphan.
+
+Usage:
+    python train.py --cfg_file configs/nerf/lego.yaml [key value ...]
+    python train.py --cfg_file configs/nerf/lego.yaml --test   # eval only
+"""
+
+from __future__ import annotations
+
+
+def main():
+    from nerf_replication_tpu.config import cfg_from_args, make_parser
+
+    args = make_parser().parse_args()
+    cfg = cfg_from_args(args)
+
+    if args.test:
+        from run import run_evaluate
+
+        run_evaluate(cfg, args)
+        return
+
+    from nerf_replication_tpu.train.trainer import fit
+
+    fit(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,8 +38,50 @@ def get_encoder(enc_cfg):
         module = TriPlaneEncoder.from_cfg(enc_cfg)
         return module, module.out_dim
 
+    if enc_type in _DYNAMIC_TYPES:
+        module = _make_dynamic(enc_type, enc_cfg)
+        return module, module.out_dim
+
     raise NotImplementedError(
-        f"Encoder type {enc_type!r} is not implemented yet "
-        f"(reference parity list: frequency, hashgrid, triplane, dnerf & "
-        f"variants; see SURVEY.md §2.2)"
+        f"Encoder type {enc_type!r} is not implemented "
+        f"(supported: frequency, hashgrid, triplane + dynamic family "
+        f"{sorted(_DYNAMIC_TYPES)}; see SURVEY.md §2.2)"
     )
+
+
+# time-conditioned family — reference type names from
+# src/models/encoding/__init__.py:6-86 map onto our dynamic modules
+_DYNAMIC_TYPES = {
+    "cuda_hashgrid_latent": "HashLatentEncoder",
+    "cuda_hashgrid_4d": "HashEncoder4d",
+    "cuda_hashgrid_coef": "HashCoefEncoder",
+    "cuda_motion2d": "Motion2dEncoder",
+    "dnerf": "DNeRFEncoder",
+    "cuda_dnerf_ngp_tensorf": "DNeRFNGPEncoder",
+    "dnerf_ngp_tensorf": "DNeRFNGPEncoder",
+    "dnerf_ngp_mlp": "DNeRFEncoder",
+    "dnerf_mlp_tensorf": "DNeRFNGPEncoder",
+}
+
+# note: input_dim deliberately NOT forwarded — each dynamic class owns its
+# hash input rank (3-D xyz canonical space; HashEncoder4d/Motion2d override)
+_HASH_KEYS = (
+    "num_levels", "level_dim", "per_level_scale",
+    "base_resolution", "log2_hashmap_size", "desired_resolution",
+)
+
+
+def _make_dynamic(enc_type, enc_cfg):
+    from . import dynamic
+
+    cls = getattr(dynamic, _DYNAMIC_TYPES[enc_type])
+    hash_kwargs = {k: enc_cfg[k] for k in _HASH_KEYS if k in enc_cfg}
+    kwargs = dict(
+        num_frames=int(enc_cfg.get("num_frames", 1)),
+        bbox=tuple(map(tuple, enc_cfg.bbox)) if "bbox" in enc_cfg else None,
+        hash_kwargs=hash_kwargs,
+    )
+    if kwargs["bbox"] is None:
+        # dynamic encoders normalize with explicit world bounds
+        kwargs["bbox"] = ((-1.5, -1.5, -1.5), (1.5, 1.5, 1.5))
+    return cls(**kwargs)

@@ -1,0 +1,220 @@
+"""Multiresolution hash-grid encoder (instant-ngp style), TPU-native.
+
+Capability parity with the reference's native CUDA component — the
+hash-encoder kernels (src/models/encoding/hashencoder/src/hashencoder.cu:
+99-196) and their module wrapper (src/models/encoding/hashencoder/
+hashgrid.py:121-227) — re-derived from the math rather than translated:
+
+* **Level geometry** (hashencoder.cu:101-102, hashgrid.py:167-177):
+  ``scale_l = 2^(l·S)·H − 1`` with ``S = log2(per_level_scale)``,
+  ``resolution_l = ceil(scale_l) + 1``; table slice per level holds
+  ``min(2^log2_hashmap_size, (ceil(H·s^l)+1)^D)`` entries rounded down to a
+  multiple of 8.
+* **Indexing** (hashencoder.cu:56-74): dense row-major over voxel corners
+  while the level fits its table slice, otherwise the XOR-prime
+  ``fast_hash`` — whether a level hashes is STATIC (a compile-time constant
+  per level), so XLA sees no data-dependent branching.
+* **Interpolation** (hashencoder.cu:116-149): D-linear blend of the 2^D
+  corners; the corner loop is a static Python loop of 2^D fused
+  gather+multiply-accumulate steps over all levels at once.
+
+The backward pass needs no hand-written kernel: differentiating the gather
+yields a scatter-add, which XLA lowers to the TPU-idiomatic segment-sum —
+the role of the CUDA ``atomicAdd`` backward (hashencoder.cu:254-267,
+SURVEY.md §2.2). Forward+backward are one fused jitted program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# fast_hash primes (hashencoder.cu:43); 1 for dim 0 keeps memory coherence
+_PRIMES = (1, 19349663, 83492791, 25165843, 6291469, 12582917, 3145739)
+
+
+def normalize_bbox(x: jax.Array, bbox) -> jax.Array:
+    """Clip to world bounds and scale into [0, 1] by the LARGEST extent
+    (hashgrid.py:191-199's wbounds normalization) — the one convention every
+    encoder in the family shares."""
+    lo = jnp.asarray(bbox[0], x.dtype)
+    hi = jnp.asarray(bbox[1], x.dtype)
+    return (jnp.clip(x, lo, hi) - lo) / (jnp.max(hi - lo) + 1e-6)
+
+
+def level_geometry(
+    input_dim: int,
+    num_levels: int,
+    per_level_scale: float,
+    base_resolution: int,
+    log2_hashmap_size: int,
+):
+    """Static per-level constants.
+
+    Returns (offsets [L+1], scales [L], resolutions [L], use_hash [L]):
+    table-slice offsets in entries, the float grid scale, the corner-grid
+    resolution, and whether the level indexes by hash (static!).
+    """
+    max_params = 2**log2_hashmap_size
+    offsets, scales, resolutions, use_hash = [0], [], [], []
+    s = float(per_level_scale)
+    for lvl in range(num_levels):
+        # allocation-side resolution (hashgrid.py:167-171)
+        res_alloc = int(np.ceil(base_resolution * s**lvl))
+        params_in_level = min(max_params, (res_alloc + 1) ** input_dim)
+        params_in_level = int(params_in_level / 8) * 8
+        offsets.append(offsets[-1] + params_in_level)
+
+        # kernel-side geometry (hashencoder.cu:101-102)
+        scale = 2.0 ** (lvl * np.log2(s)) * base_resolution - 1.0
+        resolution = int(np.ceil(scale)) + 1
+        scales.append(float(scale))
+        resolutions.append(resolution)
+        # dense iff the full corner grid fits the slice (cu:61-68 semantics)
+        use_hash.append((resolution + 1) ** input_dim > params_in_level)
+    return offsets, scales, resolutions, use_hash
+
+
+def _corner_index(
+    corner: jax.Array,  # [..., D] int32 corner coords of one level
+    resolution: int,
+    hashmap_size: int,
+    hashed: bool,
+) -> jax.Array:
+    """Table index of a corner (pre-offset), static dense/hash selection."""
+    d = corner.shape[-1]
+    if not hashed:
+        # row-major: sum_d corner_d * (resolution+1)^d  (cu:61-65)
+        stride = 1
+        index = jnp.zeros(corner.shape[:-1], jnp.uint32)
+        for dd in range(d):
+            index = index + corner[..., dd].astype(jnp.uint32) * jnp.uint32(stride)
+            stride *= resolution + 1
+    else:
+        index = jnp.zeros(corner.shape[:-1], jnp.uint32)
+        for dd in range(d):
+            index = index ^ (
+                corner[..., dd].astype(jnp.uint32) * jnp.uint32(_PRIMES[dd])
+            )
+    return (index % jnp.uint32(hashmap_size)).astype(jnp.int32)
+
+
+def hash_encode(
+    x: jax.Array,  # [..., D] in [0, 1]
+    table: jax.Array,  # [total_entries, C]
+    input_dim: int,
+    num_levels: int,
+    per_level_scale: float,
+    base_resolution: int,
+    log2_hashmap_size: int,
+) -> jax.Array:
+    """[..., D] → [..., L·C]; pure function of (x, table)."""
+    offsets, scales, resolutions, use_hash = level_geometry(
+        input_dim, num_levels, per_level_scale, base_resolution,
+        log2_hashmap_size,
+    )
+    d = input_dim
+    outs = []
+    for lvl in range(num_levels):
+        scale = scales[lvl]
+        pos = x * scale + 0.5  # cu:109
+        pos_grid = jnp.floor(pos)
+        frac = pos - pos_grid
+        pos_grid = pos_grid.astype(jnp.int32)
+
+        acc = None
+        for corner_bits in range(1 << d):
+            sel = [(corner_bits >> dd) & 1 for dd in range(d)]
+            corner = pos_grid + jnp.asarray(sel, jnp.int32)
+            w = jnp.ones(x.shape[:-1], x.dtype)
+            for dd in range(d):
+                w = w * (frac[..., dd] if sel[dd] else 1.0 - frac[..., dd])
+            idx = _corner_index(
+                corner,
+                resolutions[lvl],
+                offsets[lvl + 1] - offsets[lvl],
+                use_hash[lvl],
+            )
+            vals = jnp.take(table, idx + offsets[lvl], axis=0)
+            contrib = w[..., None] * vals
+            acc = contrib if acc is None else acc + contrib
+        outs.append(acc)
+    return jnp.concatenate(outs, axis=-1)
+
+
+class HashGridEncoder(nn.Module):
+    """Flax module owning the embedding table (uniform ±1e-4 init,
+    hashgrid.py:184-186), with world-bounds normalization to [0, 1]
+    (hashgrid.py:191-199)."""
+
+    input_dim: int = 3
+    num_levels: int = 16
+    level_dim: int = 2
+    per_level_scale: float = 2.0
+    base_resolution: int = 16
+    log2_hashmap_size: int = 19
+    desired_resolution: int = -1
+    bbox: tuple | None = None  # ((lo,)*D, (hi,)*D) world bounds
+
+    @property
+    def scale_factor(self) -> float:
+        if self.desired_resolution != -1:
+            # finest-level resolution overrides the scale (hashgrid.py:137-140)
+            return float(
+                2.0
+                ** (
+                    np.log2(self.desired_resolution / self.base_resolution)
+                    / (self.num_levels - 1)
+                )
+            )
+        return float(self.per_level_scale)
+
+    @property
+    def out_dim(self) -> int:
+        return self.num_levels * self.level_dim
+
+    @property
+    def n_entries(self) -> int:
+        offsets, _, _, _ = level_geometry(
+            self.input_dim, self.num_levels, self.scale_factor,
+            self.base_resolution, self.log2_hashmap_size,
+        )
+        return offsets[-1]
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        table = self.param(
+            "embeddings",
+            lambda key, shape: jax.random.uniform(
+                key, shape, jnp.float32, -1e-4, 1e-4
+            ),
+            (self.n_entries, self.level_dim),
+        )
+        if self.bbox is not None:
+            x = normalize_bbox(x, self.bbox)
+        return hash_encode(
+            x,
+            table,
+            self.input_dim,
+            self.num_levels,
+            self.scale_factor,
+            self.base_resolution,
+            self.log2_hashmap_size,
+        )
+
+    @classmethod
+    def from_cfg(cls, enc_cfg) -> "HashGridEncoder":
+        bbox = enc_cfg.get("bbox", None)
+        return cls(
+            input_dim=int(enc_cfg.get("input_dim", 3)),
+            num_levels=int(enc_cfg.get("num_levels", 16)),
+            level_dim=int(enc_cfg.get("level_dim", 2)),
+            per_level_scale=float(enc_cfg.get("per_level_scale", 2.0)),
+            base_resolution=int(enc_cfg.get("base_resolution", 16)),
+            log2_hashmap_size=int(enc_cfg.get("log2_hashmap_size", 19)),
+            desired_resolution=int(enc_cfg.get("desired_resolution", -1)),
+            bbox=tuple(map(tuple, bbox)) if bbox is not None else None,
+        )

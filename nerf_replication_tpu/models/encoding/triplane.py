@@ -1,0 +1,70 @@
+"""Tri-plane encoder: three axis-aligned 2-D hash grids.
+
+Capability parity with the reference's CUDA ``TriPlane``
+(src/models/encoding/hashencoder/hashgrid.py:222-238): the xy/yz/xz
+projections of a 3-D point each go through an independent 2-D multiresolution
+hash encoder and the three feature vectors concatenate. (The reference also
+carries a dense-`grid_sample` torch variant, src/models/encoding/
+triplane.py:8-103 — same capability, one implementation here.)
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .hashgrid import HashGridEncoder, normalize_bbox
+
+_PLANES = ((0, 1), (1, 2), (0, 2))  # xy, yz, xz (hashgrid.py:231-236)
+
+
+class TriPlaneEncoder(nn.Module):
+    """[..., 3] → [..., 3·L·C]."""
+
+    num_levels: int = 16
+    level_dim: int = 2
+    per_level_scale: float = 2.0
+    base_resolution: int = 16
+    log2_hashmap_size: int = 19
+    desired_resolution: int = -1
+    bbox: tuple | None = None
+
+    def setup(self):
+        kwargs = dict(
+            input_dim=2,
+            num_levels=self.num_levels,
+            level_dim=self.level_dim,
+            per_level_scale=self.per_level_scale,
+            base_resolution=self.base_resolution,
+            log2_hashmap_size=self.log2_hashmap_size,
+            desired_resolution=self.desired_resolution,
+        )
+        self.planes = [
+            HashGridEncoder(**kwargs, name=f"plane_{a}{b}") for a, b in _PLANES
+        ]
+
+    @property
+    def out_dim(self) -> int:
+        return 3 * self.num_levels * self.level_dim
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.bbox is not None:
+            x = normalize_bbox(x, self.bbox)
+        feats = [
+            plane(x[..., (a, b)]) for plane, (a, b) in zip(self.planes, _PLANES)
+        ]
+        return jnp.concatenate(feats, axis=-1)
+
+    @classmethod
+    def from_cfg(cls, enc_cfg) -> "TriPlaneEncoder":
+        bbox = enc_cfg.get("bbox", None)
+        return cls(
+            num_levels=int(enc_cfg.get("num_levels", 16)),
+            level_dim=int(enc_cfg.get("level_dim", 2)),
+            per_level_scale=float(enc_cfg.get("per_level_scale", 2.0)),
+            base_resolution=int(enc_cfg.get("base_resolution", 16)),
+            log2_hashmap_size=int(enc_cfg.get("log2_hashmap_size", 19)),
+            desired_resolution=int(enc_cfg.get("desired_resolution", -1)),
+            bbox=tuple(map(tuple, bbox)) if bbox is not None else None,
+        )

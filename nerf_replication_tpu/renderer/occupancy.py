@@ -110,9 +110,15 @@ def occupancy_stats(grid: np.ndarray) -> dict:
 def world_to_voxel(pts: jax.Array, bbox: jax.Array, resolution: int) -> jax.Array:
     """World points → integer voxel indices, clamped into the grid (the
     reference clamps to the bbox before indexing, volume_renderer.py:261-265,
-    so out-of-bounds points land in boundary voxels)."""
+    so out-of-bounds points land in boundary voxels).
+
+    Deliberate divergence: the reference scales by ``resolution - 1``
+    (volume_renderer.py:264) while the bake lays voxels out on a stride of
+    ``extent / resolution`` (occupancy_grid.py:25) — a mismatch that shifts
+    lookups down by up to one voxel near the +bbox face. We index with the
+    bake's own layout: ``floor(u · resolution)`` clamped into range."""
     lo, hi = bbox[0], bbox[1]
     normalized = (jnp.clip(pts, lo, hi) - lo) / (hi - lo)
     return jnp.clip(
-        (normalized * (resolution - 1)).astype(jnp.int32), 0, resolution - 1
+        jnp.floor(normalized * resolution).astype(jnp.int32), 0, resolution - 1
     )

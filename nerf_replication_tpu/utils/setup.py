@@ -1,0 +1,27 @@
+"""Shared eval-time bootstrap: build the network and load trained weights.
+
+One implementation of the make_network → init_params → load_network sequence
+every inference entry point needs (parity: the reference repeats this in
+run.py:54-58, occupancy_grid.py:16-18, render_video.py:24-27).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def load_trained_network(cfg, verbose: bool = True):
+    """Returns ``(network, params, epoch)`` with params from the trained
+    checkpoint (epoch selected by ``cfg.test.epoch``; -1 → latest)."""
+    from ..models import make_network
+    from ..models.nerf.network import init_params
+    from ..train.checkpoint import load_network
+
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    params, epoch = load_network(
+        cfg.trained_model_dir, params, epoch=int(cfg.test.get("epoch", -1))
+    )
+    if verbose:
+        print(f"loaded network from {cfg.trained_model_dir} (epoch {epoch})")
+    return network, params, epoch

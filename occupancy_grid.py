@@ -12,30 +12,20 @@ from __future__ import annotations
 
 
 def main():
-    import jax
-
     from nerf_replication_tpu.config import cfg_from_args, make_parser
-    from nerf_replication_tpu.models import make_network
-    from nerf_replication_tpu.models.nerf.network import init_params
     from nerf_replication_tpu.renderer.occupancy import (
         bake_occupancy_grid,
         default_grid_path,
         occupancy_stats,
         save_occupancy_grid,
     )
-    from nerf_replication_tpu.train.checkpoint import load_network
+    from nerf_replication_tpu.utils.setup import load_trained_network
 
     parser = make_parser()
     args = parser.parse_args()
     cfg = cfg_from_args(args)
 
-    network = make_network(cfg)
-    params = init_params(network, jax.random.PRNGKey(0))
-    params, epoch = load_network(
-        cfg.trained_model_dir, params, epoch=int(cfg.test.get("epoch", -1))
-    )
-    print(f"loaded network from {cfg.trained_model_dir} (epoch {epoch})")
-
+    network, params, _ = load_trained_network(cfg)
     grid = bake_occupancy_grid(params, network, cfg)
     stats = occupancy_stats(grid)
     print(

@@ -21,24 +21,15 @@ RADIUS = 4.0
 
 
 def render_360_video(cfg, args=None):
-    import jax
-
     from tqdm import tqdm
 
     from nerf_replication_tpu.datasets import make_dataset
     from nerf_replication_tpu.datasets.rays import get_rays_np, pose_spherical
-    from nerf_replication_tpu.models import make_network
-    from nerf_replication_tpu.models.nerf.network import init_params
     from nerf_replication_tpu.renderer import make_renderer
     from nerf_replication_tpu.renderer.occupancy import default_grid_path
-    from nerf_replication_tpu.train.checkpoint import load_network
+    from nerf_replication_tpu.utils.setup import load_trained_network
 
-    network = make_network(cfg)
-    params = init_params(network, jax.random.PRNGKey(0))
-    params, epoch = load_network(
-        cfg.trained_model_dir, params, epoch=int(cfg.test.get("epoch", -1))
-    )
-    print(f"loaded network from {cfg.trained_model_dir} (epoch {epoch})")
+    network, params, _ = load_trained_network(cfg)
     renderer = make_renderer(cfg, network)
     if bool(cfg.task_arg.get("accelerated_renderer", False)) and args is not None:
         renderer.load_occupancy_grid(default_grid_path(args.cfg_file))

@@ -24,20 +24,11 @@ import numpy as np
 
 def _load_eval_setup(cfg):
     """network + params (from the trained checkpoint) + renderer + test set."""
-    import jax
-
     from nerf_replication_tpu.datasets import make_dataset
-    from nerf_replication_tpu.models import make_network
-    from nerf_replication_tpu.models.nerf.network import init_params
     from nerf_replication_tpu.renderer import make_renderer
-    from nerf_replication_tpu.train.checkpoint import load_network
+    from nerf_replication_tpu.utils.setup import load_trained_network
 
-    network = make_network(cfg)
-    params = init_params(network, jax.random.PRNGKey(0))
-    params, epoch = load_network(
-        cfg.trained_model_dir, params, epoch=int(cfg.test.get("epoch", -1))
-    )
-    print(f"loaded network from {cfg.trained_model_dir} (epoch {epoch})")
+    network, params, _ = load_trained_network(cfg)
     renderer = make_renderer(cfg, network)
     test_ds = make_dataset(cfg, "test")
     return network, params, renderer, test_ds

@@ -75,8 +75,25 @@ def test_world_to_voxel_clamps_and_indexes():
     idx = np.asarray(world_to_voxel(pts, bbox, 8))
     np.testing.assert_array_equal(idx[0], [0, 0, 0])
     np.testing.assert_array_equal(idx[1], [7, 7, 7])
-    np.testing.assert_array_equal(idx[2], [7, 0, 3])  # clamped then scaled
+    np.testing.assert_array_equal(idx[2], [7, 0, 4])  # clamped then scaled
+    # center point lands in the voxel whose bake-layout cell contains it
+    np.testing.assert_array_equal(idx[3], [4, 4, 4])
     assert (idx >= 0).all() and (idx < 8).all()
+
+
+def test_world_to_voxel_aligns_with_bake_layout():
+    """A point inside baked voxel i (cell [lo + i·vs, lo + (i+1)·vs)) must
+    map back to index i — the misalignment the reference inherits from
+    scaling by resolution-1 (volume_renderer.py:264) is fixed here."""
+    bbox_np = np.array([[-1.5, -1.5, -1.5], [1.5, 1.5, 1.5]], np.float32)
+    bbox = jnp.asarray(bbox_np)
+    res = 16
+    vs = (bbox_np[1] - bbox_np[0]) / res
+    rng = np.random.default_rng(0)
+    ijk = rng.integers(0, res, (50, 3))
+    pts = bbox_np[0] + (ijk + rng.uniform(0.01, 0.99, (50, 3))) * vs
+    idx = np.asarray(world_to_voxel(jnp.asarray(pts, jnp.float32), bbox, res))
+    np.testing.assert_array_equal(idx, ijk)
 
 
 def test_bake_and_roundtrip(tmp_path, setup):

@@ -1,0 +1,107 @@
+"""img_fit dataset: 2-D image-regression warm-up task.
+
+Capability parity with the reference's `src/datasets/img_fit/synthetic.py`
+(which ships broken — it imports the nonexistent ``lib.utils``/``lib.config``,
+SURVEY.md §2.1): load ONE view of a Blender-format scene, build the (u, v)
+pixel-coordinate grid normalized to [0, 1], and serve random (uv → rgb)
+batches for training / the whole image for eval.
+
+TPU data path: :meth:`ray_bank` exposes the flat (uv, rgb) arrays so the
+generic trainer samples batches on device, exactly like the NeRF ray bank
+(the "rays" slot of the batch dict carries uv here).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class Dataset:
+    data_root: str
+    scene: str
+    split: str = "train"
+    view: int = 0
+    input_ratio: float = 1.0
+
+    img: np.ndarray = field(init=False)  # [H, W, 3]
+    uv: np.ndarray = field(init=False)  # [H*W, 2]
+    H: int = field(init=False)
+    W: int = field(init=False)
+    batch_size: int = 8192
+
+    def __post_init__(self):
+        scene_dir = os.path.join(self.data_root, self.scene)
+        with open(os.path.join(scene_dir, "transforms_train.json")) as f:
+            meta = json.load(f)
+        frame = meta["frames"][self.view]
+        rel = frame["file_path"]
+        rel = rel[2:] if rel.startswith("./") else rel
+
+        import imageio.v2 as imageio
+
+        img = np.asarray(imageio.imread(os.path.join(scene_dir, rel + ".png")))
+        img = (img / 255.0).astype(np.float32)
+        if img.shape[-1] == 4:
+            img = img[..., :3] * img[..., 3:] + (1.0 - img[..., 3:])
+        if self.input_ratio != 1.0:
+            import cv2
+
+            img = cv2.resize(
+                img, None, fx=self.input_ratio, fy=self.input_ratio,
+                interpolation=cv2.INTER_AREA,
+            )
+        self.img = img.astype(np.float32)
+        self.H, self.W = img.shape[:2]
+        X, Y = np.meshgrid(np.arange(self.W), np.arange(self.H))
+        u = X.astype(np.float32) / (self.W - 1)
+        v = Y.astype(np.float32) / (self.H - 1)
+        self.uv = np.stack([u, v], -1).reshape(-1, 2)
+
+    @classmethod
+    def from_cfg(cls, cfg, split: str) -> "Dataset":
+        node = cfg.train_dataset if split == "train" else cfg.test_dataset
+        ds = cls(
+            data_root=node.data_root,
+            scene=cfg.scene,
+            split=node.get("split", split),
+            view=int(node.get("view", 0)),
+            input_ratio=float(node.get("input_ratio", 1.0)),
+        )
+        ds.batch_size = int(cfg.task_arg.get("N_pixels", 8192))
+        return ds
+
+    # ---- TPU data path ----------------------------------------------------
+    def ray_bank(self):
+        """(uv [N, 2], rgb [N, 3]) — the generic trainer's bank contract."""
+        return self.uv, self.img.reshape(-1, 3)
+
+    # ---- loader contract --------------------------------------------------
+    def __len__(self) -> int:
+        return 1  # one image (synthetic.py:53-55)
+
+    def image_batch(self, index: int = 0) -> dict:
+        return {
+            "uv": self.uv,
+            "rays": self.uv,  # generic-trainer alias
+            "rgb": self.img.reshape(-1, 3),
+            "rgbs": self.img.reshape(-1, 3),
+            "near": np.float32(0.0),
+            "far": np.float32(1.0),
+            "i": index,
+            "meta": {"H": self.H, "W": self.W},
+        }
+
+    def __getitem__(self, index: int) -> dict:
+        if self.split == "train":
+            ids = np.random.choice(len(self.uv), self.batch_size, replace=False)
+            return {
+                "uv": self.uv[ids],
+                "rgb": self.img.reshape(-1, 3)[ids],
+                "meta": {"H": self.H, "W": self.W},
+            }
+        return self.image_batch(index)

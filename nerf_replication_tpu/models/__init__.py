@@ -9,3 +9,15 @@ from ..registry import load_attr
 def make_network(cfg):
     factory = load_attr(cfg.network_module, "make_network", "Network")
     return factory(cfg)
+
+
+def init_params_for(cfg):
+    """The network plugin's ``init_params`` (task networks have different
+    input signatures); defaults to the NeRF one. Shared by training and the
+    eval bootstrap so both resolve identically."""
+    try:
+        return load_attr(cfg.network_module, "init_params")
+    except (AttributeError, ImportError):
+        from .nerf.network import init_params
+
+        return init_params

@@ -24,8 +24,7 @@ import jax.numpy as jnp
 
 from .freq import frequency_encoder
 from .hashgrid import HashGridEncoder, normalize_bbox as _normalize_xyz
-
-_PLANES = ((0, 1), (1, 2), (0, 2))
+from .triplane import _PLANES
 
 
 def _hash_out_dim(hash_kwargs: dict | None) -> int:
@@ -196,8 +195,7 @@ class Motion2dEncoder(nn.Module):
 
     @property
     def out_dim(self) -> int:
-        hk = self.hash_kwargs or {}
-        return 3 * int(hk.get("num_levels", 16)) * int(hk.get("level_dim", 2))
+        return 3 * _hash_out_dim(self.hash_kwargs)
 
     def __call__(self, xyzt: jax.Array) -> jax.Array:
         xyz = _normalize_xyz(xyzt[..., :3], self.bbox)

@@ -195,6 +195,10 @@ class HashGridEncoder(nn.Module):
         )
         if self.bbox is not None:
             x = normalize_bbox(x, self.bbox)
+        else:
+            # callers must pre-normalize; clip so out-of-range coords can't
+            # wrap through uint32 into scrambled (but finite) table indices
+            x = jnp.clip(x, 0.0, 1.0)
         return hash_encode(
             x,
             table,
@@ -208,6 +212,13 @@ class HashGridEncoder(nn.Module):
     @classmethod
     def from_cfg(cls, enc_cfg) -> "HashGridEncoder":
         bbox = enc_cfg.get("bbox", None)
+        if bbox is None:
+            # config-driven world-coordinate encoders must declare bounds
+            # (the reference crashes on wbounds=None too, hashgrid.py:193)
+            raise ValueError(
+                "hashgrid encoder config needs 'bbox: [[lo...],[hi...]]' "
+                "world bounds for [0,1] normalization"
+            )
         return cls(
             input_dim=int(enc_cfg.get("input_dim", 3)),
             num_levels=int(enc_cfg.get("num_levels", 16)),

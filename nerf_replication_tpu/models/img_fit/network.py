@@ -1,0 +1,44 @@
+"""img_fit network: freq-encoded (u, v) → MLP → sigmoid rgb.
+
+Capability parity with the reference's `src/models/img_fit/network.py:8-55`:
+a D-layer W-wide ReLU backbone over the uv positional encoding with a
+sigmoid rgb head. No chunking loop (network.py:40-49) — memory capping at
+eval time is ImgFitRenderer's `lax.map`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..encoding import get_encoder
+
+
+class Network(nn.Module):
+    D: int = 4
+    W: int = 128
+    uv_encoder: Callable = None
+
+    @nn.compact
+    def __call__(self, uv: jax.Array) -> jax.Array:
+        """[..., 2] → [..., 3] rgb in (0, 1)."""
+        h = self.uv_encoder(uv)
+        for i in range(self.D):
+            h = nn.relu(nn.Dense(self.W, name=f"backbone_{i}")(h))
+        return jax.nn.sigmoid(nn.Dense(3, name="rgb")(h))
+
+
+def make_network(cfg) -> Network:
+    uv_enc, _ = get_encoder(cfg.network.uv_encoder)
+    return Network(
+        D=int(cfg.network.D),
+        W=int(cfg.network.W),
+        uv_encoder=uv_enc,
+    )
+
+
+def init_params(network: Network, key: jax.Array):
+    return network.init(key, jnp.zeros((2, 2), jnp.float32))

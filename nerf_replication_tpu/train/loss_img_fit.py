@@ -1,0 +1,68 @@
+"""img_fit loss module — the ``loss_module`` plugin the reference's config
+names but does not ship (``src.train.losses.img_fit`` is absent from the
+reference tree, SURVEY.md §2.1 "Broken as shipped").
+
+Same callable contract as the NeRF loss: ``(params, batch, key, train) →
+(output, loss, stats)``; the generic trainer's batch carries uv in the
+"rays" slot and target rgb in "rgbs".
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .loss import mse, mse_to_psnr
+
+
+class ImgFitRenderer:
+    """Chunked full-image apply with the Renderer.render_chunked interface
+    (so Trainer.val works unchanged)."""
+
+    def __init__(self, cfg, network):
+        self.network = network
+        self.chunk_size = int(cfg.task_arg.get("chunk_size", 16384))
+        self._fns = {}
+
+    def render_chunked(self, params, batch: dict) -> dict:
+        uv = jnp.asarray(batch["rays"])
+        n = uv.shape[0]
+        chunk = min(self.chunk_size, n)
+        n_chunks = -(-n // chunk)
+        pad = n_chunks * chunk - n
+        uv_p = jnp.pad(uv, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 2)
+
+        fn = self._fns.get((n_chunks, chunk))
+        if fn is None:
+            network = self.network
+
+            @jax.jit
+            def fn(params, uv_p):
+                return jax.lax.map(
+                    lambda c: network.apply(params, c), uv_p
+                )
+
+            self._fns[(n_chunks, chunk)] = fn
+        rgb = fn(params, uv_p).reshape(-1, 3)[:n]
+        return {"rgb": rgb, "rgb_map_f": rgb}
+
+
+class ImgFitLoss:
+    def __init__(self, cfg, network):
+        self.network = network
+        self.renderer = ImgFitRenderer(cfg, network)
+
+    def __call__(self, params, batch, key=None, train: bool = True):
+        uv = batch.get("uv", batch.get("rays"))
+        target = batch.get("rgb", batch.get("rgbs"))
+        rgb = self.network.apply(params, uv)
+        loss = mse(rgb, target)
+        stats = {"loss": loss, "psnr": mse_to_psnr(loss)}
+        return {"rgb": rgb}, loss, stats
+
+
+def make_loss(cfg, network) -> ImgFitLoss:
+    return ImgFitLoss(cfg, network)
+
+
+NetworkWrapper = ImgFitLoss

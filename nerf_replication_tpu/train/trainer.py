@@ -28,7 +28,6 @@ import numpy as np
 from flax.training.train_state import TrainState
 
 from ..datasets.sampling import sample_step_key
-from ..models.nerf.network import init_params
 from .checkpoint import load_model, load_pretrain, save_model, save_trained_config
 from .step_core import sampled_grad_step
 from .optim import make_optimizer
@@ -36,7 +35,9 @@ from .recorder import Recorder
 
 
 def make_train_state(cfg, network, key) -> tuple[TrainState, "optax.Schedule"]:
-    params = init_params(network, key)
+    from ..models import init_params_for
+
+    params = init_params_for(cfg)(network, key)
     tx, schedule = make_optimizer(cfg)
     state = TrainState.create(
         apply_fn=network.apply, params=params["params"], tx=tx
@@ -50,9 +51,18 @@ class Trainer:
         self.network = network
         self.loss = loss  # NeRFLoss: (params, batch, key, train) -> (out, loss, stats)
         self.evaluator = evaluator
-        self.n_rays = int(cfg.task_arg.get("N_rays", 1024))
-        self.near = float(cfg.task_arg.near)
-        self.far = float(cfg.task_arg.far)
+        # img_fit names the batch knob N_pixels (lego_view0.yaml:14)
+        self.n_rays = int(
+            cfg.task_arg.get("N_rays", cfg.task_arg.get("N_pixels", 1024))
+        )
+        if "N_pixels" in cfg.task_arg and "near" not in cfg.task_arg:
+            # pixel-regression tasks have no ray bounds; dummies fill the slot
+            self.near, self.far = 0.0, 1.0
+        else:
+            # ray-marching tasks must say their bounds — a missing near/far
+            # here must fail loudly, not default to garbage segments
+            self.near = float(cfg.task_arg.near)
+            self.far = float(cfg.task_arg.far)
         self.precrop_iters = int(cfg.task_arg.get("precrop_iters", 0))
         self.ep_iter = int(cfg.get("ep_iter", 500))
         self.process_index = jax.process_index()

@@ -13,12 +13,11 @@ import jax
 def load_trained_network(cfg, verbose: bool = True):
     """Returns ``(network, params, epoch)`` with params from the trained
     checkpoint (epoch selected by ``cfg.test.epoch``; -1 → latest)."""
-    from ..models import make_network
-    from ..models.nerf.network import init_params
+    from ..models import init_params_for, make_network
     from ..train.checkpoint import load_network
 
     network = make_network(cfg)
-    params = init_params(network, jax.random.PRNGKey(0))
+    params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
     params, epoch = load_network(
         cfg.trained_model_dir, params, epoch=int(cfg.test.get("epoch", -1))
     )

@@ -1,0 +1,94 @@
+"""img_fit task end-to-end: the 2-D image-regression warm-up must train
+through the SAME generic trainer/fit pipeline as NeRF (the reference ships
+this task broken — missing loss module and dead imports; SURVEY.md §2.1)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from nerf_replication_tpu.config import make_cfg
+from nerf_replication_tpu.datasets.procedural import generate_scene
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def scene_root(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_imgfit"))
+    generate_scene(root, scene="procedural", H=24, W=24, n_train=3, n_test=1)
+    return root
+
+
+def imgfit_cfg(scene_root, tmp_path, extra=()):
+    return make_cfg(
+        os.path.join(ROOT, "configs", "img_fit", "lego_view0.yaml"),
+        [
+            "scene", "procedural",
+            "train_dataset.data_root", str(scene_root),
+            "test_dataset.data_root", str(scene_root),
+            "test_dataset.input_ratio", "1.0",
+            "task_arg.N_pixels", "256",
+            "network.W", "32", "network.D", "2",
+            "network.uv_encoder.freq", "4",
+            "ep_iter", "50",
+            "train.epoch", "4",
+            "eval_ep", "4",
+            "save_latest_ep", "100",
+            "log_interval", "25",
+            "result_dir", str(tmp_path / "result"),
+            "trained_model_dir", str(tmp_path / "model"),
+            "trained_config_dir", str(tmp_path / "config"),
+            "record_dir", str(tmp_path / "record"),
+            *extra,
+        ],
+    )
+
+
+def test_img_fit_trains_and_evaluates(scene_root, tmp_path):
+    from nerf_replication_tpu.train.trainer import fit
+
+    cfg = imgfit_cfg(scene_root, tmp_path)
+    logs = []
+    state = fit(cfg, log=logs.append)
+    assert int(state.step) == 200
+
+    # the last validation PSNR must beat a flat-gray baseline on this image
+    val_lines = [l for l in logs if l.startswith("val epoch")]
+    assert val_lines, f"no validation ran; logs: {logs[-3:]}"
+    psnr = float(val_lines[-1].split("psnr:")[1].split()[0])
+    assert psnr > 10.0
+
+    result_dir = cfg.result_dir
+    assert os.path.exists(os.path.join(result_dir, "metrics.json"))
+    assert os.path.exists(os.path.join(result_dir, "vis", "res.png"))
+
+
+def test_img_fit_network_module_contract():
+    """The network plugin exposes make_network + init_params and maps
+    [..., 2] uv → [..., 3] rgb in (0, 1)."""
+    from nerf_replication_tpu.config.node import ConfigNode
+    from nerf_replication_tpu.models.img_fit.network import (
+        init_params,
+        make_network,
+    )
+
+    cfg = ConfigNode(
+        {
+            "network": {
+                "W": 16,
+                "D": 2,
+                "uv_encoder": {"type": "frequency", "input_dim": 2, "freq": 3},
+            }
+        }
+    )
+    net = make_network(cfg)
+    params = init_params(net, jax.random.PRNGKey(0))
+    uv = jax.numpy.asarray(
+        np.random.default_rng(0).uniform(0, 1, (5, 2)), jax.numpy.float32
+    )
+    rgb = net.apply(params, uv)
+    assert rgb.shape == (5, 3)
+    out = np.asarray(rgb)
+    assert (out > 0).all() and (out < 1).all()

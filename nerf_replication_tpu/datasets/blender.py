@@ -40,6 +40,18 @@ def _resize_area(img: np.ndarray, W: int, H: int) -> np.ndarray:
     return cv2.resize(img, (W, H), interpolation=cv2.INTER_AREA)
 
 
+def _to_uint8(img: np.ndarray) -> np.ndarray:
+    """Normalize decoded PNGs to uint8 (16-bit and float frames included,
+    which the reference's bare /255 mishandles)."""
+    if img.dtype == np.uint8:
+        return img
+    if img.dtype == np.uint16:
+        return (img >> 8).astype(np.uint8)
+    if np.issubdtype(img.dtype, np.floating):
+        return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+    raise ValueError(f"unsupported image dtype {img.dtype}")
+
+
 @dataclass
 class Dataset:
     """One split of a Blender-format scene, fully materialized in host RAM."""
@@ -82,31 +94,30 @@ class Dataset:
             self.input_ratio
         )
 
-        rays_list, rgb_list, pose_list = [], [], []
+        raw_images, pose_list = [], []
         for frame in frames:
             img_path = os.path.join(
                 self.data_root, self.scene, frame["file_path"] + ".png"
             )
-            img = _load_image(img_path)
+            img = _to_uint8(_load_image(img_path))
             if self.input_ratio != 1.0:
+                # uint8 INTER_AREA downscale, as the reference does before
+                # the /255 float conversion (blender.py:86-87)
                 img = _resize_area(img, self.W, self.H)
-            img = (img / 255.0).astype(np.float32)
-            if img.shape[-1] == 4:
-                # RGBA → composite onto white (blender.py:92-93)
-                img = img[..., :3] * img[..., 3:] + (1.0 - img[..., 3:])
-
-            pose = np.asarray(frame["transform_matrix"], dtype=np.float32)
-            rays_o, rays_d = get_rays_np(self.H, self.W, self.focal, pose)
-            rays_list.append(
-                np.concatenate([rays_o, rays_d], axis=-1).reshape(-1, 6)
+            raw_images.append(img)
+            pose_list.append(
+                np.asarray(frame["transform_matrix"], dtype=np.float32)
             )
-            rgb_list.append(img[..., :3].reshape(-1, 3))
-            pose_list.append(pose)
-
-        self.rays = np.concatenate(rays_list, axis=0)
-        self.rgbs = np.concatenate(rgb_list, axis=0)
         self.poses = np.stack(pose_list, axis=0)
         self.n_images = len(pose_list)
+
+        # one bank builder for every path (C++ multithreaded when available,
+        # NumPy otherwise): pinhole rays + RGBA→white compositing
+        from ..native import build_ray_bank
+
+        self.rays, self.rgbs = build_ray_bank(
+            self.poses, np.stack(raw_images, 0), self.focal
+        )
 
     @classmethod
     def from_cfg(cls, cfg, split: str) -> "Dataset":

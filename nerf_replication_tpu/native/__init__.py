@@ -1,0 +1,141 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The compute path is JAX/XLA; the host runtime around it is native where the
+reference's is (SURVEY.md: torch's C++ DataLoader machinery + hashencoder
+JIT build, src/models/encoding/hashencoder/backend.py:6-16). The library is
+compiled on first use with g++ into a per-version cache dir; every entry
+point has a NumPy fallback, so the package works on machines without a
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "raybank.cpp")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_LIB_FAILED = False
+
+
+def _build_dir() -> str:
+    import platform
+
+    tag = f"cpy{sys.version_info.major}{sys.version_info.minor}-{platform.machine()}"
+    d = os.environ.get(
+        "NERF_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "nerf_replication_tpu", tag),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile() -> str | None:
+    out = os.path.join(_build_dir(), "libraybank.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
+        return out
+    # portable flags only (no -march=native): the cache dir may be a home
+    # share mounted across heterogeneous pod hosts
+    tmp = f"{out}.tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            return None
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return out
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The compiled library, or None when unavailable (fallback mode)."""
+    global _LIB, _LIB_FAILED
+    with _LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        path = _compile()
+        if path is None:
+            _LIB_FAILED = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.build_ray_bank.argtypes = [
+            ctypes.POINTER(ctypes.c_float),   # poses
+            ctypes.POINTER(ctypes.c_uint8),   # images
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # n,H,W,C
+            ctypes.c_float,                   # focal
+            ctypes.c_int,                     # n_threads
+            ctypes.POINTER(ctypes.c_float),   # rays_out
+            ctypes.POINTER(ctypes.c_float),   # rgbs_out
+        ]
+        lib.build_ray_bank.restype = None
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def build_ray_bank(
+    poses: np.ndarray,   # [n, 4, 4] float32 c2w
+    images: np.ndarray,  # [n, H, W, C] uint8 (C in {3, 4})
+    focal: float,
+    n_threads: int | None = None,
+):
+    """(rays [n·H·W, 6], rgbs [n·H·W, 3]) — native when possible, NumPy
+    fallback otherwise. Identical math to datasets.rays.get_rays_np +
+    white-compositing."""
+    n, H, W, C = images.shape
+    lib = get_lib()
+    if lib is not None:
+        poses_c = np.ascontiguousarray(poses, np.float32)
+        images_c = np.ascontiguousarray(images, np.uint8)
+        rays = np.empty((n * H * W, 6), np.float32)
+        rgbs = np.empty((n * H * W, 3), np.float32)
+        if n_threads is None:
+            n_threads = min(os.cpu_count() or 1, 8)
+        lib.build_ray_bank(
+            poses_c.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            images_c.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n, H, W, C, float(focal), int(n_threads),
+            rays.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rgbs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return rays, rgbs
+    return _build_ray_bank_numpy(poses, images, focal)
+
+
+def _build_ray_bank_numpy(poses, images, focal):
+    from ..datasets.rays import get_rays_np
+
+    n, H, W, C = images.shape
+    rays_list, rgb_list = [], []
+    for f in range(n):
+        rays_o, rays_d = get_rays_np(H, W, focal, poses[f])
+        rays_list.append(
+            np.concatenate([rays_o, rays_d], -1).reshape(-1, 6)
+        )
+        img = images[f].astype(np.float32) / 255.0
+        if C == 4:
+            img = img[..., :3] * img[..., 3:] + (1.0 - img[..., 3:])
+        rgb_list.append(img[..., :3].reshape(-1, 3).astype(np.float32))
+    return (
+        np.concatenate(rays_list, 0).astype(np.float32),
+        np.concatenate(rgb_list, 0),
+    )

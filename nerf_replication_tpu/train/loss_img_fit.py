@@ -17,12 +17,17 @@ from .loss import mse, mse_to_psnr
 
 class ImgFitRenderer:
     """Chunked full-image apply with the Renderer.render_chunked interface
-    (so Trainer.val works unchanged)."""
+    (so Trainer.val works unchanged). One jitted callable — jit's own
+    shape-keyed cache handles per-shape retracing."""
 
     def __init__(self, cfg, network):
         self.network = network
         self.chunk_size = int(cfg.task_arg.get("chunk_size", 16384))
-        self._fns = {}
+        self._apply = jax.jit(
+            lambda params, uv_p: jax.lax.map(
+                lambda c: network.apply(params, c), uv_p
+            )
+        )
 
     def render_chunked(self, params, batch: dict) -> dict:
         uv = jnp.asarray(batch["rays"])
@@ -31,23 +36,14 @@ class ImgFitRenderer:
         n_chunks = -(-n // chunk)
         pad = n_chunks * chunk - n
         uv_p = jnp.pad(uv, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 2)
-
-        fn = self._fns.get((n_chunks, chunk))
-        if fn is None:
-            network = self.network
-
-            @jax.jit
-            def fn(params, uv_p):
-                return jax.lax.map(
-                    lambda c: network.apply(params, c), uv_p
-                )
-
-            self._fns[(n_chunks, chunk)] = fn
-        rgb = fn(params, uv_p).reshape(-1, 3)[:n]
+        rgb = self._apply(params, uv_p).reshape(-1, 3)[:n]
         return {"rgb": rgb, "rgb_map_f": rgb}
 
 
 class ImgFitLoss:
+    # bound-free task: near/far are unused dummies (Trainer contract)
+    ray_bounds = (0.0, 1.0)
+
     def __init__(self, cfg, network):
         self.network = network
         self.renderer = ImgFitRenderer(cfg, network)

@@ -55,12 +55,13 @@ class Trainer:
         self.n_rays = int(
             cfg.task_arg.get("N_rays", cfg.task_arg.get("N_pixels", 1024))
         )
-        if "N_pixels" in cfg.task_arg and "near" not in cfg.task_arg:
-            # pixel-regression tasks have no ray bounds; dummies fill the slot
-            self.near, self.far = 0.0, 1.0
+        # the task plugin (loss module) declares whether it uses ray bounds:
+        # bound-free tasks set ray_bounds = (near, far) dummies; ray-marching
+        # tasks leave it unset and a missing task_arg.near fails loudly here
+        bounds = getattr(loss, "ray_bounds", None)
+        if bounds is not None and "near" not in cfg.task_arg:
+            self.near, self.far = float(bounds[0]), float(bounds[1])
         else:
-            # ray-marching tasks must say their bounds — a missing near/far
-            # here must fail loudly, not default to garbage segments
             self.near = float(cfg.task_arg.near)
             self.far = float(cfg.task_arg.far)
         self.precrop_iters = int(cfg.task_arg.get("precrop_iters", 0))

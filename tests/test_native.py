@@ -1,0 +1,75 @@
+"""Native ray-bank builder: the C++ path must agree bit-for-bit (float32
+tolerance) with the NumPy reference math, across RGBA/RGB inputs and thread
+counts, and the dataset must produce identical banks through either path."""
+
+import numpy as np
+import pytest
+
+from nerf_replication_tpu.datasets.rays import pose_spherical
+from nerf_replication_tpu.native import (
+    _build_ray_bank_numpy,
+    build_ray_bank,
+    native_available,
+)
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++ unavailable; fallback-only platform"
+)
+
+
+def _scene(n=3, H=12, W=16, channels=4, seed=0):
+    rng = np.random.default_rng(seed)
+    poses = np.stack(
+        [pose_spherical(-180 + 120 * k, -30.0, 4.0) for k in range(n)], 0
+    ).astype(np.float32)
+    images = rng.integers(0, 256, (n, H, W, channels), dtype=np.uint8)
+    return poses, images
+
+
+def test_compiles_on_this_platform():
+    # the build toolchain is baked into the image; fallback is for users
+    assert native_available()
+
+
+@needs_native
+@pytest.mark.parametrize("channels", [3, 4])
+@pytest.mark.parametrize("n_threads", [1, 4])
+def test_native_matches_numpy(channels, n_threads):
+    poses, images = _scene(channels=channels)
+    focal = 20.0
+    rays_n, rgbs_n = _build_ray_bank_numpy(poses, images, focal)
+    rays_c, rgbs_c = build_ray_bank(poses, images, focal, n_threads=n_threads)
+    np.testing.assert_allclose(rays_c, rays_n, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(rgbs_c, rgbs_n, rtol=1e-6, atol=1e-6)
+
+
+@needs_native
+def test_dataset_uses_native_path(tmp_path):
+    """Blender dataset at input_ratio=1.0 goes through the native builder and
+    yields the same bank as the per-frame Python path (input_ratio!=1 route
+    forced via a monkeypatched ratio of 1.0-epsilon is unnecessary — compare
+    against the numpy fallback directly)."""
+    from nerf_replication_tpu.datasets.blender import Dataset
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+
+    root = str(tmp_path)
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=3, n_test=1)
+    ds = Dataset(data_root=root, scene="procedural", split="train", H=16, W=16)
+
+    rays_ref, rgbs_ref = _build_ray_bank_numpy(
+        ds.poses,
+        np.stack(
+            [
+                np.asarray(
+                    __import__("imageio.v2", fromlist=["imread"]).imread(
+                        f"{root}/procedural/train/r_{k}.png"
+                    )
+                )
+                for k in range(3)
+            ],
+            0,
+        ),
+        ds.focal,
+    )
+    np.testing.assert_allclose(ds.rays, rays_ref, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(ds.rgbs, rgbs_ref, rtol=1e-6, atol=1e-6)

@@ -40,16 +40,29 @@ def _resize_area(img: np.ndarray, W: int, H: int) -> np.ndarray:
     return cv2.resize(img, (W, H), interpolation=cv2.INTER_AREA)
 
 
-def _to_uint8(img: np.ndarray) -> np.ndarray:
-    """Normalize decoded PNGs to uint8 (16-bit and float frames included,
-    which the reference's bare /255 mishandles)."""
-    if img.dtype == np.uint8:
-        return img
+def _to_rgba_uint8(img: np.ndarray) -> np.ndarray:
+    """Normalize decoded PNGs to uint8 RGBA: 16-bit/float depths rescaled
+    (the reference's bare /255 mishandles those), gray/LA expanded, RGB given
+    opaque alpha — so frames with mixed channel counts stack uniformly."""
     if img.dtype == np.uint16:
-        return (img >> 8).astype(np.uint8)
-    if np.issubdtype(img.dtype, np.floating):
-        return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
-    raise ValueError(f"unsupported image dtype {img.dtype}")
+        img = (img >> 8).astype(np.uint8)
+    elif np.issubdtype(img.dtype, np.floating):
+        img = (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+    elif img.dtype != np.uint8:
+        raise ValueError(f"unsupported image dtype {img.dtype}")
+
+    if img.ndim == 2:  # grayscale
+        img = np.repeat(img[..., None], 3, axis=-1)
+    if img.shape[-1] == 2:  # luminance + alpha
+        img = np.concatenate([np.repeat(img[..., :1], 3, axis=-1),
+                              img[..., 1:]], axis=-1)
+    if img.shape[-1] == 3:  # opaque alpha: composite is then a no-op
+        img = np.concatenate(
+            [img, np.full_like(img[..., :1], 255)], axis=-1
+        )
+    if img.shape[-1] != 4:
+        raise ValueError(f"unsupported channel count {img.shape[-1]}")
+    return img
 
 
 @dataclass
@@ -99,7 +112,7 @@ class Dataset:
             img_path = os.path.join(
                 self.data_root, self.scene, frame["file_path"] + ".png"
             )
-            img = _to_uint8(_load_image(img_path))
+            img = _to_rgba_uint8(_load_image(img_path))
             if self.input_ratio != 1.0:
                 # uint8 INTER_AREA downscale, as the reference does before
                 # the /255 float conversion (blender.py:86-87)

@@ -1,0 +1,30 @@
+"""Named dataset-path catalog.
+
+Capability parity with the reference's `src/datasets/dataset_catalog.py:1-64`
+(a registry mapping dataset names to argument dicts, imported by its
+evaluator factory). Entries carry the data_root/split/scene arguments a
+``Dataset.from_cfg`` would otherwise read from YAML.
+"""
+
+from __future__ import annotations
+
+
+class DatasetCatalog:
+    dataset_attrs: dict[str, dict] = {
+        "BlenderTrain": {
+            "data_root": "data/nerf_synthetic",
+            "split": "train",
+        },
+        "BlenderTest": {
+            "data_root": "data/nerf_synthetic",
+            "split": "test",
+        },
+    }
+
+    @classmethod
+    def get(cls, name: str) -> dict:
+        return dict(cls.dataset_attrs[name])
+
+    @classmethod
+    def register(cls, name: str, attrs: dict) -> None:
+        cls.dataset_attrs[name] = dict(attrs)

@@ -102,6 +102,10 @@ def build_ray_bank(
     fallback otherwise. Identical math to datasets.rays.get_rays_np +
     white-compositing."""
     n, H, W, C = images.shape
+    if C not in (3, 4):
+        raise ValueError(
+            f"build_ray_bank needs RGB or RGBA frames, got {C} channels"
+        )
     lib = get_lib()
     if lib is not None:
         poses_c = np.ascontiguousarray(poses, np.float32)

@@ -1,0 +1,54 @@
+"""Sequence-axis (ray-axis) parallelism: multi-chip single-image rendering.
+
+The reference has no sequence axis to parallelize — its long axis is the
+ray/sample axis, which it scales by a serial chunking loop
+(volume_renderer.py:160; SURVEY.md §5 "Long-context"). The TPU-native
+first-class treatment: shard the ray axis of ONE image across the mesh's
+``data`` axis with `shard_map` — each chip renders its ray slice through the
+full coarse+fine pipeline, and the per-chip results concatenate back on the
+host. This is the long-sequence scaling story of this framework (a 640k-ray
+image is a 640k-token sequence): compute scales linearly over ICI with no
+cross-chip traffic during the march, because volume rendering is
+embarrassingly parallel over rays — the all-gather happens once at the end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..renderer.volume import render_rays
+from .mesh import DATA_AXIS
+
+
+def build_sequence_parallel_renderer(mesh, network, options, near, far):
+    """Returns ``render(params, rays [N, 6]) -> dict`` with the ray axis
+    sharded over ``mesh``'s data axis. N is padded to the shard count."""
+    n_shards = mesh.shape[DATA_AXIS]
+
+    def shard_body(params, rays):
+        apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+            params, pts, vd, model=model
+        )
+        return render_rays(apply_fn, rays, near, far, None, options)
+
+    smap = jax.jit(
+        shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+            check_vma=False,
+        )
+    )
+
+    def render(params, rays):
+        n = rays.shape[0]
+        pad = (-n) % n_shards
+        rays_p = jnp.pad(rays, ((0, pad), (0, 0)))
+        out = smap(params, rays_p)
+        return {k: v[:n] for k, v in out.items()}
+
+    return render

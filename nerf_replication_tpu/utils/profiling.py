@@ -1,0 +1,87 @@
+"""Profiling & timing hooks.
+
+Parity with the reference's ad-hoc instrumentation (SURVEY.md §5 "Tracing /
+profiling": `perf_timer` in base_utils.py:11-59, CUDA-event timing in
+volume_renderer.py:273-275, `torch.cuda.synchronize` wall-clocks in
+run.py:35-39), plus the TPU-native additions: `jax.profiler` trace capture
+(viewable in TensorBoard/XProf) and named trace annotations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+_records: dict[str, list[float]] = defaultdict(list)
+
+
+def _sync_devices():
+    """Flush every local device's execution stream: a sentinel computation
+    enqueued per device completes only after all previously dispatched
+    programs on that device (the role of torch.cuda.synchronize,
+    run.py:35-39). jax.effects_barrier is NOT enough — it only waits for
+    effectful computations, not pure jitted work."""
+    import jax.numpy as jnp
+
+    jax.block_until_ready(
+        [jax.device_put(jnp.zeros(()), d) + 0 for d in jax.local_devices()]
+    )
+
+
+@contextlib.contextmanager
+def perf_timer(name: str, sync: bool = True, log=None):
+    """Wall-clock a block; with ``sync``, drains all in-flight device work
+    before and after so the block's device time is actually measured."""
+    if sync:
+        _sync_devices()
+    t0 = time.perf_counter()
+    yield
+    if sync:
+        _sync_devices()
+    dt = time.perf_counter() - t0
+    _records[name].append(dt)
+    if log is not None:
+        log(f"[perf] {name}: {dt:.4f}s")
+
+
+def timings(name: str | None = None):
+    """Recorded durations: one list, or all of them."""
+    if name is not None:
+        return list(_records[name])
+    return {k: list(v) for k, v in _records.items()}
+
+
+def reset_timings():
+    _records.clear()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a jax.profiler trace for the block (open with TensorBoard's
+    profile plugin / XProf)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the device timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def time_fn(fn, *args, iters: int = 10, warmup: int = 2, **kwargs) -> float:
+    """Mean seconds per call, compile excluded, device-synced
+    (run.py:15-40's `--type network` timing contract)."""
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters

@@ -109,6 +109,18 @@ def run_evaluate(cfg, args=None):
     return result
 
 
+def run_mesh(cfg, args=None):
+    """Extract the density iso-surface to a PLY mesh (the reference's
+    mesh_utils capability, driven by cfg.level / cfg.resolution)."""
+    from nerf_replication_tpu.utils.mesh import extract_mesh
+    from nerf_replication_tpu.utils.setup import load_trained_network
+
+    network, params, _ = load_trained_network(cfg)
+    path = extract_mesh(params, network, cfg)
+    print(f"mesh saved to {path}")
+    return path
+
+
 def main():
     from nerf_replication_tpu.config import cfg_from_args, make_parser
 

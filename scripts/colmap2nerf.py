@@ -1,0 +1,223 @@
+"""Convert a COLMAP reconstruction to a NeRF ``transforms.json``.
+
+Capability parity with the reference's vendored instant-ngp script
+(scripts/colmap2nerf.py:27-440): optionally run COLMAP (feature extraction,
+matching, mapping) on an image folder when the binary is present, then parse
+the text model (cameras.txt / images.txt) into camera intrinsics +
+camera-to-world poses in the NeRF convention, recentre/rescale the scene, and
+write transforms.json with per-frame sharpness scores.
+
+Written from the COLMAP text-model format spec (qw qx qy qz tx ty tz are
+world→camera); not a copy of the vendored script.
+
+    python scripts/colmap2nerf.py --images data/scene/images \
+        [--run_colmap] [--text data/scene/colmap_text] \
+        [--aabb_scale 4] [--out data/scene/transforms.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import shutil
+import subprocess
+import sys
+
+
+def qvec2rotmat(q):
+    """COLMAP (qw, qx, qy, qz) → 3×3 rotation matrix."""
+    w, x, y, z = q
+    return [
+        [1 - 2 * y * y - 2 * z * z, 2 * x * y - 2 * z * w, 2 * x * z + 2 * y * w],
+        [2 * x * y + 2 * z * w, 1 - 2 * x * x - 2 * z * z, 2 * y * z - 2 * x * w],
+        [2 * x * z - 2 * y * w, 2 * y * z + 2 * x * w, 1 - 2 * x * x - 2 * y * y],
+    ]
+
+
+def parse_cameras_txt(path):
+    """camera_id → dict(model, width, height, params)."""
+    cams = {}
+    with open(path) as f:
+        for line in f:
+            if line.startswith("#") or not line.strip():
+                continue
+            parts = line.split()
+            cams[int(parts[0])] = {
+                "model": parts[1],
+                "width": int(parts[2]),
+                "height": int(parts[3]),
+                "params": [float(p) for p in parts[4:]],
+            }
+    return cams
+
+
+def parse_images_txt(path):
+    """[(image_name, camera_id, qvec, tvec)] — every other line is 2D points."""
+    out = []
+    with open(path) as f:
+        lines = [l for l in f if not l.startswith("#")]
+    for i in range(0, len(lines) - 1, 2):
+        parts = lines[i].split()
+        if len(parts) < 10:
+            continue
+        qvec = [float(v) for v in parts[1:5]]
+        tvec = [float(v) for v in parts[5:8]]
+        out.append((parts[9], int(parts[8]), qvec, tvec))
+    return out
+
+
+def intrinsics(cam):
+    """(fl_x, fl_y, cx, cy, distortion dict) from a COLMAP camera."""
+    p = cam["params"]
+    model = cam["model"]
+    dist = {"k1": 0.0, "k2": 0.0, "p1": 0.0, "p2": 0.0}
+    if model == "SIMPLE_PINHOLE":
+        fl_x = fl_y = p[0]
+        cx, cy = p[1], p[2]
+    elif model == "PINHOLE":
+        fl_x, fl_y, cx, cy = p[0], p[1], p[2], p[3]
+    elif model == "SIMPLE_RADIAL":
+        fl_x = fl_y = p[0]
+        cx, cy = p[1], p[2]
+        dist["k1"] = p[3]
+    elif model == "RADIAL":
+        fl_x = fl_y = p[0]
+        cx, cy = p[1], p[2]
+        dist["k1"], dist["k2"] = p[3], p[4]
+    elif model == "OPENCV":
+        fl_x, fl_y, cx, cy = p[0], p[1], p[2], p[3]
+        dist["k1"], dist["k2"], dist["p1"], dist["p2"] = p[4], p[5], p[6], p[7]
+    else:
+        raise ValueError(f"unsupported COLMAP camera model {model}")
+    return fl_x, fl_y, cx, cy, dist
+
+
+def sharpness(path) -> float:
+    """Variance-of-Laplacian focus score (higher = sharper)."""
+    try:
+        import cv2
+
+        img = cv2.imread(path, cv2.IMREAD_GRAYSCALE)
+        if img is None:
+            return 0.0
+        return float(cv2.Laplacian(img, cv2.CV_64F).var())
+    except Exception:
+        return 0.0
+
+
+def world_to_camera_to_c2w(qvec, tvec):
+    """COLMAP stores world→camera; invert and flip into the NeRF/Blender
+    convention (x right, y up, camera looks down -z)."""
+    import numpy as np
+
+    R = np.asarray(qvec2rotmat(qvec))
+    t = np.asarray(tvec).reshape(3, 1)
+    w2c = np.concatenate([np.concatenate([R, t], 1), [[0, 0, 0, 1]]], 0)
+    c2w = np.linalg.inv(w2c)
+    # COLMAP camera: x right, y DOWN, z forward → negate y and z columns
+    c2w[0:3, 1] *= -1
+    c2w[0:3, 2] *= -1
+    return c2w
+
+
+def recenter_and_scale(c2ws, target_radius: float = 4.0):
+    """Translate the camera centroid to the origin and scale the average
+    camera distance to target_radius (the Blender-synthetic shell)."""
+    import numpy as np
+
+    centers = np.stack([m[:3, 3] for m in c2ws], 0)
+    centroid = centers.mean(0)
+    for m in c2ws:
+        m[:3, 3] -= centroid
+    dist = np.mean(np.linalg.norm(centers - centroid, axis=-1))
+    if dist > 1e-6:
+        s = target_radius / dist
+        for m in c2ws:
+            m[:3, 3] *= s
+    return c2ws
+
+
+def run_colmap(images_dir: str, workspace: str):
+    """Drive the COLMAP binary (feature extraction → matching → mapping →
+    text export); requires `colmap` on PATH."""
+    if shutil.which("colmap") is None:
+        raise SystemExit("colmap binary not found on PATH (drop --run_colmap)")
+    db = os.path.join(workspace, "database.db")
+    sparse = os.path.join(workspace, "sparse")
+    text = os.path.join(workspace, "text")
+    os.makedirs(sparse, exist_ok=True)
+    os.makedirs(text, exist_ok=True)
+    steps = [
+        ["colmap", "feature_extractor", "--database_path", db,
+         "--image_path", images_dir, "--ImageReader.camera_model", "OPENCV",
+         "--ImageReader.single_camera", "1"],
+        ["colmap", "exhaustive_matcher", "--database_path", db],
+        ["colmap", "mapper", "--database_path", db, "--image_path", images_dir,
+         "--output_path", sparse],
+        ["colmap", "model_converter", "--input_path",
+         os.path.join(sparse, "0"), "--output_path", text,
+         "--output_type", "TXT"],
+    ]
+    for cmd in steps:
+        subprocess.run(cmd, check=True)
+    return text
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--images", required=True, help="image folder")
+    parser.add_argument("--text", default=None,
+                        help="COLMAP text-model dir (cameras.txt/images.txt)")
+    parser.add_argument("--run_colmap", action="store_true")
+    parser.add_argument("--aabb_scale", type=int, default=4)
+    parser.add_argument("--out", default="transforms.json")
+    args = parser.parse_args(argv)
+
+    text = args.text
+    if args.run_colmap:
+        text = run_colmap(args.images, os.path.dirname(args.out) or ".")
+    if text is None:
+        raise SystemExit("need --text (or --run_colmap)")
+
+    cams = parse_cameras_txt(os.path.join(text, "cameras.txt"))
+    images = parse_images_txt(os.path.join(text, "images.txt"))
+    if not images:
+        raise SystemExit("no registered images in the COLMAP model")
+
+    cam = cams[images[0][1]]
+    fl_x, fl_y, cx, cy, dist = intrinsics(cam)
+    W, H = cam["width"], cam["height"]
+
+    c2ws = [world_to_camera_to_c2w(q, t) for _, _, q, t in images]
+    c2ws = recenter_and_scale(c2ws)
+
+    frames = []
+    for (name, _, _, _), c2w in zip(images, c2ws):
+        rel = os.path.join(os.path.basename(args.images.rstrip("/")), name)
+        frames.append(
+            {
+                "file_path": rel,
+                "sharpness": sharpness(os.path.join(args.images, name)),
+                "transform_matrix": [[float(v) for v in row] for row in c2w],
+            }
+        )
+    frames.sort(key=lambda f: f["file_path"])
+
+    out = {
+        "camera_angle_x": 2.0 * math.atan(0.5 * W / fl_x),
+        "camera_angle_y": 2.0 * math.atan(0.5 * H / fl_y),
+        "fl_x": fl_x, "fl_y": fl_y, "cx": cx, "cy": cy,
+        "w": W, "h": H,
+        **dist,
+        "aabb_scale": args.aabb_scale,
+        "frames": frames,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} with {len(frames)} frames")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

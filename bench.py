@@ -40,6 +40,7 @@ def main():
             "task_arg.precrop_iters", "0",
             # TPU-native precision: bf16 MXU matmuls, f32 params/heads/compositing
             "precision.compute_dtype", "bfloat16",
+            "task_arg.remat", os.environ.get("BENCH_REMAT", "false"),
         ],
     )
     network = make_network(cfg)

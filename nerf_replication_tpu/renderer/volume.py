@@ -40,6 +40,7 @@ class RenderOptions:
     lindisp: bool = False
     use_viewdirs: bool = True
     chunk_size: int = 8192
+    remat: bool = False  # rematerialize MLP activations in backward (HBM↓)
 
     @classmethod
     def from_cfg(cls, cfg, train: bool = True) -> "RenderOptions":
@@ -58,6 +59,7 @@ class RenderOptions:
             lindisp=bool(ta.get("lindisp", False)),
             use_viewdirs=bool(ta.get("use_viewdirs", True)),
             chunk_size=int(ta.get("chunk_size", 8192)),
+            remat=bool(ta.get("remat", False)) and train,
         )
 
 
@@ -191,6 +193,12 @@ def render_rays(
     (`rgb_map_c/f`, `depth_map_c/f`, `acc_map_c/f`)."""
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     n_rays = rays.shape[0]
+
+    if options.remat:
+        # trade FLOPs for HBM: recompute the MLP sweep during backward so
+        # the 256-wide activations of ~N·256 points are never stored —
+        # the batch-size ceiling moves from activations to the ray batch
+        apply_fn = jax.checkpoint(apply_fn, static_argnums=(2,))
 
     if key is not None:
         k_strat, k_noise_c, k_pdf, k_noise_f = jax.random.split(key, 4)

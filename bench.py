@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+import traceback
 
 import jax
 import jax.numpy as jnp
@@ -24,11 +26,105 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_RAYS_PER_SEC = 1024 / 0.222  # reference log.txt mean iter time
 
 
+def _init_backend_with_retry(
+    retries: int = 3, delay_s: float = 15.0, hang_timeout_s: float = 120.0
+):
+    """Touch the device backend, retrying on transient init failures.
+
+    Round 1's bench failed rc=1 with "Unable to initialize backend 'axon':
+    UNAVAILABLE" — the TPU tunnel can be momentarily sick. Two distinct
+    failure modes need distinct handling:
+
+    * init RAISES (UNAVAILABLE): transient — bounded retry with a stderr
+      diagnostic turns a flaky chip into a delayed number.
+    * init HANGS (tunnel wedged): a timeout must bound the wait, or the
+      whole driver time budget is eaten (round 1's rc=124).
+
+    Each probe runs in a SUBPROCESS: it can be killed on hang, its failure
+    isn't cached in this process's backend state, and (axon is monoclient)
+    it releases the tunnel on exit before the real in-process init. The
+    in-process init itself then runs in a watchdog thread with the same
+    timeout and feeds the same retry loop — a wedge or UNAVAILABLE between
+    probe exit and attach is handled, not just the probe.
+    """
+    import subprocess
+    import threading
+
+    def _attach_in_process():
+        """Bounded in-process jax.devices(): (devices|None, error|None)."""
+        result: dict = {}
+
+        def attach():
+            try:
+                result["devices"] = jax.devices()
+            except Exception as exc:
+                result["error"] = exc
+
+        t = threading.Thread(target=attach, daemon=True)
+        t.start()
+        t.join(hang_timeout_s)
+        if t.is_alive():
+            return None, RuntimeError(
+                f"in-process backend init hung >{hang_timeout_s:.0f}s"
+            )
+        return result.get("devices"), result.get("error")
+
+    last = "unknown"
+    attempt = 0
+    while attempt < retries:
+        attempt += 1
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                text=True,
+                timeout=hang_timeout_s,
+            )
+            if p.returncode == 0:
+                devices, err = _attach_in_process()
+                if devices is not None:
+                    print(
+                        f"bench: backend '{jax.default_backend()}' up, "
+                        f"{len(devices)} device(s): {devices[0].device_kind}",
+                        file=sys.stderr,
+                    )
+                    return devices
+                if isinstance(err, RuntimeError) and "hung" in str(err):
+                    # a thread stuck in backend init holds the init lock:
+                    # further in-process attempts block on it — fail fast
+                    raise err
+                last = str(err)
+            else:
+                tail = (p.stderr or p.stdout).strip().splitlines()
+                last = tail[-1] if tail else "probe exited nonzero"
+        except subprocess.TimeoutExpired:
+            last = f"backend init hung >{hang_timeout_s:.0f}s (tunnel wedged?)"
+            # a wedged tunnel rarely un-wedges in seconds; one re-probe only
+            retries = min(retries, attempt + 1)
+        print(
+            f"bench: backend probe {attempt}/{retries} failed: {last}",
+            file=sys.stderr,
+        )
+        if attempt < retries:
+            time.sleep(delay_s)
+    raise RuntimeError(f"backend unavailable after {retries} attempts: {last}")
+
+
 def main():
     from nerf_replication_tpu.config import make_cfg
     from nerf_replication_tpu.models.nerf.network import make_network
     from nerf_replication_tpu.train.loss import make_loss
     from nerf_replication_tpu.train.trainer import Trainer, make_train_state
+
+    # escape hatch for CI/smoke runs on machines whose sitecustomize pins
+    # the platform (env alone is beaten — see utils/platform.py)
+    forced = os.environ.get("BENCH_FORCE_PLATFORM", "")
+    if forced:
+        from nerf_replication_tpu.utils.platform import force_platform
+
+        force_platform(forced)
+    else:
+        _init_backend_with_retry()
 
     n_rays = int(os.environ.get("BENCH_N_RAYS", 4096))
     n_steps = int(os.environ.get("BENCH_STEPS", 50))
@@ -89,4 +185,26 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:
+        # The driver records exactly one JSON line; on unrecoverable failure
+        # emit a diagnostic line (value null) so the record is actionable
+        # rather than an opaque non-zero exit.
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": "train_rays_per_sec",
+                    "value": None,
+                    "unit": "rays/s",
+                    "vs_baseline": None,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+            )
+        )
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # hard exit: a watchdogged init thread may be wedged in C++ backend
+        # code; normal interpreter shutdown could block behind it
+        os._exit(1)

@@ -40,8 +40,14 @@ def default_cfg() -> ConfigNode:
     cfg.gpus = [0]  # accepted for config parity; device selection is JAX's
     cfg.resume = True
     cfg.pretrain = ""
-    cfg.distributed = False
+    # fix_random pins the host-side RNGs (random/np.random — dataset
+    # generation, procedural scenes); the device path is already
+    # deterministic via explicit key threading. ≙ reference train.py:25-28.
     cfg.fix_random = False
+    # NaN-anomaly switch: ≙ reference train.py:23's always-on
+    # set_detect_anomaly, opt-in here because jax_debug_nans disables jit
+    # caching benefits on the hot path.
+    cfg.debug_nans = False
     cfg.skip_eval = False
     # the reference evaluator always dumps per-view pred/gt PNGs
     # (src/evaluators/nerf.py:29-38)

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 
 import numpy as np
 
@@ -16,6 +17,8 @@ from ..utils.image import psnr, write_png
 class Evaluator:
     def __init__(self, cfg):
         self.result_dir = cfg.result_dir
+        if cfg.get("clear_result", False):
+            shutil.rmtree(self.result_dir, ignore_errors=True)
         self.psnrs: list[float] = []
 
     def evaluate(self, output: dict, batch: dict):

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 
 import numpy as np
 
@@ -22,6 +23,10 @@ class Evaluator:
     def __init__(self, cfg):
         self.result_dir = cfg.result_dir
         self.save_images = bool(cfg.get("save_result", True))
+        if cfg.get("clear_result", False):
+            # wipe stale per-view artifacts from a previous run so the dir
+            # holds exactly this evaluation's outputs
+            shutil.rmtree(self.result_dir, ignore_errors=True)
         self.psnrs: list[float] = []
         self.ssims: list[float] = []
 

@@ -1,0 +1,258 @@
+"""Pallas TPU kernel for the multiresolution hash-grid forward pass.
+
+The CUDA reference implements the encoder as hand-written kernels
+(src/models/encoding/hashencoder/src/hashencoder.cu:99-196 forward,
+254-267 backward). This is the TPU counterpart of the *forward* gather +
+D-linear MAC, built per SURVEY.md §7 step 8, with the design constraints
+Mosaic imposes:
+
+* **Layout.** A level's table slice is packed ``[R, 128·C]``: entry ``e``,
+  feature ``c`` live at row ``e // 128``, lane ``c·128 + e % 128``. The raw
+  ``[entries, C]`` layout (C=2) would waste 64× VMEM to lane padding; packed,
+  a full 2^19-entry slice is 4 MB and fits VMEM beside the point block.
+* **Grid.** ``(L, N/blk)`` — one program interpolates one level for one
+  point block. Per-level scalars (scale, resolution, hashed?, slice size)
+  ride in via ``PrefetchScalarGridSpec`` so the kernel body is one traced
+  program for all levels; the dense/hash decision is a ``jnp.where`` select
+  on uint32 index math, not control flow.
+* **Gather.** The 2^D corner loop is a static Python loop of row+lane
+  gathers from the VMEM-resident slice. Random gather is the op TPUs are
+  weakest at — whether this beats XLA's own gather lowering is an empirical
+  question, which is exactly why both paths exist behind one dispatch
+  (``use_pallas``) and one oracle test. The backward pass is NOT a kernel:
+  differentiating the pure-XLA formulation yields a segment-sum scatter-add,
+  the TPU-idiomatic equivalent of the CUDA ``atomicAdd`` backward
+  (SURVEY.md §2.2) — so the custom_vjp pairs the Pallas forward with the
+  XLA backward.
+
+Correctness: validated against ``hash_encode`` (the pure-XLA oracle) in
+``tests/test_pallas_hash.py`` under interpret mode on CPU; the TPU
+lowering + benchmark verdict is recorded in PERF.md.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .hashgrid import _PRIMES, hash_encode, level_geometry
+
+_LANES = 128
+
+
+def pack_table(
+    table: jax.Array,  # [total_entries, C]
+    offsets,  # [L+1] python ints
+) -> jax.Array:
+    """Repack the flat table into ``[L, R_max, 128·C]`` level pages.
+
+    Rows beyond a level's slice are zero — harmless, since index math never
+    reaches them (indices are mod slice size).
+    """
+    num_levels = len(offsets) - 1
+    c = table.shape[-1]
+    s_max = max(offsets[lvl + 1] - offsets[lvl] for lvl in range(num_levels))
+    r_max = -(-s_max // _LANES)
+    pages = []
+    for lvl in range(num_levels):
+        sl = table[offsets[lvl] : offsets[lvl + 1]]
+        pad = r_max * _LANES - sl.shape[0]
+        sl = jnp.pad(sl, ((0, pad), (0, 0)))
+        # [R·128, C] -> [R, 128, C] -> [R, C, 128] -> [R, C·128]
+        page = sl.reshape(r_max, _LANES, c).transpose(0, 2, 1)
+        pages.append(page.reshape(r_max, c * _LANES))
+    return jnp.stack(pages)
+
+
+def _hash_kernel(
+    scales_ref,  # f32[L]   scalar-prefetch
+    resolutions_ref,  # i32[L]
+    hashed_ref,  # i32[L]  (0/1)
+    sizes_ref,  # i32[L]  slice entry counts
+    x_ref,  # [blk, D] VMEM
+    page_ref,  # [R, C·128] VMEM — this level's packed slice
+    out_ref,  # [C, blk] VMEM — this level's features for this block
+    *,
+    input_dim: int,
+    level_dim: int,
+):
+    lvl = pl.program_id(0)
+    scale = scales_ref[lvl]
+    resolution = resolutions_ref[lvl]
+    hashed = hashed_ref[lvl]
+    hashmap_size = sizes_ref[lvl].astype(jnp.uint32)
+
+    x = x_ref[:]  # [blk, D]
+    pos = x * scale + 0.5  # cu:109
+    pos_grid = jnp.floor(pos)
+    frac = pos - pos_grid
+    pos_grid = pos_grid.astype(jnp.int32)
+
+    d = input_dim
+    c = level_dim
+    page = page_ref[:]  # [R, C·128]
+
+    acc = jnp.zeros((c, x.shape[0]), jnp.float32)
+    for corner_bits in range(1 << d):
+        # per-dim scalar offsets (Python ints — a vector constant would be
+        # a captured const, which pallas_call rejects)
+        sel = [(corner_bits >> dd) & 1 for dd in range(d)]
+
+        # dense row-major and XOR-prime hash, both computed per-dim, select
+        # by the prefetched per-level flag (static-shape, no branching)
+        dense = jnp.zeros(x.shape[:1], jnp.uint32)
+        stride = jnp.uint32(1)
+        hashv = jnp.zeros(x.shape[:1], jnp.uint32)
+        w = jnp.ones(x.shape[0], jnp.float32)
+        for dd in range(d):
+            corner_d = (pos_grid[..., dd] + sel[dd]).astype(jnp.uint32)
+            dense = dense + corner_d * stride
+            stride = stride * (resolution.astype(jnp.uint32) + 1)
+            hashv = hashv ^ (corner_d * jnp.uint32(_PRIMES[dd]))
+            w = w * (frac[..., dd] if sel[dd] else 1.0 - frac[..., dd])
+        index = jnp.where(hashed == 1, hashv, dense) % hashmap_size
+
+        row = (index // _LANES).astype(jnp.int32)  # [blk]
+        lane = (index % _LANES).astype(jnp.int32)  # [blk]
+        rows = jnp.take(page, row, axis=0)  # [blk, C·128]
+        for cc in range(c):
+            vals = jnp.take_along_axis(
+                rows, (lane + cc * _LANES)[:, None], axis=1
+            )[:, 0]
+            acc = acc.at[cc, :].add(w * vals)
+    out_ref[:] = acc
+
+
+def pallas_hash_encode(
+    x: jax.Array,  # [N, D] in [0, 1]
+    table: jax.Array,  # [total_entries, C]
+    input_dim: int,
+    num_levels: int,
+    per_level_scale: float,
+    base_resolution: int,
+    log2_hashmap_size: int,
+    block_size: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Forward-only Pallas path; same contract as ``hash_encode``."""
+    offsets, scales, resolutions, use_hash = level_geometry(
+        input_dim, num_levels, per_level_scale, base_resolution,
+        log2_hashmap_size,
+    )
+    n = x.shape[0]
+    blk = min(block_size, n)
+    n_blocks = -(-n // blk)
+    pad = n_blocks * blk - n
+    x_p = jnp.pad(x, ((0, pad), (0, 0)))
+
+    pages = pack_table(table, offsets)  # [L, R, C·128]
+    c = table.shape[-1]
+    r_max = pages.shape[1]
+
+    sizes = np.asarray(
+        [offsets[lvl + 1] - offsets[lvl] for lvl in range(num_levels)],
+        np.int32,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(num_levels, n_blocks),
+        in_specs=[
+            pl.BlockSpec((blk, input_dim), lambda l, b, *_: (b, 0)),
+            pl.BlockSpec((1, r_max, c * _LANES), lambda l, b, *_: (l, 0, 0)),
+        ],
+        # [C, blk] feature-major output block: lanes carry points (tiling-
+        # friendly); the host-side transpose below restores [N, L·C]
+        out_specs=pl.BlockSpec((1, c, blk), lambda l, b, *_: (l, 0, b)),
+    )
+
+    kernel = functools.partial(
+        _squeeze_page_kernel, input_dim=input_dim, level_dim=c
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (num_levels, c, n_blocks * blk), jnp.float32
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(
+        jnp.asarray(scales, jnp.float32),
+        jnp.asarray(resolutions, jnp.int32),
+        jnp.asarray(use_hash, jnp.int32),
+        jnp.asarray(sizes),
+        x_p.astype(jnp.float32),
+        pages.astype(jnp.float32),
+    )
+    # [L, C, N] -> [N, L·C] matching hash_encode's per-level concat
+    out = jnp.transpose(out, (2, 0, 1)).reshape(
+        n_blocks * blk, num_levels * c
+    )
+    return out[:n]
+
+
+def _squeeze_page_kernel(
+    scales_ref, resolutions_ref, hashed_ref, sizes_ref,
+    x_ref, page_ref, out_ref, *, input_dim: int, level_dim: int,
+):
+    """Adapter: drop the leading level axis the BlockSpecs carry."""
+    _hash_kernel(
+        scales_ref, resolutions_ref, hashed_ref, sizes_ref,
+        x_ref, page_ref.at[0], out_ref.at[0],
+        input_dim=input_dim, level_dim=level_dim,
+    )
+
+
+# -- custom_vjp: Pallas forward + XLA segment-sum backward -------------------
+
+
+def make_hash_encode_fn(
+    input_dim: int,
+    num_levels: int,
+    per_level_scale: float,
+    base_resolution: int,
+    log2_hashmap_size: int,
+    use_pallas: bool = False,
+    interpret: bool = False,
+):
+    """Return ``encode(x, table) -> [N, L·C]``.
+
+    ``use_pallas=False`` → the pure-XLA formulation (oracle; also its own
+    backward). ``use_pallas=True`` → Pallas forward, with the backward taken
+    from the XLA formulation's VJP (gather-transpose = scatter-add =
+    segment-sum; writing that as a second kernel would re-implement what XLA
+    already lowers idiomatically, cu:254-267 ≙ segment_sum).
+    """
+    static = dict(
+        input_dim=input_dim,
+        num_levels=num_levels,
+        per_level_scale=per_level_scale,
+        base_resolution=base_resolution,
+        log2_hashmap_size=log2_hashmap_size,
+    )
+
+    def xla_encode(x, table):
+        return hash_encode(x, table, **static)
+
+    if not use_pallas:
+        return xla_encode
+
+    @jax.custom_vjp
+    def encode(x, table):
+        return pallas_hash_encode(x, table, interpret=interpret, **static)
+
+    def fwd(x, table):
+        return encode(x, table), (x, table)
+
+    def bwd(res, g):
+        x, table = res
+        _, vjp = jax.vjp(xla_encode, x, table)
+        return vjp(g)
+
+    encode.defvjp(fwd, bwd)
+    return encode

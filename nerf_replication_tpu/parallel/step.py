@@ -43,7 +43,13 @@ def build_dp_step(
     """shard_map DP step: ``(state, bank_rays, bank_rgbs, base_key) ->
     (state, stats)`` with the bank sharded over the data axis."""
     n_data = mesh.shape[DATA_AXIS]
-    n_local = max(1, n_rays_global // n_data)
+    if n_rays_global % n_data != 0:
+        raise ValueError(
+            f"n_rays_global={n_rays_global} must divide the data axis "
+            f"({n_data}) — a silent round-down would train a different "
+            "effective batch than configured"
+        )
+    n_local = n_rays_global // n_data
 
     def body(state, bank_rays, bank_rgbs, base_key):
         # disjoint stream per (step, device-shard) — axis_index is global
@@ -80,13 +86,38 @@ def build_gspmd_step(
     """GSPMD dp×tp step: sharding constraints on the batch (data axis) and on
     params (model axis, via sharding rules); XLA derives the collectives."""
     batch_sh = data_sharding(mesh)
+    n_data = mesh.shape[DATA_AXIS]
+    if n_rays % n_data != 0:
+        raise ValueError(
+            f"n_rays={n_rays} must divide the data axis ({n_data}) — a "
+            "silent round-down would train a different effective batch "
+            "than configured"
+        )
+    n_local = n_rays // n_data
+
+    # per-shard sampling: each data-shard draws its rays from its LOCAL bank
+    # shard (disjoint RNG via the axis index). A global random gather here
+    # would make XLA materialize cross-chip collectives on the whole bank
+    # every step; tests/test_parallel.py asserts the compiled HLO carries no
+    # all-gather of the bank.
+    def _sample_local(k, bank_rays, bank_rgbs):
+        k = jax.random.fold_in(k, jax.lax.axis_index(DATA_AXIS))
+        return sample_rays(k, bank_rays, bank_rgbs, n_local)
+
+    sample_sharded = shard_map(
+        _sample_local,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
 
     def step(state, bank_rays, bank_rgbs, base_key):
         key = sample_step_key(base_key, state.step)
         k_sample, k_render = jax.random.split(key)
 
-        # one global batch, sharded over the data axis
-        rays, rgbs = sample_rays(k_sample, bank_rays, bank_rgbs, n_rays)
+        # data-sharded batch, sampled shard-locally
+        rays, rgbs = sample_sharded(k_sample, bank_rays, bank_rgbs)
         rays = jax.lax.with_sharding_constraint(rays, batch_sh)
         rgbs = jax.lax.with_sharding_constraint(rgbs, batch_sh)
 

@@ -290,6 +290,8 @@ class Renderer:
         self.occupancy_grid = None
         self.grid_bbox = None
         self._march_fns: dict = {}
+        self._march_fns_cap = 8
+        self._n_truncated = jnp.zeros((), jnp.int32)
 
     def _apply_fn(self, params):
         return lambda pts, viewdirs, model: self.network.apply(
@@ -368,11 +370,15 @@ class Renderer:
 
         from .accelerated import march_rays_accelerated
 
-        near, far = float(batch["near"]), float(batch["far"])
         rays_p, n, n_chunks, chunk = _pad_to_chunks(
             batch["rays"], self.march_options.chunk_size
         )
 
+        # near/far ARE jit-static here — they set the march-step count, a
+        # static shape — so they belong in the cache key; the LRU cap keeps
+        # per-frame-varying bounds from growing the executable cache
+        # without bound
+        near, far = float(batch["near"]), float(batch["far"])
         cache_key = (n_chunks, chunk, near, far)
         fn = self._march_fns.get(cache_key)
         if fn is None:
@@ -391,17 +397,31 @@ class Renderer:
                     rays_p,
                 )
 
+            while len(self._march_fns) >= self._march_fns_cap:
+                self._march_fns.pop(next(iter(self._march_fns)))
             self._march_fns[cache_key] = fn
+        else:
+            self._march_fns[cache_key] = self._march_fns.pop(cache_key)  # LRU
 
         out = fn(params, rays_p, self.occupancy_grid, self.grid_bbox)
-        n_truncated = int(jnp.sum(out.pop("n_truncated")))
+        # accumulate the truncation diagnostic ON DEVICE — a host sync here
+        # would serialize per-image dispatch (ADVICE r1); callers read it
+        # once per eval via report_truncation()
+        self._n_truncated = self._n_truncated + jnp.sum(out.pop("n_truncated"))
+        return _unpad_outputs(out, n)
+
+    def report_truncation(self, log=print) -> int:
+        """One host sync: total rays (since last call) that exhausted the
+        max_march_samples budget while still transparent."""
+        n_truncated = int(self._n_truncated)
+        self._n_truncated = jnp.zeros((), jnp.int32)
         if n_truncated:
-            print(
+            log(
                 f"render_accelerated: {n_truncated} rays exceeded the "
                 f"max_march_samples={self.march_options.max_samples} budget "
                 f"while still transparent (far contributions truncated)"
             )
-        return _unpad_outputs(out, n)
+        return n_truncated
 
 
 def make_renderer(cfg, network) -> Renderer:

@@ -84,7 +84,10 @@ class Trainer:
         process_index = self.process_index
         near, far, loss = self.near, self.far, self.loss
 
-        @jax.jit
+        # donate the state: params + adam moments update in place instead of
+        # allocating fresh buffers every step (the sharded builders already
+        # donate; the single-chip flagship path must too)
+        @partial(jax.jit, donate_argnums=(0,))
         def step_fn(state, bank_rays, bank_rgbs, base_key, *pool):
             key = sample_step_key(base_key, state.step, process_index)
             k_sample, k_render = jax.random.split(key)
@@ -194,13 +197,16 @@ def fit(cfg, network=None, log=print):
     cfg, resume if available, run the epoch loop with save/eval cadence."""
     from ..datasets import make_dataset
     from ..evaluators import make_evaluator
+    from ..parallel.collectives import barrier
     from ..parallel.mesh import is_chief, multihost_init
     from ..registry import load_attr
+    from ..utils.setup import configure_runtime
     from .recorder import make_recorder
 
     # multi-host runtime first (parity: NCCL process-group init,
     # reference train.py:116-120)
     multihost_init(cfg)
+    configure_runtime(cfg)
 
     if network is None:
         from ..models import make_network
@@ -251,12 +257,19 @@ def fit(cfg, network=None, log=print):
             log=log,
         )
         chief = is_chief()
-        if chief and (epoch + 1) % save_ep == 0:
-            save_model(cfg.trained_model_dir, state, epoch,
-                       recorder.state_dict(), latest=False)
-        if chief and (epoch + 1) % save_latest_ep == 0:
-            save_model(cfg.trained_model_dir, state, epoch,
-                       recorder.state_dict(), latest=True)
+        saving = (epoch + 1) % save_ep == 0 or (epoch + 1) % save_latest_ep == 0
+        if saving:
+            # bracket chief-only saves with barriers so a non-chief process
+            # (or a shared-FS reader resuming from `latest`) can never
+            # observe a half-written bundle
+            barrier("pre_save")
+            if chief and (epoch + 1) % save_ep == 0:
+                save_model(cfg.trained_model_dir, state, epoch,
+                           recorder.state_dict(), latest=False)
+            if chief and (epoch + 1) % save_latest_ep == 0:
+                save_model(cfg.trained_model_dir, state, epoch,
+                           recorder.state_dict(), latest=True)
+            barrier("post_save")
         # chief-only: validation renders/writes artifacts on one process
         # (the reference runs val on rank 0 only, train.py:84-85)
         if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:

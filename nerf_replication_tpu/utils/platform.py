@@ -1,0 +1,40 @@
+"""Force the JAX platform on machines whose sitecustomize pins it at boot.
+
+This machine's axon sitecustomize calls ``jax.config.update("jax_platforms",
+...)`` at interpreter start, which BEATS the ``JAX_PLATFORMS`` env var — so
+selecting the virtual-CPU platform (for tests, the driver's multi-chip
+dryrun, or CI smoke runs) requires updating the config AFTER importing jax,
+before any backend touch. One shared implementation; tests/conftest.py,
+__graft_entry__.py, and bench.py all route through it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_platform(platform: str = "cpu", device_count: int | None = None) -> None:
+    """Pin the JAX platform (and, for cpu, the virtual device count).
+
+    Must run before the process touches any JAX backend; the XLA flag is
+    read once at backend init. An existing
+    ``--xla_force_host_platform_device_count`` flag is REWRITTEN, not kept:
+    a stale count from the environment (or an earlier caller) would
+    silently validate a different topology than requested.
+    """
+    os.environ["JAX_PLATFORMS"] = platform
+    if device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        new = f"--xla_force_host_platform_device_count={device_count}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", new, flags
+            )
+        else:
+            flags = (flags + " " + new).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", platform)

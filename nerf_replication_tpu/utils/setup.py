@@ -7,7 +7,28 @@ run.py:54-58, occupancy_grid.py:16-18, render_video.py:24-27).
 
 from __future__ import annotations
 
+import random
+
 import jax
+import numpy as np
+
+
+def configure_runtime(cfg) -> None:
+    """Apply the config's debug/determinism switches to the JAX runtime.
+
+    Parity with the reference's train.py:23-28: ``debug_nans`` is the
+    NaN-anomaly detector (``set_detect_anomaly``, always-on there, opt-in
+    here — it re-checks every primitive's output and costs throughput);
+    ``fix_random`` pins the host-side RNGs the way cudnn.deterministic +
+    global seeding does there. The device path needs no switch: explicit
+    key threading already makes it deterministic and resumable.
+    """
+    if cfg.get("debug_nans", False):
+        jax.config.update("jax_debug_nans", True)
+    if cfg.get("fix_random", False):
+        seed = int(cfg.get("seed", 0))
+        random.seed(seed)
+        np.random.seed(seed)
 
 
 def load_trained_network(cfg, verbose: bool = True):

@@ -51,6 +51,7 @@ def render_360_video(cfg, args=None):
         rgb = np.clip(np.asarray(out[key]).reshape(H, W, 3), 0.0, 1.0)
         frames.append((rgb * 255).astype(np.uint8))
 
+    renderer.report_truncation()
     os.makedirs(cfg.result_dir, exist_ok=True)
     out_path = _write_video(os.path.join(cfg.result_dir, "video"), frames)
     print(f"video saved to {out_path}")
@@ -78,7 +79,12 @@ def _write_video(base_path: str, frames: list[np.ndarray]) -> str:
     import imageio.v2 as imageio
 
     path = base_path + ".gif"
-    imageio.mimsave(path, frames, duration=1.0 / FPS)  # seconds per frame
+    # imageio >= 2.28 (Pillow plugin) interprets GIF frame duration in
+    # MILLIseconds; older releases used seconds. Dispatch on version so the
+    # fallback GIF actually plays at FPS either way.
+    ver = tuple(int(v) for v in imageio.__version__.split(".")[:2])
+    duration = 1000.0 / FPS if ver >= (2, 28) else 1.0 / FPS
+    imageio.mimsave(path, frames, duration=duration, loop=0)
     return path
 
 

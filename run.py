@@ -101,6 +101,7 @@ def run_evaluate(cfg, args=None):
         evaluator.evaluate(out, batch)
 
     result = evaluator.summarize()
+    renderer.report_truncation()
     times = net_times[1:] if len(net_times) > 1 else net_times
     print(
         f"mean net_time: {np.mean(times):.4f}s  fps: {1.0 / np.mean(times):.3f}"
@@ -126,6 +127,9 @@ def main():
 
     args = make_parser().parse_args()
     cfg = cfg_from_args(args)
+    from nerf_replication_tpu.utils.setup import configure_runtime
+
+    configure_runtime(cfg)
     fn = globals().get("run_" + args.type)
     if fn is None:
         known = sorted(

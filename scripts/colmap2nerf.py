@@ -54,17 +54,31 @@ def parse_cameras_txt(path):
 
 
 def parse_images_txt(path):
-    """[(image_name, camera_id, qvec, tvec)] — every other line is 2D points."""
+    """[(image_name, camera_id, qvec, tvec)].
+
+    COLMAP's format is 2 lines per image where the second (2D points) line
+    may be legitimately EMPTY — so blank lines can't be filtered wholesale
+    (that desyncs the pairing) nor kept wholesale (a stray blank between
+    records desyncs it the other way). Mirror colmap's own reader: skip
+    blank/comment lines only while LOOKING FOR an image line, then consume
+    the immediately following line (whatever it holds) as the points line.
+    """
     out = []
     with open(path) as f:
-        lines = [l for l in f if not l.startswith("#")]
-    for i in range(0, len(lines) - 1, 2):
-        parts = lines[i].split()
+        lines = f.read().splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
         if len(parts) < 10:
             continue
         qvec = [float(v) for v in parts[1:5]]
         tvec = [float(v) for v in parts[5:8]]
         out.append((parts[9], int(parts[8]), qvec, tvec))
+        i += 1  # the 2D-points partner line, possibly empty
     return out
 
 

@@ -6,23 +6,20 @@ Must run before anything imports jax, hence env mutation at conftest import.
 """
 
 import os
+import sys
 
 # Force-override: the machine environment pins JAX to the real TPU tunnel
-# (axon, which is monoclient) — tests must never attach to it. The axon
-# sitecustomize calls jax.config.update("jax_platforms", "axon,cpu") at
-# interpreter boot, which beats env vars, so we must update the config AFTER
-# importing jax, not just set JAX_PLATFORMS.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# (axon, which is monoclient) — tests must never attach to it. The shared
+# helper updates the config AFTER importing jax (env vars alone are beaten
+# by the sitecustomize — see utils/platform.py).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-import jax  # noqa: E402
+from nerf_replication_tpu.utils.platform import force_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_platform("cpu", device_count=8)
+
+import jax  # noqa: E402
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

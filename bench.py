@@ -134,8 +134,8 @@ def main():
         [
             "task_arg.N_rays", str(n_rays),
             "task_arg.precrop_iters", "0",
-            # TPU-native precision: bf16 MXU matmuls, f32 params/heads/compositing
-            "precision.compute_dtype", "bfloat16",
+            # TPU-native default: bf16 MXU matmuls, f32 params/heads/compositing
+            "precision.compute_dtype", os.environ.get("BENCH_DTYPE", "bfloat16"),
             "task_arg.remat", os.environ.get("BENCH_REMAT", "false"),
         ],
     )

@@ -1,8 +1,17 @@
 """Dataset factory: resolves the ``*_dataset_module`` plugin key.
 
 Parity with the reference's `make_data_loader` (src/datasets/make_dataset.py:
-73-100); the returned object is a Dataset exposing the ray-bank/TPU contract
-rather than a torch DataLoader (see datasets.blender module docstring).
+73-100). Two data paths exist by design (SURVEY.md §7):
+
+* :func:`make_dataset` — the TPU hot path: a Dataset exposing ``ray_bank()``
+  for on-device sampling inside the jitted step. No loader object.
+* :func:`make_data_loader` — the host-side loader contract for the CLI/debug
+  workflow and image-shaped tasks: sampler selection (random/sequential/
+  distributed), batch-sampler selection (``default``/``image_size`` via
+  ``cfg.train.batch_sampler`` + ``sampler_meta``), ``ep_iter`` iteration
+  capping, a named-collator registry, and thread prefetch honoring
+  ``num_workers`` (threads, not processes — the work is NumPy slicing, and
+  fork-per-batch is the overhead the reference pays 0.2 s/iter for).
 """
 
 from __future__ import annotations
@@ -14,6 +23,111 @@ def make_dataset(cfg, split: str = "train"):
     key = "train_dataset_module" if split == "train" else "test_dataset_module"
     dataset_cls = load_attr(cfg[key], "Dataset")
     return dataset_cls.from_cfg(cfg, split)
+
+
+class DataLoader:
+    """Minimal iterable: batch sampler → __getitem__ → collate, with an
+    optional ``num_workers``-thread prefetch pipeline.
+
+    Batch entries are passed to ``dataset[entry]`` verbatim — plain indices
+    from the default sampler, ``(index, h, w)`` tuples from the image_size
+    sampler (the reference's dataset-side resize contract).
+    """
+
+    def __init__(self, dataset, batch_sampler, collate, num_workers: int = 0):
+        self.dataset = dataset
+        self.batch_sampler = batch_sampler
+        self.collate = collate
+        self.num_workers = int(num_workers)
+
+    def _load(self, batch):
+        return self.collate([self.dataset[entry] for entry in batch])
+
+    def __iter__(self):
+        if self.num_workers <= 0:
+            for batch in self.batch_sampler:
+                yield self._load(batch)
+            return
+        # bounded prefetch: at most num_workers batches in flight —
+        # Executor.map would submit the WHOLE sampler eagerly and buffer
+        # every finished batch regardless of consumer speed (OOM on long
+        # full-image iterations)
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            window: deque = deque()
+            it = iter(self.batch_sampler)
+            try:
+                for batch in it:
+                    window.append(pool.submit(self._load, batch))
+                    if len(window) >= self.num_workers:
+                        yield window.popleft().result()
+                while window:
+                    yield window.popleft().result()
+            finally:
+                for fut in window:
+                    fut.cancel()
+
+    def __len__(self):
+        return len(self.batch_sampler)
+
+
+def make_data_loader(cfg, split: str = "train", is_distributed: bool = False,
+                     max_iter: int = -1):
+    """Reference-shaped loader factory (make_dataset.py:73-100)."""
+    import jax
+
+    from .collate import make_collator
+    from .samplers import (
+        BatchSampler,
+        DistributedSampler,
+        ImageSizeBatchSampler,
+        IterationBasedBatchSampler,
+        RandomSampler,
+        SequentialSampler,
+    )
+
+    dataset = make_dataset(cfg, split)
+    node = cfg.train if split == "train" else cfg.test
+    n = dataset.n_images if hasattr(dataset, "n_images") else len(dataset)
+
+    shuffle = bool(node.get("shuffle", split == "train"))
+    seed = int(cfg.get("seed", 0))
+    if is_distributed:
+        sampler = DistributedSampler(
+            n, jax.process_index(), jax.process_count(), seed=seed,
+            shuffle=shuffle,
+        )
+    elif shuffle:
+        sampler = RandomSampler(n, seed=seed)
+    else:
+        sampler = SequentialSampler(n)
+
+    batch_size = int(node.get("batch_size", 1))
+    kind = str(node.get("batch_sampler", "default"))
+    if kind == "image_size":
+        meta = node.get("sampler_meta", {}) or {}
+        batch_sampler = ImageSizeBatchSampler(
+            sampler, batch_size,
+            min_hw=tuple(meta.get("min_hw", (256, 256))),
+            max_hw=tuple(meta.get("max_hw", (480, 640))),
+            divisor=int(meta.get("strides", 32)),
+            seed=seed,
+        )
+    else:
+        batch_sampler = BatchSampler(sampler, batch_size)
+
+    if max_iter == -1:
+        ep_iter = int(cfg.get("ep_iter", -1))
+        max_iter = ep_iter if split == "train" else -1
+    if max_iter > 0:
+        batch_sampler = IterationBasedBatchSampler(batch_sampler, max_iter)
+
+    return DataLoader(
+        dataset, batch_sampler, make_collator(cfg, split),
+        num_workers=int(node.get("num_workers", 0)),
+    )
 
 
 from . import rays, sampling  # noqa: E402,F401  (re-export submodules)

@@ -11,23 +11,70 @@ from __future__ import annotations
 import numpy as np
 
 
-def get_rays_np(H: int, W: int, focal: float, c2w: np.ndarray):
+def get_rays_np(
+    H: int,
+    W: int,
+    focal: float,
+    c2w: np.ndarray,
+    fl_y: float | None = None,
+    cx: float | None = None,
+    cy: float | None = None,
+):
     """Ray origins/directions for every pixel of an HxW pinhole image.
 
     Returns ``(rays_o, rays_d)`` each ``[H, W, 3]`` float32. Directions are
     *not* normalized (matching the reference; `raw2outputs` multiplies sample
     distances by ``|d|``, volume_renderer.py:27).
+
+    The Blender-synthetic case passes only ``focal`` (principal point at the
+    image center, square pixels). Real captures carry calibrated
+    ``fl_x/fl_y/cx/cy`` (colmap2nerf output) — pass them for off-center /
+    anisotropic intrinsics.
     """
     c2w = np.asarray(c2w, dtype=np.float32)
+    fl_y = focal if fl_y is None else fl_y
+    cx = 0.5 * W if cx is None else cx
+    cy = 0.5 * H if cy is None else cy
     i, j = np.meshgrid(
         np.arange(W, dtype=np.float32), np.arange(H, dtype=np.float32), indexing="xy"
     )
     dirs = np.stack(
-        [(i - 0.5 * W) / focal, -(j - 0.5 * H) / focal, -np.ones_like(i)], axis=-1
+        [(i - cx) / focal, -(j - cy) / fl_y, -np.ones_like(i)], axis=-1
     )
     rays_d = dirs @ c2w[:3, :3].T
     rays_o = np.broadcast_to(c2w[:3, 3], rays_d.shape).copy()
     return rays_o.astype(np.float32), rays_d.astype(np.float32)
+
+
+def ndc_rays_np(
+    H: int, W: int, focal: float, near: float,
+    rays_o: np.ndarray, rays_d: np.ndarray,
+    fl_y: float | None = None,
+):
+    """Shift rays into normalized device coordinates (forward-facing scenes).
+
+    The original NeRF's LLFF treatment (Mildenhall et al. 2020, appendix C):
+    move origins to the near plane, then apply the perspective projection so
+    the frustum maps to the [-1,1] cube and sampling t∈[0,1] sweeps
+    near→infinity. The reference names this capability in BASELINE.json
+    ("LLFF forward-facing, NDC rays") but never implements it.
+    """
+    fl_y = focal if fl_y is None else fl_y  # anisotropic pixels: y uses fl_y
+    o, d = rays_o.astype(np.float64), rays_d.astype(np.float64)
+    # shift each origin onto the z = -near plane
+    t = -(near + o[..., 2]) / d[..., 2]
+    o = o + t[..., None] * d
+
+    o0 = -focal / (0.5 * W) * o[..., 0] / o[..., 2]
+    o1 = -fl_y / (0.5 * H) * o[..., 1] / o[..., 2]
+    o2 = 1.0 + 2.0 * near / o[..., 2]
+    d0 = -focal / (0.5 * W) * (d[..., 0] / d[..., 2] - o[..., 0] / o[..., 2])
+    d1 = -fl_y / (0.5 * H) * (d[..., 1] / d[..., 2] - o[..., 1] / o[..., 2])
+    d2 = -2.0 * near / o[..., 2]
+
+    rays_o = np.stack([o0, o1, o2], axis=-1).astype(np.float32)
+    rays_d = np.stack([d0, d1, d2], axis=-1).astype(np.float32)
+    return rays_o, rays_d
 
 
 def trans_t(t: float) -> np.ndarray:

@@ -1,0 +1,225 @@
+"""Real-capture dataset: a single colmap2nerf ``transforms.json`` → ray bank.
+
+Closes the capture→train loop the reference only gestures at: its vendored
+converter (reference scripts/colmap2nerf.py:332-440) writes instant-ngp-style
+transforms that nothing in its own tree consumes. Here
+``scripts/colmap2nerf.py`` output is directly trainable:
+
+* ONE ``transforms.json`` for the whole capture (no ``transforms_{split}``
+  files): the split is derived by holdout — every ``test_hold``-th frame is
+  test, the rest train (the LLFF ``llffhold=8`` convention).
+* calibrated intrinsics ``fl_x/fl_y/cx/cy`` + ``w/h`` at the top level
+  (or per frame — per-frame keys win when present), instead of
+  ``camera_angle_x``; principal point and anisotropic focal are honored in
+  ray generation (rays.py:get_rays_np).
+* ``file_path`` entries carry their real extension and are used verbatim
+  (blender.py appends ``.png`` — real captures are usually jpg).
+* optional **NDC rays** for forward-facing captures (``ndc: true``):
+  origins moved to the near plane and projected so t∈[0,1] sweeps
+  near→infinity (rays.py:ndc_rays_np); ray-space near/far become 0/1
+  regardless of cfg, matching the original NeRF's LLFF treatment.
+
+Lens distortion (k1/k2/p1/p2) is recorded by the converter but NOT applied
+here — matching instant-ngp's loader behavior of treating mildly-distorted
+captures as pinhole unless images are pre-undistorted (colmap's
+``image_undistorter`` is the supported path for heavy distortion).
+
+Same contract as datasets.blender.Dataset: ``ray_bank()`` for on-device
+sampling, ``image_batch(i)`` for eval, registry-loadable via
+``train_dataset_module: nerf_replication_tpu.datasets.real``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .blender import _load_image, _resize_area, _to_rgba_uint8
+from .rays import get_rays_np, ndc_rays_np
+
+
+@dataclass
+class Dataset:
+    data_root: str
+    scene: str = ""
+    split: str = "train"
+    transforms: str = "transforms.json"
+    test_hold: int = 8
+    input_ratio: float = 1.0
+    ndc: bool = False
+    near: float = 2.0
+    far: float = 6.0
+
+    H: int = field(init=False)
+    W: int = field(init=False)
+    focal: float = field(init=False)
+    rays: np.ndarray = field(init=False)
+    rgbs: np.ndarray = field(init=False)
+    poses: np.ndarray = field(init=False)
+    n_images: int = field(init=False)
+
+    def _transforms_path(self) -> str:
+        cands = [
+            os.path.join(self.data_root, self.scene, self.transforms),
+            os.path.join(self.data_root, self.transforms),
+        ]
+        for c in cands:
+            if os.path.exists(c):
+                return c
+        raise FileNotFoundError(
+            f"no {self.transforms} under {self.data_root!r} "
+            f"(scene={self.scene!r}); run scripts/colmap2nerf.py first"
+        )
+
+    def __post_init__(self):
+        path = self._transforms_path()
+        base = os.path.dirname(path)
+        with open(path, "r") as f:
+            meta = json.load(f)
+
+        frames = meta["frames"]
+        hold = max(int(self.test_hold), 1)
+        test_idx = set(range(0, len(frames), hold))
+        if self.split == "train":
+            frames = [f for i, f in enumerate(frames) if i not in test_idx]
+        else:
+            frames = [f for i, f in enumerate(frames) if i in test_idx]
+        if not frames:
+            raise ValueError(
+                f"split={self.split!r} with test_hold={hold} selected no "
+                f"frames out of {len(meta['frames'])}"
+            )
+
+        def intr(frame, key, default=None):
+            # per-frame intrinsics win over capture-level (instant-ngp allows
+            # both layouts; ours writes capture-level)
+            v = frame.get(key, meta.get(key, default))
+            if v is None:
+                raise KeyError(f"transforms.json lacks intrinsic {key!r}")
+            return float(v)
+
+        W0 = int(meta.get("w", 0)) or None
+        H0 = int(meta.get("h", 0)) or None
+
+        images, pose_list, ray_o, ray_d = [], [], [], []
+        for frame in frames:
+            fp = frame["file_path"]
+            root, ext = os.path.splitext(fp)
+            if not ext:  # blender-style extensionless path
+                fp = fp + ".png"
+            img = _to_rgba_uint8(_load_image(os.path.join(base, fp)))
+            h, w = img.shape[:2]
+            if H0 is None:
+                H0, W0 = h, w
+            fl_x = intr(frame, "fl_x")
+            fl_y = intr(frame, "fl_y", fl_x)
+            cx = intr(frame, "cx", 0.5 * W0)
+            cy = intr(frame, "cy", 0.5 * H0)
+
+            H = int(H0 * self.input_ratio)
+            W = int(W0 * self.input_ratio)
+            if (h, w) != (H, W):
+                img = _resize_area(img, W, H)
+            r = self.input_ratio
+            c2w = np.asarray(frame["transform_matrix"], dtype=np.float32)
+            o, d = get_rays_np(
+                H, W, fl_x * r, c2w, fl_y=fl_y * r, cx=cx * r, cy=cy * r
+            )
+            if self.ndc:
+                # NDC wants the pre-projection focals of THIS capture
+                o, d = ndc_rays_np(
+                    H, W, fl_x * r, 1.0, o, d, fl_y=fl_y * r
+                )
+            ray_o.append(o.reshape(-1, 3))
+            ray_d.append(d.reshape(-1, 3))
+            images.append(img)
+            pose_list.append(c2w)
+
+        self.H, self.W = int(H0 * self.input_ratio), int(W0 * self.input_ratio)
+        self.focal = intr(frames[0], "fl_x") * self.input_ratio
+        self.poses = np.stack(pose_list, 0)
+        self.n_images = len(frames)
+        if self.ndc:
+            self.near, self.far = 0.0, 1.0
+
+        rgba = np.stack(images, 0).astype(np.float32) / 255.0
+        # white-background compositing, as the blender bank builder does
+        rgb = rgba[..., :3] * rgba[..., 3:4] + (1.0 - rgba[..., 3:4])
+        self.rays = np.concatenate(
+            [np.concatenate(ray_o, 0), np.concatenate(ray_d, 0)], axis=-1
+        ).astype(np.float32)
+        self.rgbs = rgb.reshape(-1, 3).astype(np.float32)
+
+    @classmethod
+    def from_cfg(cls, cfg, split: str) -> "Dataset":
+        node = cfg.train_dataset if split == "train" else cfg.test_dataset
+        ndc = bool(node.get("ndc", cfg.task_arg.get("ndc", False)))
+        if ndc:
+            near = float(cfg.task_arg.get("near", 2.0))
+            far = float(cfg.task_arg.get("far", 6.0))
+            if near != 0.0 or far != 1.0:
+                # the Trainer samples cfg.task_arg bounds — a mismatch would
+                # silently place every sample outside the NDC [0,1] frustum
+                raise ValueError(
+                    "ndc=true requires task_arg.near: 0.0 and task_arg.far: "
+                    f"1.0 (got near={near}, far={far}); see "
+                    "configs/real/capture_ndc.yaml"
+                )
+        return cls(
+            data_root=node.data_root,
+            scene=cfg.scene,
+            split=node.get("split", split),
+            transforms=node.get("transforms", "transforms.json"),
+            test_hold=int(node.get("test_hold", 8)),
+            input_ratio=float(node.get("input_ratio", 1.0)),
+            ndc=ndc,
+            near=float(cfg.task_arg.get("near", 2.0)),
+            far=float(cfg.task_arg.get("far", 6.0)),
+        )
+
+    # ---- shared dataset contract ------------------------------------------
+    def ray_bank(self):
+        return self.rays, self.rgbs
+
+    def precrop_index_pool(self, precrop_frac: float) -> np.ndarray:
+        H, W, n = self.H, self.W, self.n_images
+        dH = int(H // 2 * precrop_frac)
+        dW = int(W // 2 * precrop_frac)
+        rows = np.arange(H // 2 - dH, H // 2 + dH)
+        cols = np.arange(W // 2 - dW, W // 2 + dW)
+        rr, cc = np.meshgrid(rows, cols, indexing="ij")
+        per_image = (rr * W + cc).reshape(-1)
+        offsets = np.arange(n, dtype=np.int64)[:, None] * (H * W)
+        return (offsets + per_image[None, :]).reshape(-1)
+
+    def __len__(self) -> int:
+        if self.split == "train":
+            return 1_000_000
+        return self.n_images
+
+    def image_batch(self, index: int) -> dict:
+        n_pix = self.H * self.W
+        sl = slice(index * n_pix, (index + 1) * n_pix)
+        return {
+            "rays": self.rays[sl],
+            "rgbs": self.rgbs[sl],
+            "near": np.float32(self.near),
+            "far": np.float32(self.far),
+            "i": index,
+            "meta": {"H": self.H, "W": self.W, "focal": self.focal},
+        }
+
+    def __getitem__(self, index: int) -> dict:
+        if self.split == "train":
+            idx = np.random.randint(0, self.rays.shape[0], size=(1024,))
+            return {
+                "rays": self.rays[idx],
+                "rgbs": self.rgbs[idx],
+                "near": np.float32(self.near),
+                "far": np.float32(self.far),
+                "i": index,
+            }
+        return self.image_batch(index)

@@ -151,6 +151,44 @@ def make_network(cfg) -> Network:
     )
 
 
+def load_weights_from_keras(params, weights, model: str = "coarse"):
+    """Import an original-NeRF Keras checkpoint (the flat weight list the
+    reference consumes, network.py:76-123) into one MLP branch.
+
+    The Keras list interleaves [kernel, bias] per layer: D trunk layers,
+    then feature_linear (index 2D), views_linear (2D+2), rgb_linear (2D+4),
+    alpha_linear (2D+6). Keras kernels are stored ``[in, out]`` — exactly
+    Flax's layout, so unlike the torch reference no transpose is needed.
+    Returns a NEW params pytree; requires use_viewdirs layout.
+    """
+    import numpy as np
+
+    branch = dict(params["params"][model])
+    d = sum(1 for k in branch if k.startswith("pts_linear_"))
+
+    def pair(name, idx):
+        kernel = np.asarray(weights[idx])
+        bias = np.asarray(weights[idx + 1]).reshape(-1)
+        have = branch[name]["kernel"].shape
+        if tuple(kernel.shape) != tuple(have):
+            raise ValueError(
+                f"{name}: keras weight {kernel.shape} != param {have} "
+                "(check D/W/skips match the checkpoint)"
+            )
+        return {"kernel": jnp.asarray(kernel), "bias": jnp.asarray(bias)}
+
+    for i in range(d):
+        branch[f"pts_linear_{i}"] = pair(f"pts_linear_{i}", 2 * i)
+    branch["feature_linear"] = pair("feature_linear", 2 * d)
+    branch["views_linear_0"] = pair("views_linear_0", 2 * d + 2)
+    branch["rgb_linear"] = pair("rgb_linear", 2 * d + 4)
+    branch["alpha_linear"] = pair("alpha_linear", 2 * d + 6)
+
+    new_params = dict(params["params"])
+    new_params[model] = branch
+    return {"params": new_params}
+
+
 def init_params(network: Network, key: jax.Array):
     """Initialize both MLPs' parameters with dummy point/dir batches.
 

@@ -20,11 +20,32 @@ PHI_DEG = -30.0
 RADIUS = 4.0
 
 
-def render_360_video(cfg, args=None):
-    from tqdm import tqdm
-
-    from nerf_replication_tpu.datasets import make_dataset
+def spiral_frames(renderer, params, H, W, focal, near, far, n_frames=N_FRAMES,
+                  phi_deg=PHI_DEG, radius=RADIUS, progress=True):
+    """Render the 360° spiral as a list of uint8 [H, W, 3] frames."""
     from nerf_replication_tpu.datasets.rays import get_rays_np, pose_spherical
+
+    thetas = np.linspace(-180.0, 180.0, n_frames, endpoint=False)
+    if progress:
+        from tqdm import tqdm
+
+        thetas = tqdm(thetas, desc="Rendering video")
+    frames = []
+    for theta in thetas:
+        c2w = pose_spherical(float(theta), phi_deg, radius)
+        rays_o, rays_d = get_rays_np(H, W, focal, c2w)
+        rays = np.concatenate([rays_o, rays_d], -1).reshape(-1, 6)
+        batch = {"rays": rays, "near": np.float32(near), "far": np.float32(far)}
+        out = renderer.render_accelerated(params, batch)
+        key = "rgb_map_f" if "rgb_map_f" in out else "rgb_map_c"
+        rgb = np.clip(np.asarray(out[key]).reshape(H, W, 3), 0.0, 1.0)
+        frames.append((rgb * 255).astype(np.uint8))
+    renderer.report_truncation()
+    return frames
+
+
+def render_360_video(cfg, args=None):
+    from nerf_replication_tpu.datasets import make_dataset
     from nerf_replication_tpu.renderer import make_renderer
     from nerf_replication_tpu.renderer.occupancy import default_grid_path
     from nerf_replication_tpu.utils.setup import load_trained_network
@@ -35,23 +56,11 @@ def render_360_video(cfg, args=None):
         renderer.load_occupancy_grid(default_grid_path(args.cfg_file))
 
     test_ds = make_dataset(cfg, "test")
-    H, W, focal = test_ds.H, test_ds.W, test_ds.focal
-    near, far = np.float32(test_ds.near), np.float32(test_ds.far)
-
-    n_frames = int(cfg.task_arg.get("video_frames", N_FRAMES))
-    thetas = np.linspace(-180.0, 180.0, n_frames, endpoint=False)
-    frames = []
-    for theta in tqdm(thetas, desc="Rendering video"):
-        c2w = pose_spherical(float(theta), PHI_DEG, RADIUS)
-        rays_o, rays_d = get_rays_np(H, W, focal, c2w)
-        rays = np.concatenate([rays_o, rays_d], -1).reshape(-1, 6)
-        batch = {"rays": rays, "near": near, "far": far}
-        out = renderer.render_accelerated(params, batch)
-        key = "rgb_map_f" if "rgb_map_f" in out else "rgb_map_c"
-        rgb = np.clip(np.asarray(out[key]).reshape(H, W, 3), 0.0, 1.0)
-        frames.append((rgb * 255).astype(np.uint8))
-
-    renderer.report_truncation()
+    frames = spiral_frames(
+        renderer, params, test_ds.H, test_ds.W, test_ds.focal,
+        test_ds.near, test_ds.far,
+        n_frames=int(cfg.task_arg.get("video_frames", N_FRAMES)),
+    )
     os.makedirs(cfg.result_dir, exist_ok=True)
     out_path = _write_video(os.path.join(cfg.result_dir, "video"), frames)
     print(f"video saved to {out_path}")

@@ -35,16 +35,17 @@ def _load_eval_setup(cfg):
 
 
 def run_dataset(cfg, args=None):
-    """Iterate the train loader contract (reference run.py:5-12)."""
+    """Iterate the train loader contract (reference run.py:5-12): the full
+    sampler → collate → prefetch pipeline, capped at 1000 batches."""
     from tqdm import tqdm
 
-    from nerf_replication_tpu.datasets import make_dataset
+    from nerf_replication_tpu.datasets import make_data_loader
 
-    dataset = make_dataset(cfg, "train")
-    n = min(len(dataset), 1000)
+    loader = make_data_loader(cfg, "train", max_iter=1000)
     t0 = time.time()
-    for i in tqdm(range(n)):
-        _ = dataset[i]
+    n = 0
+    for _ in tqdm(loader):
+        n += 1
     dt = time.time() - t0
     print(f"iterated {n} batches in {dt:.2f}s ({n / dt:.1f} it/s)")
 

@@ -1,0 +1,99 @@
+"""Hash-encoder shootout: pure-XLA gather vs the Pallas kernel, on device.
+
+VERDICT r1 #5's required measurement: both formulations at the lego_hash
+shapes (16 levels, C=2, 2^19 tables, desired_resolution 1024), forward and
+forward+backward, at NeRF-step point counts. Prints one JSON line per
+(impl, mode, n_points); non-lowerable Pallas (Mosaic rejects the gather) is
+caught and reported as {"lowered": false} — that outcome, recorded, is the
+evidence for keeping the XLA path.
+
+    python scripts/bench_hash.py [--points 16384 262144] [--steps 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--points", type=int, nargs="+", default=[16384, 262144])
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--levels", type=int, default=16)
+    p.add_argument("--log2_t", type=int, default=19)
+    p.add_argument("--force_platform", default=os.environ.get(
+        "BENCH_FORCE_PLATFORM", ""))
+    args = p.parse_args(argv)
+
+    if args.force_platform:
+        from nerf_replication_tpu.utils.platform import force_platform
+
+        force_platform(args.force_platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nerf_replication_tpu.models.encoding.hashgrid import level_geometry
+    from nerf_replication_tpu.models.encoding.pallas_hash import (
+        make_hash_encode_fn,
+    )
+
+    # lego_hash geometry: desired_resolution 1024 from base 16 over L levels
+    scale = float(2.0 ** (np.log2(1024 / 16) / (args.levels - 1)))
+    static = dict(
+        input_dim=3, num_levels=args.levels, per_level_scale=scale,
+        base_resolution=16, log2_hashmap_size=args.log2_t,
+    )
+    offsets, _, _, _ = level_geometry(3, args.levels, scale, 16, args.log2_t)
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    table = jax.random.uniform(k1, (offsets[-1], 2), jnp.float32, -1e-4, 1e-4)
+
+    def timed(fn, *a):
+        out = fn(*a)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.steps
+
+    for n in args.points:
+        x = jax.random.uniform(k2, (n, 3), jnp.float32)
+        for impl in ("xla", "pallas"):
+            enc = make_hash_encode_fn(**static, use_pallas=impl == "pallas")
+            fwd = jax.jit(enc)
+
+            def loss(x, t):
+                return jnp.sum(enc(x, t) ** 2)
+
+            fwdbwd = jax.jit(jax.grad(loss, argnums=1))
+            for mode, fn, fargs in (
+                ("fwd", fwd, (x, table)),
+                ("fwd+bwd", fwdbwd, (x, table)),
+            ):
+                rec = {"impl": impl, "mode": mode, "n_points": n,
+                       "levels": args.levels, "log2_t": args.log2_t}
+                try:
+                    dt = timed(fn, *fargs)
+                    rec.update(
+                        lowered=True,
+                        ms=round(dt * 1e3, 3),
+                        points_per_sec=round(n / dt, 1),
+                    )
+                except Exception as exc:
+                    rec.update(lowered=False,
+                               error=f"{type(exc).__name__}: {exc}"[:300])
+                print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

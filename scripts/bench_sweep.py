@@ -1,0 +1,62 @@
+"""Bench sweep: N_rays × compute dtype × remat on the single-chip train step.
+
+Writes one JSON line per point (same schema as bench.py plus the sweep axes)
+to stdout and, with --out, to a JSONL file consumed by PERF.md. Run on the
+TPU; CPU smoke: BENCH_FORCE_PLATFORM=cpu with tiny axes.
+
+    python scripts/bench_sweep.py [--rays 1024 4096 16384 65536]
+        [--dtypes float32 bfloat16] [--remat false true] [--steps 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--rays", type=int, nargs="+",
+                   default=[1024, 4096, 16384, 65536])
+    p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
+    p.add_argument("--remat", nargs="+", default=["false", "true"])
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+
+    results = []
+    for n_rays in args.rays:
+        for dtype in args.dtypes:
+            for remat in args.remat:
+                env = dict(
+                    os.environ,
+                    BENCH_N_RAYS=str(n_rays),
+                    BENCH_STEPS=str(args.steps),
+                    BENCH_REMAT=remat,
+                    BENCH_DTYPE=dtype,
+                )
+                r = subprocess.run(
+                    [sys.executable, os.path.join(_REPO, "bench.py")],
+                    env=env, capture_output=True, text=True, timeout=1200,
+                )
+                line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    rec = {"error": line or r.stderr[-200:]}
+                rec.update(n_rays=n_rays, dtype=dtype, remat=remat == "true")
+                results.append(rec)
+                print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            for rec in results:
+                f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -153,6 +153,25 @@ def recenter_and_scale(c2ws, target_radius: float = 4.0):
     return c2ws
 
 
+def run_ffmpeg(video_in: str, images_dir: str, fps: float,
+               time_slice: str = ""):
+    """Extract frames from a video into ``images_dir`` (parity: reference
+    colmap2nerf.py:57-120's ffmpeg mode — fps sampling + optional t1,t2
+    time slice); requires `ffmpeg` on PATH."""
+    if shutil.which("ffmpeg") is None:
+        raise SystemExit("ffmpeg binary not found on PATH (drop --video_in)")
+    os.makedirs(images_dir, exist_ok=True)
+    filters = [f"fps={fps}"]
+    cmd = ["ffmpeg", "-y", "-i", video_in]
+    if time_slice:
+        t1, t2 = (float(t) for t in time_slice.split(","))
+        cmd += ["-ss", str(t1), "-to", str(t2)]
+    cmd += ["-vf", ",".join(filters), "-qscale:v", "2",
+            os.path.join(images_dir, "%04d.jpg")]
+    subprocess.run(cmd, check=True)
+    return images_dir
+
+
 def run_colmap(images_dir: str, workspace: str):
     """Drive the COLMAP binary (feature extraction → matching → mapping →
     text export); requires `colmap` on PATH."""
@@ -185,9 +204,20 @@ def main(argv=None):
     parser.add_argument("--text", default=None,
                         help="COLMAP text-model dir (cameras.txt/images.txt)")
     parser.add_argument("--run_colmap", action="store_true")
+    parser.add_argument("--video_in", default="",
+                        help="extract frames from this video into --images "
+                             "first (implies a capture workflow: follow "
+                             "with --run_colmap)")
+    parser.add_argument("--video_fps", type=float, default=2.0)
+    parser.add_argument("--time_slice", default="",
+                        help="'t1,t2' seconds window of the video to use")
     parser.add_argument("--aabb_scale", type=int, default=4)
     parser.add_argument("--out", default="transforms.json")
     args = parser.parse_args(argv)
+
+    if args.video_in:
+        run_ffmpeg(args.video_in, args.images, args.video_fps,
+                   args.time_slice)
 
     text = args.text
     if args.run_colmap:

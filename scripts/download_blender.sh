@@ -1,0 +1,25 @@
+#!/bin/bash
+# Fetch the NeRF Blender-synthetic test set (parity with the reference's
+# scripts/download_blender.sh:1-10 — same Google-Drive archive and cookie
+# dance). On an air-gapped machine this fails fast; use the procedural
+# generator for a synthetic stand-in scene:
+#   python -c "from nerf_replication_tpu.datasets.procedural import generate_scene; \
+#              generate_scene('data/nerf_synthetic', scene='lego', H=400, W=400, n_train=100, n_test=8)"
+set -e
+cd "$(dirname "$0")/.."
+data_root="data/nerf_synthetic"
+mkdir -p "$data_root"
+cd "$data_root"
+echo "Getting Blender dataset in $data_root"
+fileid="18JxhpWD-4ZmuFKLzKlAw-w5PpzZxXOcG"
+wget -q --load-cookies /tmp/cookies.txt \
+  "https://docs.google.com/uc?export=download&confirm=$(wget --quiet --save-cookies /tmp/cookies.txt --keep-session-cookies --no-check-certificate "https://docs.google.com/uc?export=download&id=${fileid}" -O- | sed -rn 's/.*confirm=([0-9A-Za-z_]+).*/\1\n/p')&id=${fileid}" \
+  -O out.zip || {
+    echo "download failed (no network?); see the procedural fallback in this script's header" >&2
+    rm -f out.zip /tmp/cookies.txt
+    exit 1
+  }
+rm -f /tmp/cookies.txt
+unzip -q out.zip
+rm -f out.zip
+echo "done"

@@ -1,0 +1,228 @@
+"""One-command trained-quality artifact (VERDICT r1 #3).
+
+Generates a procedural Blender-format scene (the air-gapped stand-in for
+nerf_synthetic — scripts/download_blender.sh documents the swap), trains the
+flagship config under a wall-clock budget with periodic eval, and leaves the
+full artifact trail:
+
+* data/record/... PSNR/SSIM trace (QUALITY.jsonl, one line per eval)
+* data/result/... summary.json + per-view pred/gt PNGs
+* occupancy grid (occupancy_grid.npz) baked from the trained net
+* 360° video via the accelerated renderer
+* QUALITY.md — the trace table + wall-clock-to-threshold estimates
+
+    python scripts/quality_run.py --minutes 30 [--H 400] [--views 100]
+        [--scene_root data/quality_scene] [--target_psnr 21.55]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--minutes", type=float, default=30.0)
+    p.add_argument("--H", type=int, default=400)
+    p.add_argument("--views", type=int, default=100)
+    p.add_argument("--test_views", type=int, default=4)
+    p.add_argument("--scene_root", default="data/quality_scene")
+    p.add_argument("--target_psnr", type=float, default=21.55,
+                   help="reference log.txt final PSNR (475 epochs)")
+    p.add_argument("--n_rays", type=int, default=4096)
+    p.add_argument("--eval_every_s", type=float, default=120.0)
+    p.add_argument("--force_platform", default=os.environ.get(
+        "BENCH_FORCE_PLATFORM", ""))
+    p.add_argument("--tag", default="quality")
+    p.add_argument("opts", nargs="*", default=[],
+                   help="trailing cfg key/value overrides (smoke runs)")
+    args = p.parse_args(argv)
+
+    if args.force_platform:
+        from nerf_replication_tpu.utils.platform import force_platform
+
+        force_platform(args.force_platform)
+
+    import jax
+    import numpy as np
+
+    from nerf_replication_tpu.config import make_cfg
+    from nerf_replication_tpu.datasets import make_dataset
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+    from nerf_replication_tpu.evaluators import make_evaluator
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.train import make_loss, make_train_state
+    from nerf_replication_tpu.train.checkpoint import save_model
+    from nerf_replication_tpu.train.trainer import Trainer
+
+    scene = "procedural"
+    if not os.path.exists(
+        os.path.join(args.scene_root, scene, "transforms_train.json")
+    ):
+        print(f"generating {args.views}-view {args.H}² scene …", flush=True)
+        generate_scene(
+            args.scene_root, scene=scene, H=args.H, W=args.H,
+            n_train=args.views, n_test=args.test_views,
+        )
+
+    cfg = make_cfg(
+        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        [
+            "scene", scene,
+            "exp_name", args.tag,
+            "train_dataset.data_root", args.scene_root,
+            "test_dataset.data_root", args.scene_root,
+            "train_dataset.H", str(args.H), "train_dataset.W", str(args.H),
+            "test_dataset.H", str(args.H), "test_dataset.W", str(args.H),
+            "test_dataset.cams", "[0, -1, 1]",
+            "task_arg.N_rays", str(args.n_rays),
+            "precision.compute_dtype", "bfloat16",
+            *args.opts,
+        ],
+    )
+
+    network = make_network(cfg)
+    loss = make_loss(cfg, network)
+    evaluator = make_evaluator(cfg)
+    trainer = Trainer(cfg, network, loss, evaluator)
+    state, schedule = make_train_state(cfg, network, jax.random.PRNGKey(0))
+
+    train_ds = make_dataset(cfg, "train")
+    test_ds = make_dataset(cfg, "test")
+    bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+    pool = None
+    if trainer.precrop_iters > 0:
+        pool = jax.device_put(
+            train_ds.precrop_index_pool(
+                float(cfg.task_arg.get("precrop_frac", 0.5))
+            )
+        )
+    base_key = jax.random.PRNGKey(1)
+
+    budget_s = args.minutes * 60.0
+    t0 = time.time()
+    next_eval = args.eval_every_s
+    trace = []
+    host_step = 0
+    crossed_at = None
+    trace_path = os.path.join(_REPO, "QUALITY.jsonl")
+    with open(trace_path, "w") as tf:
+        while time.time() - t0 < budget_s:
+            # one burst of steps between host syncs
+            for _ in range(100):
+                use_pool = pool is not None and host_step < trainer.precrop_iters
+                state, stats = trainer.step(
+                    state, bank[0], bank[1], base_key,
+                    index_pool=pool if use_pool else None,
+                )
+                host_step += 1
+            jax.block_until_ready(stats)
+            elapsed = time.time() - t0
+            if elapsed >= next_eval or elapsed >= budget_s:
+                next_eval = elapsed + args.eval_every_s
+                result = trainer.val(
+                    state, epoch=host_step, test_dataset=test_ds,
+                    max_images=args.test_views,
+                )
+                rec = {
+                    "t_s": round(elapsed, 1), "step": host_step,
+                    "loss": float(stats["loss"]), **result,
+                }
+                trace.append(rec)
+                tf.write(json.dumps(rec) + "\n")
+                tf.flush()
+                print(json.dumps(rec), flush=True)
+                if crossed_at is None and result.get("psnr", 0) >= args.target_psnr:
+                    crossed_at = rec
+
+    save_model(cfg.trained_model_dir, state, epoch=host_step // 500,
+               recorder_state={}, latest=True)
+
+    # artifacts: occupancy grid → accelerated video
+    from nerf_replication_tpu.renderer import make_renderer
+    from nerf_replication_tpu.renderer.occupancy import (
+        bake_occupancy_grid,
+        save_occupancy_grid,
+    )
+
+    params = {"params": state.params}
+    grid = bake_occupancy_grid(params, network, cfg)
+    grid_path = os.path.join(cfg.trained_model_dir, "occupancy_grid.npz")
+    bbox = cfg.train_dataset.scene_bbox
+    thresh = float(cfg.task_arg.get("occupancy_grid_threshold", 1.0))
+    save_occupancy_grid(grid_path, grid, bbox, thresh)
+    print(f"occupancy grid: {grid_path} "
+          f"({100.0 * float(np.asarray(grid).mean()):.1f}% occupied)")
+
+    import render_video
+
+    renderer = make_renderer(cfg, network)
+    renderer.load_occupancy_grid(grid_path)
+    frames = render_video.spiral_frames(
+        renderer, params, H=min(args.H, 200), W=min(args.H, 200),
+        focal=test_ds.focal * min(args.H, 200) / args.H,
+        near=float(cfg.task_arg.near), far=float(cfg.task_arg.far),
+        n_frames=60,
+    )
+    os.makedirs(cfg.result_dir, exist_ok=True)
+    video_path = render_video._write_video(
+        os.path.join(cfg.result_dir, "video"), frames
+    )
+    print(f"video: {video_path}")
+
+    # QUALITY.md
+    best = max(trace, key=lambda r: r.get("psnr", 0), default=None)
+    lines = [
+        "# QUALITY — trained artifact trace",
+        "",
+        f"Scene: procedural {args.H}²×{args.views} views; config lego.yaml "
+        f"(N_rays={args.n_rays}, bf16); budget {args.minutes:.0f} min on "
+        f"`{jax.devices()[0].device_kind}`.",
+        "",
+        "| t (s) | step | loss | PSNR | SSIM |",
+        "|---|---|---|---|---|",
+    ]
+    for r in trace:
+        lines.append(
+            f"| {r['t_s']} | {r['step']} | {r['loss']:.4f} | "
+            f"{r.get('psnr', float('nan')):.2f} | "
+            f"{r.get('ssim', float('nan')):.3f} |"
+        )
+    lines.append("")
+    if crossed_at:
+        lines.append(
+            f"**Crossed the reference's {args.target_psnr} dB at "
+            f"t={crossed_at['t_s']} s (step {crossed_at['step']})** — the "
+            f"reference took 237k steps / ~14.6 h at 0.222 s/iter to get "
+            f"there (log.txt)."
+        )
+    elif best:
+        lines.append(
+            f"Best PSNR {best.get('psnr', 0):.2f} dB at t={best['t_s']} s; "
+            f"did not cross {args.target_psnr} dB in budget."
+        )
+    if len(trace) >= 2 and best and best.get("psnr", 0) > 0:
+        # crude wall-clock-to-30dB estimate from the tail slope
+        a, b = trace[-2], trace[-1]
+        dpsnr = b.get("psnr", 0) - a.get("psnr", 0)
+        if dpsnr > 1e-3:
+            eta = (30.0 - b["psnr"]) * (b["t_s"] - a["t_s"]) / dpsnr
+            lines.append(
+                f"\nTail slope {dpsnr:.2f} dB / {b['t_s'] - a['t_s']:.0f} s "
+                f"⇒ naive wall-clock-to-30 dB ≈ {b['t_s'] + max(eta, 0):.0f} s "
+                "(log-shaped convergence makes this a lower bound)."
+            )
+    with open(os.path.join(_REPO, "QUALITY.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("wrote QUALITY.md")
+
+
+if __name__ == "__main__":
+    main()

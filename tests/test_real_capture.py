@@ -1,0 +1,216 @@
+"""Real-capture loop end-to-end (VERDICT r1 #4): a synthetic COLMAP text
+model of the procedural scene → scripts/colmap2nerf.py → datasets.real →
+a few hundred training steps with descending loss. Plus unit coverage of the
+NDC ray math and the holdout split."""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.datasets.rays import get_rays_np, ndc_rays_np
+
+
+def _write_colmap_text(scene_root, scene, out_dir, H, W, focal):
+    """Re-express a generated blender-format scene as a COLMAP text model
+    (world→camera quaternions), so the converter's inversion round-trips."""
+    with open(
+        os.path.join(scene_root, scene, "transforms_train.json")
+    ) as f:
+        meta = json.load(f)
+
+    os.makedirs(out_dir, exist_ok=True)
+    cx, cy = W / 2.0, H / 2.0
+    with open(os.path.join(out_dir, "cameras.txt"), "w") as f:
+        f.write(f"# cams\n1 PINHOLE {W} {H} {focal} {focal} {cx} {cy}\n")
+
+    lines = ["# images"]
+    for i, frame in enumerate(meta["frames"]):
+        c2w = np.asarray(frame["transform_matrix"], dtype=np.float64)
+        # undo the NeRF convention flip (y/z columns), then invert to w2c
+        c2w_colmap = c2w.copy()
+        c2w_colmap[0:3, 1] *= -1
+        c2w_colmap[0:3, 2] *= -1
+        w2c = np.linalg.inv(c2w_colmap)
+        R, t = w2c[:3, :3], w2c[:3, 3]
+        # rotation matrix → quaternion (w, x, y, z)
+        tr = np.trace(R)
+        if tr > 0:
+            s = 2.0 * np.sqrt(tr + 1.0)
+            q = [0.25 * s, (R[2, 1] - R[1, 2]) / s,
+                 (R[0, 2] - R[2, 0]) / s, (R[1, 0] - R[0, 1]) / s]
+        else:
+            k = int(np.argmax(np.diag(R)))
+            i2, j2 = (k + 1) % 3, (k + 2) % 3
+            s = 2.0 * np.sqrt(1.0 + R[k, k] - R[i2, i2] - R[j2, j2])
+            q = [0.0, 0.0, 0.0, 0.0]
+            q[0] = (R[j2, i2] - R[i2, j2]) / s
+            q[1 + k] = 0.25 * s
+            q[1 + i2] = (R[i2, k] + R[k, i2]) / s
+            q[1 + j2] = (R[j2, k] + R[k, j2]) / s
+        name = os.path.basename(frame["file_path"]) + ".png"
+        lines.append(
+            f"{i + 1} {q[0]} {q[1]} {q[2]} {q[3]} "
+            f"{t[0]} {t[1]} {t[2]} 1 {name}"
+        )
+        lines.append("")  # empty 2D-points line
+    with open(os.path.join(out_dir, "images.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+@pytest.fixture(scope="module")
+def capture_root(tmp_path_factory):
+    """A 'capture': images in a flat dir + transforms.json from the converter."""
+    import shutil
+
+    import colmap2nerf
+
+    root = tmp_path_factory.mktemp("capture")
+    scene_root = str(root / "blender")
+    H = W = 20
+    generate_scene(scene_root, scene="procedural", H=H, W=W,
+                   n_train=10, n_test=2)
+    # flatten the train images into an images/ dir, colmap-capture style
+    img_dir = root / "myscene" / "images"
+    img_dir.mkdir(parents=True)
+    src = os.path.join(scene_root, "procedural", "train")
+    for p in sorted(os.listdir(src)):
+        shutil.copy(os.path.join(src, p), img_dir / p)
+
+    with open(os.path.join(scene_root, "procedural",
+                           "transforms_train.json")) as f:
+        cam_angle = json.load(f)["camera_angle_x"]
+    focal = 0.5 * W / np.tan(0.5 * cam_angle)
+
+    text = str(root / "text")
+    _write_colmap_text(scene_root, "procedural", text, H, W, focal)
+    out = str(root / "myscene" / "transforms.json")
+    colmap2nerf.main(
+        ["--images", str(img_dir), "--text", text, "--out", out]
+    )
+    return str(root)
+
+
+def test_converter_output_is_loadable(capture_root):
+    from nerf_replication_tpu.datasets.real import Dataset
+
+    train = Dataset(data_root=capture_root, scene="myscene", split="train",
+                    test_hold=5)
+    test = Dataset(data_root=capture_root, scene="myscene", split="test",
+                   test_hold=5)
+    assert train.n_images == 8 and test.n_images == 2  # 10 frames, hold 5
+    rays, rgbs = train.ray_bank()
+    assert rays.shape == (8 * 20 * 20, 6) and rgbs.shape == (8 * 20 * 20, 3)
+    assert np.isfinite(rays).all() and np.isfinite(rgbs).all()
+    # ray directions must point at the recentred scene: origins ~radius 4
+    o = rays[:, :3].reshape(8, -1, 3)[:, 0]
+    np.testing.assert_allclose(
+        np.linalg.norm(o, axis=-1).mean(), 4.0, atol=0.8
+    )
+    b = test.image_batch(1)
+    assert b["rays"].shape == (400, 6) and b["meta"]["H"] == 20
+
+
+def test_real_capture_trains(capture_root):
+    """The full loop: converter output → config → fit-style training for a
+    few hundred steps; the loss must drop."""
+    from nerf_replication_tpu.config import make_cfg
+    from nerf_replication_tpu.datasets import make_dataset
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.train import make_loss, make_train_state
+    from nerf_replication_tpu.train.trainer import Trainer
+
+    cfg = make_cfg(
+        os.path.join(os.path.dirname(__file__), "..", "configs", "real",
+                     "capture.yaml"),
+        [
+            "scene", "myscene",
+            "train_dataset.data_root", capture_root,
+            "test_dataset.data_root", capture_root,
+            "train_dataset.test_hold", "5",
+            "test_dataset.test_hold", "5",
+            "network.nerf.W", "48", "network.nerf.D", "2",
+            "network.nerf.skips", "[1]",
+            "task_arg.N_samples", "12", "task_arg.N_importance", "12",
+            "task_arg.N_rays", "128", "task_arg.chunk_size", "512",
+        ],
+    )
+    network = make_network(cfg)
+    loss = make_loss(cfg, network)
+    trainer = Trainer(cfg, network, loss)
+    state, _ = make_train_state(cfg, network, jax.random.PRNGKey(0))
+
+    ds = make_dataset(cfg, "train")
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(1)
+
+    losses = []
+    for _ in range(200):
+        state, stats = trainer.step(state, bank[0], bank[1], key)
+        losses.append(float(stats["loss"]))
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-20:]) < 0.6 * np.mean(losses[:20])
+
+
+def test_ndc_ray_math():
+    """NDC properties (original NeRF appendix C): rays in the frustum map
+    into the [-1,1] cube; the origin lands on the near plane (z=-1 in NDC);
+    t=inf maps to z=+1."""
+    H, W, focal, near = 40, 60, 50.0, 1.0
+    c2w = np.eye(4, dtype=np.float32)  # camera at origin looking down -z
+    o, d = get_rays_np(H, W, focal, c2w)
+    no, nd = ndc_rays_np(H, W, focal, near, o.reshape(-1, 3), d.reshape(-1, 3))
+
+    # origin on the NDC near plane
+    np.testing.assert_allclose(no[:, 2], -1.0, atol=1e-5)
+    # t → ∞ endpoint: o + 1·d has z=+1 (since d2 = -2n/oz, oz=-n ⇒ d2=2)
+    np.testing.assert_allclose((no + nd)[:, 2], 1.0, atol=1e-5)
+    # x/y of both endpoints stay inside [-1, 1] (frustum → cube)
+    assert np.abs(no[:, :2]).max() <= 1.0 + 1e-4
+    assert np.abs((no + nd)[:, :2]).max() <= 1.0 + 1e-4
+
+
+def test_real_dataset_ndc_mode(capture_root):
+    from nerf_replication_tpu.datasets.real import Dataset
+
+    ds = Dataset(data_root=capture_root, scene="myscene", split="train",
+                 test_hold=5, ndc=True)
+    assert ds.near == 0.0 and ds.far == 1.0
+    rays, _ = ds.ray_bank()
+    assert np.isfinite(rays).all()
+
+
+def test_ndc_config_requires_zero_one_bounds(capture_root):
+    """ndc=true with the default 2/6 ray bounds must fail LOUDLY — the
+    trainer samples cfg.task_arg bounds, which would all miss the NDC
+    frustum."""
+    from nerf_replication_tpu.config import make_cfg
+    from nerf_replication_tpu.datasets.real import Dataset
+
+    cfg = make_cfg(
+        os.path.join(os.path.dirname(__file__), "..", "configs", "real",
+                     "capture.yaml"),
+        ["scene", "myscene",
+         "train_dataset.data_root", capture_root,
+         "train_dataset.ndc", "True"],
+    )
+    with pytest.raises(ValueError, match="task_arg.near"):
+        Dataset.from_cfg(cfg, "train")
+
+    # the shipped NDC config carries matching bounds and constructs fine
+    cfg2 = make_cfg(
+        os.path.join(os.path.dirname(__file__), "..", "configs", "real",
+                     "capture_ndc.yaml"),
+        ["scene", "myscene",
+         "train_dataset.data_root", capture_root,
+         "train_dataset.test_hold", "5"],
+    )
+    ds = Dataset.from_cfg(cfg2, "train")
+    assert ds.ndc and ds.near == 0.0

@@ -61,7 +61,10 @@ class DataLoader:
             try:
                 for batch in it:
                     window.append(pool.submit(self._load, batch))
-                    if len(window) >= self.num_workers:
+                    # pop only past num_workers in-flight: popping at == would
+                    # make depth-1 prefetch a no-op (block on the batch just
+                    # submitted, nothing loading while the consumer computes)
+                    if len(window) > self.num_workers:
                         yield window.popleft().result()
                 while window:
                     yield window.popleft().result()

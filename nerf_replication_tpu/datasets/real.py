@@ -37,12 +37,13 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .bank import RayBankDataset
 from .blender import _load_image, _resize_area, _to_rgba_uint8
 from .rays import get_rays_np, ndc_rays_np
 
 
 @dataclass
-class Dataset:
+class Dataset(RayBankDataset):
     data_root: str
     scene: str = ""
     split: str = "train"
@@ -93,18 +94,11 @@ class Dataset:
                 f"frames out of {len(meta['frames'])}"
             )
 
-        def intr(frame, key, default=None):
-            # per-frame intrinsics win over capture-level (instant-ngp allows
-            # both layouts; ours writes capture-level)
-            v = frame.get(key, meta.get(key, default))
-            if v is None:
-                raise KeyError(f"transforms.json lacks intrinsic {key!r}")
-            return float(v)
-
         W0 = int(meta.get("w", 0)) or None
         H0 = int(meta.get("h", 0)) or None
 
         images, pose_list, ray_o, ray_d = [], [], [], []
+        bank_focal = None
         for frame in frames:
             fp = frame["file_path"]
             root, ext = os.path.splitext(fp)
@@ -114,32 +108,50 @@ class Dataset:
             h, w = img.shape[:2]
             if H0 is None:
                 H0, W0 = h, w
-            fl_x = intr(frame, "fl_x")
-            fl_y = intr(frame, "fl_y", fl_x)
-            cx = intr(frame, "cx", 0.5 * W0)
-            cy = intr(frame, "cy", 0.5 * H0)
-
             H = int(H0 * self.input_ratio)
             W = int(W0 * self.input_ratio)
+
+            # Intrinsics carry their provenance's pixel units: per-frame keys
+            # are in THIS frame's native (h, w) pixels, capture-level keys in
+            # the top-level (meta h/w) pixels. Each is scaled by its own
+            # units→bank factor, so a second camera's frames (different
+            # native size, per-frame intrinsics) and capture-level fallbacks
+            # both land in bank pixels.
+            sx_f, sy_f = W / w, H / h
+            sx_c, sy_c = W / W0, H / H0
+
+            def intr(key, s_frame, s_cap, default=None):
+                if key in frame:
+                    return float(frame[key]) * s_frame
+                if key in meta:
+                    return float(meta[key]) * s_cap
+                if default is None:
+                    raise KeyError(f"transforms.json lacks intrinsic {key!r}")
+                return default  # already bank-scale
+
+            fl_x = intr("fl_x", sx_f, sx_c)
+            fl_y = intr("fl_y", sy_f, sy_c, default=fl_x)
+            cx = intr("cx", sx_f, sx_c, default=0.5 * W)
+            cy = intr("cy", sy_f, sy_c, default=0.5 * H)
+            if bank_focal is None:
+                bank_focal = fl_x
+
             if (h, w) != (H, W):
                 img = _resize_area(img, W, H)
-            r = self.input_ratio
             c2w = np.asarray(frame["transform_matrix"], dtype=np.float32)
-            o, d = get_rays_np(
-                H, W, fl_x * r, c2w, fl_y=fl_y * r, cx=cx * r, cy=cy * r
-            )
+            o, d = get_rays_np(H, W, fl_x, c2w, fl_y=fl_y, cx=cx, cy=cy)
             if self.ndc:
                 # NDC wants the pre-projection focals of THIS capture
-                o, d = ndc_rays_np(
-                    H, W, fl_x * r, 1.0, o, d, fl_y=fl_y * r
-                )
+                o, d = ndc_rays_np(H, W, fl_x, 1.0, o, d, fl_y=fl_y)
             ray_o.append(o.reshape(-1, 3))
             ray_d.append(d.reshape(-1, 3))
             images.append(img)
             pose_list.append(c2w)
 
         self.H, self.W = int(H0 * self.input_ratio), int(W0 * self.input_ratio)
-        self.focal = intr(frames[0], "fl_x") * self.input_ratio
+        # bank-scale focal of the first loaded frame (consistent with the
+        # rays regardless of which camera that frame came from)
+        self.focal = float(bank_focal)
         self.poses = np.stack(pose_list, 0)
         self.n_images = len(frames)
         if self.ndc:
@@ -180,46 +192,4 @@ class Dataset:
             far=float(cfg.task_arg.get("far", 6.0)),
         )
 
-    # ---- shared dataset contract ------------------------------------------
-    def ray_bank(self):
-        return self.rays, self.rgbs
-
-    def precrop_index_pool(self, precrop_frac: float) -> np.ndarray:
-        H, W, n = self.H, self.W, self.n_images
-        dH = int(H // 2 * precrop_frac)
-        dW = int(W // 2 * precrop_frac)
-        rows = np.arange(H // 2 - dH, H // 2 + dH)
-        cols = np.arange(W // 2 - dW, W // 2 + dW)
-        rr, cc = np.meshgrid(rows, cols, indexing="ij")
-        per_image = (rr * W + cc).reshape(-1)
-        offsets = np.arange(n, dtype=np.int64)[:, None] * (H * W)
-        return (offsets + per_image[None, :]).reshape(-1)
-
-    def __len__(self) -> int:
-        if self.split == "train":
-            return 1_000_000
-        return self.n_images
-
-    def image_batch(self, index: int) -> dict:
-        n_pix = self.H * self.W
-        sl = slice(index * n_pix, (index + 1) * n_pix)
-        return {
-            "rays": self.rays[sl],
-            "rgbs": self.rgbs[sl],
-            "near": np.float32(self.near),
-            "far": np.float32(self.far),
-            "i": index,
-            "meta": {"H": self.H, "W": self.W, "focal": self.focal},
-        }
-
-    def __getitem__(self, index: int) -> dict:
-        if self.split == "train":
-            idx = np.random.randint(0, self.rays.shape[0], size=(1024,))
-            return {
-                "rays": self.rays[idx],
-                "rgbs": self.rgbs[idx],
-                "near": np.float32(self.near),
-                "far": np.float32(self.far),
-                "i": index,
-            }
-        return self.image_batch(index)
+    # ray_bank/precrop_index_pool/__len__/image_batch/__getitem__: RayBankDataset

@@ -65,7 +65,11 @@ class DistributedSampler(RandomSampler):
         rng = np.random.default_rng((self.seed, self.epoch))
         order = rng.permutation(self.n) if self.shuffle else np.arange(self.n)
         total = -(-self.n // self.world) * self.world
-        order = np.concatenate([order, order[: total - self.n]])  # pad
+        # pad by tiling: a single slice under-pads when world > 2·n (e.g.
+        # 1 image on 4 processes needs 3 repeats), leaving high ranks with
+        # short/empty slices while __len__ still promises ceil(n/world)
+        reps = -(-total // self.n)
+        order = np.tile(order, reps)[:total]
         return iter(order[self.rank : total : self.world].tolist())
 
     def __len__(self):

@@ -26,10 +26,11 @@ def main(argv=None):
     p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
     p.add_argument("--remat", nargs="+", default=["false", "true"])
     p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--point_timeout", type=float, default=1200.0)
     p.add_argument("--out", default="")
     args = p.parse_args(argv)
 
-    results = []
+    out_f = open(args.out, "w") if args.out else None
     for n_rays in args.rays:
         for dtype in args.dtypes:
             for remat in args.remat:
@@ -40,22 +41,29 @@ def main(argv=None):
                     BENCH_REMAT=remat,
                     BENCH_DTYPE=dtype,
                 )
-                r = subprocess.run(
-                    [sys.executable, os.path.join(_REPO, "bench.py")],
-                    env=env, capture_output=True, text=True, timeout=1200,
-                )
-                line = (r.stdout.strip().splitlines() or ["{}"])[-1]
                 try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    rec = {"error": line or r.stderr[-200:]}
+                    r = subprocess.run(
+                        [sys.executable, os.path.join(_REPO, "bench.py")],
+                        env=env, capture_output=True, text=True,
+                        timeout=args.point_timeout,
+                    )
+                    line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        rec = {"error": line or r.stderr[-200:]}
+                except subprocess.TimeoutExpired:
+                    # one stuck point (e.g. a long tunnel-recovery wait under
+                    # a big BENCH_INIT_RETRIES budget) must not abort the
+                    # sweep and lose every prior record
+                    rec = {"error": f"point exceeded {args.point_timeout}s"}
                 rec.update(n_rays=n_rays, dtype=dtype, remat=remat == "true")
-                results.append(rec)
                 print(json.dumps(rec), flush=True)
-    if args.out:
-        with open(args.out, "w") as f:
-            for rec in results:
-                f.write(json.dumps(rec) + "\n")
+                if out_f:  # written per point: a crash keeps prior records
+                    out_f.write(json.dumps(rec) + "\n")
+                    out_f.flush()
+    if out_f:
+        out_f.close()
 
 
 if __name__ == "__main__":

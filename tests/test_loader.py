@@ -42,6 +42,18 @@ def test_distributed_sampler_partitions():
     assert covered == set(range(10))
 
 
+def test_distributed_sampler_more_ranks_than_items():
+    # world > 2·n: padding must tile, not slice — every rank still gets
+    # ceil(n/world) items (a short/empty high-rank slice would hang lockstep
+    # collectives while low ranks proceed)
+    world = 4
+    per_rank = [list(DistributedSampler(1, r, world, seed=0)) for r in range(world)]
+    assert all(p == [0] for p in per_rank)
+    per_rank = [list(DistributedSampler(3, r, world, seed=0)) for r in range(world)]
+    assert all(len(p) == 1 for p in per_rank)
+    assert set(i for p in per_rank for i in p) == {0, 1, 2}
+
+
 def test_batch_sampler_shapes():
     bs = BatchSampler(SequentialSampler(10), 4)
     batches = list(bs)

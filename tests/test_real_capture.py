@@ -214,3 +214,54 @@ def test_ndc_config_requires_zero_one_bounds(capture_root):
     )
     ds = Dataset.from_cfg(cfg2, "train")
     assert ds.ndc and ds.near == 0.0
+
+
+def test_real_mixed_resolution_intrinsics(tmp_path):
+    """A frame stored at 2× the capture resolution (second camera) must get
+    its intrinsics scaled by ITS native→bank resize factor, not by
+    input_ratio — both frames below share a pose, so their rays must agree."""
+    import imageio.v2 as imageio
+
+    from nerf_replication_tpu.datasets.real import Dataset
+
+    H = W = 16
+    rng = np.random.default_rng(0)
+    img_small = (rng.uniform(0, 255, (H, W, 3))).astype(np.uint8)
+    img_big = np.repeat(np.repeat(img_small, 2, axis=0), 2, axis=1)
+    scene = tmp_path / "scene"
+    scene.mkdir()
+    imageio.imwrite(scene / "a.png", img_small)
+    imageio.imwrite(scene / "b.png", img_big)
+
+    c2w = np.eye(4)
+    c2w[2, 3] = 4.0
+    meta = {
+        "w": W, "h": H, "fl_x": 20.0, "fl_y": 20.0, "cx": 8.0, "cy": 8.0,
+        "frames": [
+            # frame 0 always lands in the holdout test split — pad with it
+            {"file_path": "a.png", "transform_matrix": c2w.tolist()},
+            {"file_path": "a.png", "transform_matrix": c2w.tolist()},
+            # same pose, captured at 2× resolution with 2× intrinsics
+            {"file_path": "b.png", "transform_matrix": c2w.tolist(),
+             "fl_x": 40.0, "fl_y": 40.0, "cx": 16.0, "cy": 16.0},
+            # same pose, stored at 2× resolution but with NO per-frame
+            # intrinsics: the capture-level values are in capture (16px)
+            # units and must NOT be scaled by this frame's native factor
+            {"file_path": "b.png", "transform_matrix": c2w.tolist()},
+        ],
+    }
+    with open(scene / "transforms.json", "w") as f:
+        json.dump(meta, f)
+
+    ds = Dataset(data_root=str(scene), split="train", test_hold=4)
+    rays, rgbs = ds.ray_bank()
+    per = H * W
+    assert ds.n_images == 3
+    for k in (1, 2):  # both 2×-stored frames must reproduce frame a's rays
+        np.testing.assert_allclose(
+            rays[:per], rays[k * per:(k + 1) * per], rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            rgbs[:per], rgbs[k * per:(k + 1) * per], atol=0.05
+        )
+    assert ds.focal == pytest.approx(20.0)

@@ -48,10 +48,10 @@ class DataLoader:
             for batch in self.batch_sampler:
                 yield self._load(batch)
             return
-        # bounded prefetch: at most num_workers batches in flight —
-        # Executor.map would submit the WHOLE sampler eagerly and buffer
-        # every finished batch regardless of consumer speed (OOM on long
-        # full-image iterations)
+        # bounded prefetch: at most num_workers+1 submitted batches in the
+        # window — Executor.map would submit the WHOLE sampler eagerly and
+        # buffer every finished batch regardless of consumer speed (OOM on
+        # long full-image iterations)
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 

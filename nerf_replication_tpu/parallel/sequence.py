@@ -23,16 +23,35 @@ from ..renderer.volume import render_rays
 from .mesh import DATA_AXIS
 
 
-def build_sequence_parallel_renderer(mesh, network, options, near, far):
+def build_sequence_parallel_renderer(
+    mesh, network, options, near, far, chunk_size: int | None = None
+):
     """Returns ``render(params, rays [N, 6]) -> dict`` with the ray axis
-    sharded over ``mesh``'s data axis. N is padded to the shard count."""
+    sharded over ``mesh``'s data axis. N is padded to the shard count.
+
+    ``chunk_size`` bounds per-device memory the way ``render_chunked`` does
+    on one chip: each shard marches its ray slice in fixed-size ``lax.map``
+    chunks, so a full 640k-ray eval image fits HBM at any device count
+    (each device holds chunk_size × 256-sample activations, not N/shards)."""
     n_shards = mesh.shape[DATA_AXIS]
 
     def shard_body(params, rays):
         apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
             params, pts, vd, model=model
         )
-        return render_rays(apply_fn, rays, near, far, None, options)
+        n = rays.shape[0]  # static: per-shard slice length
+        if chunk_size is None or chunk_size >= n:
+            return render_rays(apply_fn, rays, near, far, None, options)
+        n_chunks = -(-n // chunk_size)
+        pad = n_chunks * chunk_size - n
+        rays_c = jnp.pad(rays, ((0, pad), (0, 0))).reshape(
+            n_chunks, chunk_size, 6
+        )
+        out = jax.lax.map(
+            lambda rc: render_rays(apply_fn, rc, near, far, None, options),
+            rays_c,
+        )
+        return {k: v.reshape((-1,) + v.shape[2:])[:n] for k, v in out.items()}
 
     smap = jax.jit(
         shard_map(

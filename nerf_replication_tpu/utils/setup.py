@@ -23,6 +23,17 @@ def configure_runtime(cfg) -> None:
     global seeding does there. The device path needs no switch: explicit
     key threading already makes it deterministic and resumable.
     """
+    import os
+
+    # explicit platform pin for the CLIs (NERF_PLATFORM=cpu): plain
+    # JAX_PLATFORMS is beaten by this machine's sitecustomize (see
+    # utils/platform.py), which would silently send a CPU-intended run to a
+    # possibly-wedged TPU tunnel
+    platform = os.environ.get("NERF_PLATFORM", "")
+    if platform:
+        from .platform import force_platform
+
+        force_platform(platform)
     if cfg.get("debug_nans", False):
         jax.config.update("jax_debug_nans", True)
     if cfg.get("fix_random", False):

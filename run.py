@@ -50,6 +50,33 @@ def run_dataset(cfg, args=None):
     print(f"iterated {n} batches in {dt:.2f}s ({n / dt:.1f} it/s)")
 
 
+def _full_image_render_fn(cfg, network, renderer, test_ds):
+    """Whole-image renderer for the eval CLIs: single-device chunked by
+    default; ``eval.sharded: true`` on a multi-device runtime shards the ray
+    axis of each image over the mesh's data axis (sequence parallelism —
+    parallel/sequence.py) with in-shard chunking for memory."""
+    import jax
+
+    if bool(cfg.get("eval", {}).get("sharded", False)) and len(jax.devices()) > 1:
+        import jax.numpy as jnp
+
+        from nerf_replication_tpu.parallel.mesh import make_mesh_from_cfg
+        from nerf_replication_tpu.parallel.sequence import (
+            build_sequence_parallel_renderer,
+        )
+
+        # reuse the renderer's own eval options — a second from_cfg would be
+        # a divergence point if Renderer ever adjusts them
+        options = renderer.eval_options
+        sp = build_sequence_parallel_renderer(
+            make_mesh_from_cfg(cfg), network, options,
+            near=float(test_ds.near), far=float(test_ds.far),
+            chunk_size=options.chunk_size,
+        )
+        return lambda params, batch: sp(params, jnp.asarray(batch["rays"]))
+    return lambda params, batch: renderer.render_chunked(params, batch)
+
+
 def run_network(cfg, args=None):
     """Timed full-image network forward over the test set (run.py:15-40)."""
     import jax
@@ -57,11 +84,12 @@ def run_network(cfg, args=None):
     from tqdm import tqdm
 
     network, params, renderer, test_ds = _load_eval_setup(cfg)
+    render = _full_image_render_fn(cfg, network, renderer, test_ds)
     total_time, net_times = 0.0, []
     for i in tqdm(range(len(test_ds))):
         batch = test_ds.image_batch(i)
         t0 = time.time()
-        out = renderer.render_chunked(params, batch)
+        out = render(params, batch)
         jax.block_until_ready(out)
         net_times.append(time.time() - t0)
         total_time += net_times[-1]
@@ -87,15 +115,22 @@ def run_evaluate(cfg, args=None):
     evaluator = make_evaluator(cfg)
 
     accelerated = bool(cfg.task_arg.get("accelerated_renderer", False))
+    grid_loaded = False
     if accelerated:
         grid_path = default_grid_path(getattr(args, "cfg_file", "config"))
-        renderer.load_occupancy_grid(grid_path)
+        grid_loaded = renderer.load_occupancy_grid(grid_path)
+    if grid_loaded:
+        # ESS+ERT march (single-device; the grid lookup is the win here)
+        render = renderer.render_accelerated
+    else:
+        # vanilla path — rides the mesh when eval.sharded is on
+        render = _full_image_render_fn(cfg, network, renderer, test_ds)
 
     net_times = []
     for i in tqdm(range(len(test_ds))):
         batch = test_ds.image_batch(i)
         t0 = time.time()
-        out = renderer.render_accelerated(params, batch)
+        out = render(params, batch)
         jax.block_until_ready(out)
         net_times.append(time.time() - t0)
         out = {k: np.asarray(v) for k, v in out.items()}

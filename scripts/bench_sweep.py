@@ -27,6 +27,8 @@ def main(argv=None):
     p.add_argument("--remat", nargs="+", default=["false", "true"])
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--point_timeout", type=float, default=1200.0)
+    p.add_argument("--config", default="lego.yaml",
+                   help="config under configs/nerf/ (e.g. lego_hash.yaml)")
     p.add_argument("--out", default="")
     args = p.parse_args(argv)
 
@@ -40,6 +42,7 @@ def main(argv=None):
                     BENCH_STEPS=str(args.steps),
                     BENCH_REMAT=remat,
                     BENCH_DTYPE=dtype,
+                    BENCH_CONFIG=args.config,
                 )
                 try:
                     r = subprocess.run(
@@ -57,7 +60,8 @@ def main(argv=None):
                     # a big BENCH_INIT_RETRIES budget) must not abort the
                     # sweep and lose every prior record
                     rec = {"error": f"point exceeded {args.point_timeout}s"}
-                rec.update(n_rays=n_rays, dtype=dtype, remat=remat == "true")
+                rec.update(n_rays=n_rays, dtype=dtype, remat=remat == "true",
+                           config=args.config)
                 print(json.dumps(rec), flush=True)
                 if out_f:  # written per point: a crash keeps prior records
                     out_f.write(json.dumps(rec) + "\n")

@@ -137,6 +137,17 @@ def test_sequence_parallel_renderer_matches_single_device():
             np.asarray(out_sp[k]), np.asarray(out_ref[k]), rtol=2e-5, atol=1e-6
         )
 
+    # in-shard chunking (the full-image memory bound) must not change results:
+    # 37 rays pad to 40, 5 per shard, chunk 3 → 2 lax.map chunks per shard
+    render_c = build_sequence_parallel_renderer(
+        mesh, network, options, 2.0, 6.0, chunk_size=3
+    )
+    out_c = render_c(params, jnp.asarray(rays))
+    for k in out_ref:
+        np.testing.assert_allclose(
+            np.asarray(out_c[k]), np.asarray(out_ref[k]), rtol=2e-5, atol=1e-6
+        )
+
 
 def test_latent_dataset_and_catalog(tmp_path):
     from nerf_replication_tpu.datasets.catalog import DatasetCatalog

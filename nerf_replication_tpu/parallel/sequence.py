@@ -10,6 +10,12 @@ host. This is the long-sequence scaling story of this framework (a 640k-ray
 image is a 640k-token sequence): compute scales linearly over ICI with no
 cross-chip traffic during the march, because volume rendering is
 embarrassingly parallel over rays — the all-gather happens once at the end.
+
+Both builders here (the vanilla coarse+fine renderer and the
+occupancy-accelerated ESS+ERT march) share one chunk/pad skeleton:
+``_chunked_over_rays`` bounds per-device memory inside the shard, and
+``_pad_shard_call`` pads the global ray axis to the shard count and slices
+the results back.
 """
 
 from __future__ import annotations
@@ -21,6 +27,32 @@ from jax.sharding import PartitionSpec as P
 
 from ..renderer.volume import render_rays
 from .mesh import DATA_AXIS
+
+
+def _chunked_over_rays(render_chunk, rays, chunk_size: int | None):
+    """Apply ``render_chunk([chunk, 6]) -> dict`` over a ray slice in
+    fixed-size ``lax.map`` chunks (zero-padded; per-ray outputs are unpadded
+    back to the slice length). ``chunk_size >= n`` short-circuits to one
+    direct call."""
+    n = rays.shape[0]  # static: per-shard slice length
+    if chunk_size is None or chunk_size >= n:
+        return render_chunk(rays)
+    n_chunks = -(-n // chunk_size)
+    pad = n_chunks * chunk_size - n
+    rays_c = jnp.pad(rays, ((0, pad), (0, 0))).reshape(
+        n_chunks, chunk_size, 6
+    )
+    out = jax.lax.map(render_chunk, rays_c)
+    return {k: v.reshape((-1,) + v.shape[2:])[:n] for k, v in out.items()}
+
+
+def _pad_shard_call(smap_fn, n_shards: int, rays, *extra):
+    """Pad the global ray axis to a multiple of ``n_shards``, run the
+    shard-mapped function, slice every output back to the true length."""
+    n = rays.shape[0]
+    pad = (-n) % n_shards
+    rays_p = jnp.pad(rays, ((0, pad), (0, 0)))
+    return {k: v[:n] for k, v in smap_fn(rays_p, *extra).items()}
 
 
 def build_sequence_parallel_renderer(
@@ -39,19 +71,11 @@ def build_sequence_parallel_renderer(
         apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
             params, pts, vd, model=model
         )
-        n = rays.shape[0]  # static: per-shard slice length
-        if chunk_size is None or chunk_size >= n:
-            return render_rays(apply_fn, rays, near, far, None, options)
-        n_chunks = -(-n // chunk_size)
-        pad = n_chunks * chunk_size - n
-        rays_c = jnp.pad(rays, ((0, pad), (0, 0))).reshape(
-            n_chunks, chunk_size, 6
-        )
-        out = jax.lax.map(
+        return _chunked_over_rays(
             lambda rc: render_rays(apply_fn, rc, near, far, None, options),
-            rays_c,
+            rays,
+            chunk_size,
         )
-        return {k: v.reshape((-1,) + v.shape[2:])[:n] for k, v in out.items()}
 
     smap = jax.jit(
         shard_map(
@@ -64,10 +88,57 @@ def build_sequence_parallel_renderer(
     )
 
     def render(params, rays):
-        n = rays.shape[0]
-        pad = (-n) % n_shards
-        rays_p = jnp.pad(rays, ((0, pad), (0, 0)))
-        out = smap(params, rays_p)
-        return {k: v[:n] for k, v in out.items()}
+        return _pad_shard_call(
+            lambda rays_p: smap(params, rays_p), n_shards, rays
+        )
 
     return render
+
+
+def build_sequence_parallel_march(
+    mesh, network, march_options, near, far, chunk_size: int | None = None
+):
+    """Sequence-parallel ESS+ERT march: the occupancy-accelerated renderer
+    (renderer/accelerated.py) with the ray axis sharded over ``mesh``'s data
+    axis. The baked grid + bbox are replicated (a 128³ bool grid is 2 MB —
+    broadcast once, gathered locally on every chip); rays shard like the
+    vanilla sequence renderer, with the same in-shard chunk bound.
+
+    Returns ``march(params, rays [N,6], grid, bbox) -> dict`` (the
+    ``n_truncated`` diagnostic sums per-ray flags after pad rows are
+    sliced off)."""
+    from ..renderer.accelerated import march_rays_accelerated
+
+    n_shards = mesh.shape[DATA_AXIS]
+
+    def shard_body(params, rays, grid, bbox):
+        apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+            params, pts, vd, model=model
+        )
+        return _chunked_over_rays(
+            lambda rc: march_rays_accelerated(
+                apply_fn, rc, near, far, grid, bbox, march_options
+            ),
+            rays,
+            chunk_size,
+        )
+
+    smap = jax.jit(
+        shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS), P(), P()),
+            out_specs=P(DATA_AXIS),
+            check_vma=False,
+        )
+    )
+
+    def march(params, rays, grid, bbox):
+        out = _pad_shard_call(
+            lambda rays_p, g, b: smap(params, rays_p, g, b),
+            n_shards, rays, grid, bbox,
+        )
+        out["n_truncated"] = jnp.sum(out.pop("truncated"))
+        return out
+
+    return march

@@ -119,13 +119,15 @@ def march_rays_accelerated(
         rgb_map = rgb_map + (1.0 - acc_map[..., None])
     # diagnostic: rays whose occupied positions exceeded the K budget while
     # still transparent lose far contributions — surface it instead of
-    # silently truncating (still-alive check keeps ERT-finished rays out)
+    # silently truncating (still-alive check keeps ERT-finished rays out).
+    # Returned PER RAY so chunk/shard padding rows can be sliced off before
+    # summing (zero-direction pad rays never composite but can look
+    # "still alive over an occupied voxel" and would inflate a scalar count).
     n_occ = jnp.sum(occupied, axis=-1)
     still_alive = trans[:, -1] >= options.transmittance_threshold
-    truncated = jnp.sum((n_occ > k) & still_alive)
     return {
         "rgb_map_f": rgb_map,
         "depth_map_f": depth_map,
         "acc_map_f": acc_map,
-        "n_truncated": truncated,
+        "truncated": (n_occ > k) & still_alive,
     }

@@ -403,12 +403,20 @@ class Renderer:
         else:
             self._march_fns[cache_key] = self._march_fns.pop(cache_key)  # LRU
 
-        out = fn(params, rays_p, self.occupancy_grid, self.grid_bbox)
+        out = _unpad_outputs(
+            fn(params, rays_p, self.occupancy_grid, self.grid_bbox), n
+        )
         # accumulate the truncation diagnostic ON DEVICE — a host sync here
         # would serialize per-image dispatch (ADVICE r1); callers read it
-        # once per eval via report_truncation()
-        self._n_truncated = self._n_truncated + jnp.sum(out.pop("n_truncated"))
-        return _unpad_outputs(out, n)
+        # once per eval via report_truncation(). Summed after unpadding, so
+        # padding rows never count.
+        self._n_truncated = self._n_truncated + jnp.sum(out.pop("truncated"))
+        return out
+
+    def accumulate_truncated(self, flags_or_count) -> None:
+        """Fold an external path's truncation diagnostic (per-ray flags or a
+        count) into the on-device accumulator read by report_truncation()."""
+        self._n_truncated = self._n_truncated + jnp.sum(flags_or_count)
 
     def report_truncation(self, log=print) -> int:
         """One host sync: total rays (since last call) that exhausted the

@@ -24,6 +24,9 @@ def main():
     parser = make_parser()
     args = parser.parse_args()
     cfg = cfg_from_args(args)
+    from nerf_replication_tpu.utils.setup import configure_runtime
+
+    configure_runtime(cfg)
 
     network, params, _ = load_trained_network(cfg)
     grid = bake_occupancy_grid(params, network, cfg)

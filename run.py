@@ -50,31 +50,74 @@ def run_dataset(cfg, args=None):
     print(f"iterated {n} batches in {dt:.2f}s ({n / dt:.1f} it/s)")
 
 
-def _full_image_render_fn(cfg, network, renderer, test_ds):
-    """Whole-image renderer for the eval CLIs: single-device chunked by
-    default; ``eval.sharded: true`` on a multi-device runtime shards the ray
-    axis of each image over the mesh's data axis (sequence parallelism —
-    parallel/sequence.py) with in-shard chunking for memory."""
+def _full_image_render_fn(cfg, network, renderer, test_ds, use_grid=False):
+    """Whole-image renderer for the eval CLIs: single-device by default;
+    ``eval.sharded: true`` on a multi-device runtime shards the ray axis of
+    each image over the mesh's data axis (sequence parallelism —
+    parallel/sequence.py) with in-shard chunking for memory. ``use_grid``
+    selects the occupancy-accelerated ESS+ERT march (a grid must already be
+    loaded on the renderer)."""
     import jax
 
-    if bool(cfg.get("eval", {}).get("sharded", False)) and len(jax.devices()) > 1:
-        import jax.numpy as jnp
+    sharded = (
+        bool(cfg.get("eval", {}).get("sharded", False))
+        and jax.device_count() > 1
+    )
+    if not sharded:
+        if use_grid:
+            return renderer.render_accelerated
+        return lambda params, batch: renderer.render_chunked(params, batch)
 
-        from nerf_replication_tpu.parallel.mesh import make_mesh_from_cfg
-        from nerf_replication_tpu.parallel.sequence import (
-            build_sequence_parallel_renderer,
+    import jax.numpy as jnp
+
+    from nerf_replication_tpu.parallel.mesh import make_mesh_from_cfg
+    from nerf_replication_tpu.parallel.sequence import (
+        build_sequence_parallel_march,
+        build_sequence_parallel_renderer,
+    )
+
+    # the sharded builders bake near/far as jit-static march bounds
+    near, far = float(test_ds.near), float(test_ds.far)
+
+    def check_bounds(batch):
+        # the single-device paths honor per-batch bounds; the sharded
+        # executables can't — reject a mismatch instead of silently
+        # rendering at the wrong depth range
+        if float(batch["near"]) != near or float(batch["far"]) != far:
+            raise ValueError(
+                f"eval.sharded baked bounds ({near}, {far}) but the batch "
+                f"carries ({float(batch['near'])}, {float(batch['far'])})"
+            )
+
+    mesh = make_mesh_from_cfg(cfg)
+    if use_grid:
+        march = build_sequence_parallel_march(
+            mesh, network, renderer.march_options, near=near, far=far,
+            chunk_size=renderer.march_options.chunk_size,
         )
 
-        # reuse the renderer's own eval options — a second from_cfg would be
-        # a divergence point if Renderer ever adjusts them
-        options = renderer.eval_options
-        sp = build_sequence_parallel_renderer(
-            make_mesh_from_cfg(cfg), network, options,
-            near=float(test_ds.near), far=float(test_ds.far),
-            chunk_size=options.chunk_size,
-        )
-        return lambda params, batch: sp(params, jnp.asarray(batch["rays"]))
-    return lambda params, batch: renderer.render_chunked(params, batch)
+        def render(params, batch):
+            check_bounds(batch)
+            out = march(params, jnp.asarray(batch["rays"]),
+                        renderer.occupancy_grid, renderer.grid_bbox)
+            renderer.accumulate_truncated(out.pop("n_truncated"))
+            return out
+
+        return render
+
+    # reuse the renderer's own eval options — a second from_cfg would be
+    # a divergence point if Renderer ever adjusts them
+    options = renderer.eval_options
+    sp = build_sequence_parallel_renderer(
+        mesh, network, options, near=near, far=far,
+        chunk_size=options.chunk_size,
+    )
+
+    def render(params, batch):
+        check_bounds(batch)
+        return sp(params, jnp.asarray(batch["rays"]))
+
+    return render
 
 
 def run_network(cfg, args=None):
@@ -119,12 +162,11 @@ def run_evaluate(cfg, args=None):
     if accelerated:
         grid_path = default_grid_path(getattr(args, "cfg_file", "config"))
         grid_loaded = renderer.load_occupancy_grid(grid_path)
-    if grid_loaded:
-        # ESS+ERT march (single-device; the grid lookup is the win here)
-        render = renderer.render_accelerated
-    else:
-        # vanilla path — rides the mesh when eval.sharded is on
-        render = _full_image_render_fn(cfg, network, renderer, test_ds)
+    # one gate for all four combinations: (grid?, sharded?) — the march
+    # paths when a grid loaded, the vanilla chunked/sequence paths otherwise
+    render = _full_image_render_fn(
+        cfg, network, renderer, test_ds, use_grid=grid_loaded
+    )
 
     net_times = []
     for i in tqdm(range(len(test_ds))):

@@ -94,14 +94,16 @@ def main(argv=None):
     }
     print(json.dumps(rec), flush=True)
 
-    # measured step time against the compiled executable
-    state, stats = step_fn(state, bank_rays, bank_rgbs, base_key)
+    # measured step time against the SAME compiled executable (calling
+    # step_fn would re-trace and pay the multi-minute compile a second time
+    # — jit's dispatch cache doesn't see AOT lower().compile() results)
+    state, stats = compiled(state, bank_rays, bank_rgbs, base_key)
     for _ in range(3):
-        state, stats = step_fn(state, bank_rays, bank_rgbs, base_key)
+        state, stats = compiled(state, bank_rays, bank_rgbs, base_key)
     jax.block_until_ready(stats)
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        state, stats = step_fn(state, bank_rays, bank_rgbs, base_key)
+        state, stats = compiled(state, bank_rays, bank_rgbs, base_key)
     jax.block_until_ready(stats)
     dt = (time.perf_counter() - t0) / args.steps
     peak_bf16 = 197e12  # TPU v5 lite bf16 peak (PERF.md)
@@ -116,7 +118,7 @@ def main(argv=None):
     if args.trace_dir:
         with device_trace(args.trace_dir):
             for _ in range(3):
-                state, stats = step_fn(state, bank_rays, bank_rgbs, base_key)
+                state, stats = compiled(state, bank_rays, bank_rgbs, base_key)
             jax.block_until_ready(stats)
         print(json.dumps({"trace_dir": args.trace_dir}), flush=True)
 

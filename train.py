@@ -20,6 +20,9 @@ def main():
 
     args = make_parser().parse_args()
     cfg = cfg_from_args(args)
+    from nerf_replication_tpu.utils.setup import configure_runtime
+
+    configure_runtime(cfg)
 
     if args.test:
         from run import run_evaluate

@@ -160,10 +160,14 @@ def sample_pdf(
             key, cdf.shape[:-1] + (n_samples,), dtype=jnp.float32
         )
 
-    # batched right-bisect: for row-wise sorted cdf, count entries <= u
-    inds = jax.vmap(lambda c, uu: jnp.searchsorted(c, uu, side="right"))(
-        cdf.reshape(-1, cdf.shape[-1]), u.reshape(-1, n_samples)
-    ).reshape(u.shape)
+    # batched right-bisect: for row-wise sorted cdf, count entries <= u.
+    # A broadcast compare + sum ([..., n_samples, B] bools) lowers to pure
+    # vector ops on TPU; vmapped searchsorted would become a log2(B)-step
+    # loop of gathers. B is ~64, so the O(n·B) compare is tiny next to the
+    # MLP sweeps it sits between.
+    inds = jnp.sum(
+        (cdf[..., None, :] <= u[..., :, None]).astype(jnp.int32), axis=-1
+    )
     below = jnp.maximum(inds - 1, 0)
     above = jnp.minimum(inds, cdf.shape[-1] - 1)
 

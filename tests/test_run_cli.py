@@ -1,0 +1,85 @@
+"""The eval CLI's unified render gate: all four (grid, sharded) paths
+resolve, and the sharded paths reject per-batch bounds that differ from the
+baked ones instead of silently rendering the wrong depth range."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import run as run_cli
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.renderer import make_renderer
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_cli"))
+    generate_scene(root, scene="procedural", H=8, W=8, n_train=2, n_test=1)
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.march_chunk_size", "32",
+         "task_arg.max_march_samples", "8",
+         "task_arg.render_step_size", "0.5",
+         "task_arg.chunk_size", "32"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    renderer = make_renderer(cfg, network)
+    from nerf_replication_tpu.datasets import make_dataset
+
+    test_ds = make_dataset(cfg, "test")
+    return cfg, network, params, renderer, test_ds
+
+
+def _batch(test_ds, near=None, far=None):
+    b = test_ds.image_batch(0)
+    if near is not None:
+        b = dict(b, near=np.float32(near), far=np.float32(far))
+    return b
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device CPU mesh")
+@pytest.mark.parametrize("sharded", [False, True])
+@pytest.mark.parametrize("use_grid", [False, True])
+def test_gate_resolves_and_renders(setup, sharded, use_grid):
+    cfg, network, params, renderer, test_ds = setup
+    cfg = cfg.clone()
+    cfg.defrost()
+    cfg.eval = {"sharded": sharded}
+    cfg.freeze()
+    if use_grid:
+        rng = np.random.default_rng(0)
+        renderer.occupancy_grid = jnp.asarray(rng.random((8, 8, 8)) < 0.5)
+        renderer.grid_bbox = jnp.asarray(
+            cfg.train_dataset.scene_bbox, jnp.float32
+        )
+    render = run_cli._full_image_render_fn(
+        cfg, network, renderer, test_ds, use_grid=use_grid
+    )
+    out = render(params, _batch(test_ds))
+    rgb = np.asarray(out["rgb_map_f"])
+    assert rgb.shape == (64, 3) and np.isfinite(rgb).all()
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device CPU mesh")
+def test_sharded_gate_rejects_mismatched_bounds(setup):
+    cfg, network, params, renderer, test_ds = setup
+    cfg = cfg.clone()
+    cfg.defrost()
+    cfg.eval = {"sharded": True}
+    cfg.freeze()
+    render = run_cli._full_image_render_fn(
+        cfg, network, renderer, test_ds, use_grid=False
+    )
+    with pytest.raises(ValueError, match="baked bounds"):
+        render(params, _batch(test_ds, near=1.0, far=3.0))

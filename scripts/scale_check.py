@@ -50,6 +50,8 @@ def main(argv=None):
     p.add_argument("--grid", type=int, default=128)
     p.add_argument("--force_platform", default=os.environ.get(
         "BENCH_FORCE_PLATFORM", ""))
+    p.add_argument("opts", nargs="*", default=[],
+                   help="trailing cfg key/value overrides (CPU smoke: tiny net)")
     args = p.parse_args(argv)
 
     if args.force_platform:
@@ -74,6 +76,7 @@ def main(argv=None):
             "task_arg.march_chunk_size", str(args.chunk),
             "task_arg.occupancy_grid_res", str(args.grid),
             "precision.compute_dtype", "bfloat16",
+            *args.opts,
         ],
     )
     network = make_network(cfg)

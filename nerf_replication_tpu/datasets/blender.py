@@ -114,6 +114,18 @@ class Dataset(RayBankDataset):
                 self.data_root, self.scene, frame["file_path"] + ".png"
             )
             img = _to_rgba_uint8(_load_image(img_path))
+            if img.shape[:2] != (H_orig, W_orig):
+                # the reference trusts cfg H/W and would silently build rays
+                # with the wrong focal/slicing on a mismatched capture —
+                # fail loudly instead (SURVEY.md §2.5 spirit). Checked
+                # BEFORE the input_ratio resize, which would otherwise
+                # coerce any capture (even aspect-distorting) into shape.
+                raise ValueError(
+                    f"{img_path}: image is {img.shape[1]}x{img.shape[0]} but "
+                    f"the config expects {W_orig}x{H_orig} "
+                    f"(train/test_dataset.H/W) — set H/W to the capture "
+                    "resolution"
+                )
             if self.input_ratio != 1.0:
                 # uint8 INTER_AREA downscale, as the reference does before
                 # the /255 float conversion (blender.py:86-87)

@@ -1,0 +1,57 @@
+"""Smoke coverage for the small CLI tools: plot_loss parsing/figure and
+check_grid stats — the operational artifact-sanity layer of the reference's
+test strategy (SURVEY.md §4 items 2-3)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, _REPO)
+
+import plot_loss
+
+
+def test_plot_loss_parses_both_formats(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        # our recorder's console format
+        "eta: 0:01:00  epoch: 0  step: 10  loss: 0.5  psnr_mse: 0.1  "
+        "data: 0.001  batch: 0.2  lr: 0.0005  max_mem: 100.0\n"
+        "eta: 0:00:30  epoch: 1  step: 20  loss: 0.25  psnr_mse: 0.05  "
+        "data: 0.001  batch: 0.2  lr: 0.0005  max_mem: 100.0\n"
+        # validation summaries, both frameworks' spellings
+        "Average PSNR: 18.5\n"
+        "val epoch 1: psnr: 19.25  ssim: 0.81\n"
+    )
+    train, val = plot_loss.parse_log_file(str(log))
+    assert [r["step"] for r in train] == [10, 20]
+    assert train[1]["loss"] == 0.25
+    assert any(abs(v.get("psnr", 0) - 18.5) < 1e-9 for v in val)
+    assert any(abs(v.get("psnr", 0) - 19.25) < 1e-9 for v in val)
+
+    out = tmp_path / "curves.png"
+    plot_loss.plot_metrics(train, val, str(out))
+    assert out.exists() and out.stat().st_size > 0
+
+
+def test_check_grid_cli(tmp_path):
+    from nerf_replication_tpu.renderer.occupancy import save_occupancy_grid
+
+    grid = np.zeros((8, 8, 8), bool)
+    grid[2:6, 2:6, 2:6] = True
+    path = tmp_path / "logs" / "lego" / "occupancy_grid.npz"
+    save_occupancy_grid(
+        str(path), grid, [[-1.5] * 3, [1.5] * 3], 1.0
+    )
+
+    env = dict(os.environ, NERF_PLATFORM="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "check_grid.py"),
+         "--cfg_file", "configs/nerf/lego.yaml"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "64/512" in r.stdout  # 4^3 occupied of 8^3

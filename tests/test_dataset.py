@@ -175,3 +175,33 @@ def test_render_view_alpha_channel():
     assert img.shape == (32, 32, 4) and img.dtype == np.uint8
     alpha = img[..., 3]
     assert alpha.max() == 255 and alpha.min() == 0  # object + background present
+
+
+def test_blender_rejects_mismatched_capture_size(tmp_path):
+    """cfg H/W disagreeing with the images on disk must fail loudly — the
+    reference silently builds rays with the wrong focal/slicing."""
+    import pytest
+
+    from nerf_replication_tpu.datasets.blender import Dataset
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+
+    root = str(tmp_path)
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=2, n_test=1)
+    with pytest.raises(ValueError, match="capture resolution"):
+        Dataset(data_root=root, scene="procedural", split="train", H=32, W=32)
+
+
+def test_blender_rejects_mismatch_even_with_input_ratio(tmp_path):
+    """The size guard must fire on the PRE-resize capture size — input_ratio
+    resizing would otherwise coerce any capture (even aspect-distorting)
+    into the expected shape."""
+    import pytest
+
+    from nerf_replication_tpu.datasets.blender import Dataset
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+
+    root = str(tmp_path)
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=2, n_test=1)
+    with pytest.raises(ValueError, match="capture resolution"):
+        Dataset(data_root=root, scene="procedural", split="train",
+                H=32, W=32, input_ratio=0.5)

@@ -30,7 +30,9 @@ def setup(tmp_path_factory):
         ["task_arg.march_chunk_size", "32",
          "task_arg.max_march_samples", "8",
          "task_arg.render_step_size", "0.5",
-         "task_arg.chunk_size", "32"],
+         "task_arg.chunk_size", "32",
+         "train_dataset.H", "8", "train_dataset.W", "8",
+         "test_dataset.H", "8", "test_dataset.W", "8"],
     )
     network = make_network(cfg)
     params = init_params(network, jax.random.PRNGKey(0))

@@ -20,6 +20,15 @@ from dataclasses import dataclass, field
 import numpy as np
 
 
+def _uv_grid(h: int, w: int) -> np.ndarray:
+    """[h·w, 2] normalized (u, v) pixel coordinates in [0, 1] — one
+    construction for the native grid and the resized tuple-index path."""
+    X, Y = np.meshgrid(np.arange(w), np.arange(h))
+    u = X.astype(np.float32) / max(w - 1, 1)
+    v = Y.astype(np.float32) / max(h - 1, 1)
+    return np.stack([u, v], -1).reshape(-1, 2)
+
+
 @dataclass
 class Dataset:
     data_root: str
@@ -57,10 +66,7 @@ class Dataset:
             )
         self.img = img.astype(np.float32)
         self.H, self.W = img.shape[:2]
-        X, Y = np.meshgrid(np.arange(self.W), np.arange(self.H))
-        u = X.astype(np.float32) / (self.W - 1)
-        v = Y.astype(np.float32) / (self.H - 1)
-        self.uv = np.stack([u, v], -1).reshape(-1, 2)
+        self.uv = _uv_grid(self.H, self.W)
 
     @classmethod
     def from_cfg(cls, cfg, split: str) -> "Dataset":
@@ -96,7 +102,23 @@ class Dataset:
             "meta": {"H": self.H, "W": self.W},
         }
 
-    def __getitem__(self, index: int) -> dict:
+    def __getitem__(self, index) -> dict:
+        if isinstance(index, tuple):
+            # ImageSizeBatchSampler contract (reference samplers.py:10-47 via
+            # the light-stage datasets): the sampler hands (index, h, w) and
+            # the dataset resizes its item — scale augmentation for the 2-D
+            # regression task, with TPU-friendly bucketed (h, w).
+            index, h, w = index
+            import cv2
+
+            img = cv2.resize(
+                self.img, (w, h), interpolation=cv2.INTER_AREA
+            ).reshape(-1, 3)
+            uv = _uv_grid(h, w)
+            ids = np.random.choice(
+                h * w, min(self.batch_size, h * w), replace=False
+            )
+            return {"uv": uv[ids], "rgb": img[ids], "meta": {"H": h, "W": w}}
         if self.split == "train":
             ids = np.random.choice(len(self.uv), self.batch_size, replace=False)
             return {

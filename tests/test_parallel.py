@@ -224,3 +224,30 @@ def test_dp_step_matches_host_emulation(scene_root):
         new_state.params,
         expected_state.params,
     )
+
+
+def test_tp_is_pure_relayout(scene_root):
+    """Same data-axis size, same keys: a model_axis=2 GSPMD step must produce
+    numerically (close to) identical loss and updated params as model_axis=1
+    — tensor parallelism re-lays-out the math, it must not change it."""
+    devices = jax.devices()[:4]
+
+    results = []
+    for model_axis in (1, 2):
+        # fresh identical setup per layout (seeded init ⇒ same state)
+        cfg, net, loss, state, ds = _setup(scene_root)
+        # data axis fixed at 2 in both meshes → identical shard-local draws
+        mesh = make_mesh(data_axis=2, model_axis=model_axis,
+                         devices=devices[: 2 * model_axis])
+        state_sh = shard_train_state(state, mesh)
+        step = build_gspmd_step(mesh, loss, n_rays=128, near=2.0, far=6.0)
+        bank = shard_bank(*ds.ray_bank(), mesh)
+        state_sh, stats = step(state_sh, bank[0], bank[1], jax.random.PRNGKey(7))
+        results.append(
+            (float(stats["loss"]),
+             np.asarray(state_sh.params["coarse"]["pts_linear_0"]["kernel"]))
+        )
+
+    (loss_a, k_a), (loss_b, k_b) = results
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-5)
+    np.testing.assert_allclose(k_a, k_b, rtol=1e-4, atol=1e-6)

@@ -181,6 +181,28 @@ def main():
     dt = time.perf_counter() - t0
 
     rays_per_sec = n_rays * n_steps / dt
+
+    # closed-form MFU estimate (PERF.md arithmetic): per-point MLP cost =
+    # 2·params; a train step touches N_samples coarse + (N_samples +
+    # N_importance) fine points per ray, ×3 for fwd+bwd. Encoder tables are
+    # excluded (gathers, not MXU FLOPs).
+    import numpy as np
+
+    def _mlp_params(tree) -> int:
+        return sum(
+            int(np.prod(np.shape(leaf)))
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+            if "embeddings" not in str(path)
+        )
+
+    n_coarse = int(cfg.task_arg.N_samples)
+    n_fine = n_coarse + int(cfg.task_arg.get("N_importance", 0))
+    p_coarse = _mlp_params(state.params.get("coarse", {}))
+    p_fine = _mlp_params(state.params.get("fine", {}))
+    flops_per_ray = 3.0 * 2.0 * (p_coarse * n_coarse + p_fine * n_fine)
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e bf16
+    mfu = rays_per_sec * flops_per_ray / peak if flops_per_ray else None
+
     print(
         json.dumps(
             {
@@ -188,6 +210,8 @@ def main():
                 "value": round(rays_per_sec, 1),
                 "unit": "rays/s",
                 "vs_baseline": round(rays_per_sec / BASELINE_RAYS_PER_SEC, 2),
+                "mfu": round(mfu, 4) if mfu is not None else None,
+                "gflops_per_ray": round(flops_per_ray / 1e9, 3),
             }
         )
     )

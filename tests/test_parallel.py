@@ -251,3 +251,43 @@ def test_tp_is_pure_relayout(scene_root):
     (loss_a, k_a), (loss_b, k_b) = results
     np.testing.assert_allclose(loss_a, loss_b, rtol=1e-5)
     np.testing.assert_allclose(k_a, k_b, rtol=1e-4, atol=1e-6)
+
+
+def test_checkpoint_restores_across_topology(scene_root, tmp_path):
+    """Save an unsharded single-device bundle, restore it, shard the restored
+    state onto a dp x tp mesh, and step — the multi-host resume path (a chief
+    saves, a differently-sharded job restores). Catches Orbax sharding-
+    metadata coupling to the save-time topology."""
+    from nerf_replication_tpu.train.checkpoint import load_model, save_model
+
+    cfg, net, loss, state, ds = _setup(scene_root)
+    # advance one unsharded step so moments are non-trivial
+    from nerf_replication_tpu.train.step_core import sampled_grad_step
+    from nerf_replication_tpu.datasets.sampling import sample_step_key
+
+    rays, rgbs = (jnp.asarray(a) for a in ds.ray_bank())
+    k = sample_step_key(jax.random.PRNGKey(0), state.step)
+    k1, k2 = jax.random.split(k)
+    grads, _ = sampled_grad_step(
+        loss, state.params, rays, rgbs, 32, 2.0, 6.0, k1, k2
+    )
+    state = state.apply_gradients(grads=grads)
+
+    mdir = str(tmp_path / "ckpt")
+    save_model(mdir, state, epoch=3, recorder_state={"step": 25}, latest=True)
+
+    # fresh state (different values), restore, then shard onto the mesh
+    _, _, _, state2, _ = _setup(scene_root)
+    restored, begin_epoch, rec = load_model(mdir, state2)
+    assert begin_epoch == 4 and rec["step"] == 25
+    np.testing.assert_allclose(
+        np.asarray(restored.params["coarse"]["pts_linear_0"]["kernel"]),
+        np.asarray(state.params["coarse"]["pts_linear_0"]["kernel"]),
+    )
+
+    mesh = make_mesh(model_axis=2)
+    state_sh = shard_train_state(restored, mesh)
+    step = build_gspmd_step(mesh, loss, n_rays=128, near=2.0, far=6.0)
+    bank = shard_bank(rays, rgbs, mesh)
+    state_sh, stats = step(state_sh, bank[0], bank[1], jax.random.PRNGKey(2))
+    assert np.isfinite(float(stats["loss"]))

@@ -134,9 +134,20 @@ def main():
             hang_timeout_s=float(os.environ.get("BENCH_INIT_TIMEOUT_S", 120)),
         )
 
-    n_rays = int(os.environ.get("BENCH_N_RAYS", 4096))
-    n_steps = int(os.environ.get("BENCH_STEPS", 50))
-    config = os.environ.get("BENCH_CONFIG", "lego.yaml")
+    # Measured-best defaults: scripts/tpu_battery.sh promotes the winning
+    # sweep point into BENCH_DEFAULTS.json so the driver's plain
+    # `python bench.py` (no envs) runs the best known config. Envs still win.
+    defaults = {"n_rays": 4096, "steps": 50, "config": "lego.yaml",
+                "dtype": "bfloat16", "remat": "false"}
+    try:
+        with open(os.path.join(_REPO, "BENCH_DEFAULTS.json")) as f:
+            defaults.update(json.load(f))
+    except (OSError, ValueError):
+        pass
+
+    n_rays = int(os.environ.get("BENCH_N_RAYS", defaults["n_rays"]))
+    n_steps = int(os.environ.get("BENCH_STEPS", defaults["steps"]))
+    config = os.environ.get("BENCH_CONFIG", defaults["config"])
 
     cfg = make_cfg(
         os.path.join(_REPO, "configs", "nerf", config),
@@ -144,8 +155,10 @@ def main():
             "task_arg.N_rays", str(n_rays),
             "task_arg.precrop_iters", "0",
             # TPU-native default: bf16 MXU matmuls, f32 params/heads/compositing
-            "precision.compute_dtype", os.environ.get("BENCH_DTYPE", "bfloat16"),
-            "task_arg.remat", os.environ.get("BENCH_REMAT", "false"),
+            "precision.compute_dtype",
+            os.environ.get("BENCH_DTYPE", defaults["dtype"]),
+            "task_arg.remat",
+            os.environ.get("BENCH_REMAT", str(defaults["remat"]).lower()),
         ],
     )
     network = make_network(cfg)
@@ -196,11 +209,16 @@ def main():
         )
 
     n_coarse = int(cfg.task_arg.N_samples)
-    n_fine = n_coarse + int(cfg.task_arg.get("N_importance", 0))
+    n_importance = int(cfg.task_arg.get("N_importance", 0))
     p_coarse = _mlp_params(state.params.get("coarse", {}))
     p_fine = _mlp_params(state.params.get("fine", {}))
-    flops_per_ray = 3.0 * 2.0 * (p_coarse * n_coarse + p_fine * n_fine)
-    peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e bf16
+    # the fine network only runs when hierarchical sampling is on
+    fine_term = p_fine * (n_coarse + n_importance) if n_importance > 0 else 0
+    flops_per_ray = 3.0 * 2.0 * (p_coarse * n_coarse + fine_term)
+    # v5e peak: 197 TFLOP/s bf16; fp32 runs the MXU at ~half rate
+    dtype = str(cfg.precision.compute_dtype)
+    default_peak = 197e12 if dtype == "bfloat16" else 98.5e12
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", default_peak))
     mfu = rays_per_sec * flops_per_ray / peak if flops_per_ray else None
 
     print(
@@ -212,6 +230,9 @@ def main():
                 "vs_baseline": round(rays_per_sec / BASELINE_RAYS_PER_SEC, 2),
                 "mfu": round(mfu, 4) if mfu is not None else None,
                 "gflops_per_ray": round(flops_per_ray / 1e9, 3),
+                "dtype": dtype,
+                "peak_flops": peak,
+                "n_rays": n_rays,
             }
         )
     )

@@ -1,0 +1,305 @@
+"""Light-stage / ZJU-MoCap multi-view capture dataset, TPU-native.
+
+Capability parity with the reference's `src/datasets/light_stage.py:10-237`
+(the last §2.4 component): a calibrated multi-camera rig captured over time —
+``annots.npy`` with per-camera ``K/D/R/T`` and per-frame image lists,
+foreground (human) masks, per-frame SMPL vertices defining a moving bbox,
+and rays that carry a per-frame latent index for time-conditioned encoders
+(models/encoding/dynamic.py consumes ``(x, y, z, t)``).
+
+TPU-first redesign of the sampling path: the reference draws each batch on
+the host with rejection sampling — 50% foreground rays (random pixels until
+enough land on ``mask==1``) and 50% background rays (pixels inside the
+projected world-bbox hull), per ``__getitem__`` call, every step
+(light_stage.py:174-206). Here both candidate sets are enumerated ONCE into
+a device-resident two-segment ray bank — all foreground pixels, then
+background pixels resampled to the same count — so the jitted trainer's
+uniform bank draw yields the same 50/50 fg/bg mixture in expectation with
+zero per-step host work and no rejection loops.
+
+Matches the reference's mask handling: a ``border``-px erode/dilate band
+around the silhouette is marked ambiguous (value 100) and excluded from the
+foreground set (light_stage.py:110-116); masked-out pixels are zeroed in the
+image (light_stage.py:151). Undistortion and ``input_ratio`` resize follow
+light_stage.py:128-150. T is stored in millimetres in ZJU annots and is
+scaled by 1/1000 (light_stage.py:163).
+
+Rays are ``[N, 7]``: origin, direction, latent (time) index. The static
+renderer slices columns 0:6 and ignores the t column; dynamic-encoder tasks
+read it. Scalar near/far for the volume renderer are derived from the world
+bbox: min/max camera-to-bbox-corner distance with a safety margin (the
+reference leaves per-ray box intersection to its human-NeRF renderers; this
+dataset is not wired into its NeRF path either — SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+def _erode_dilate_band(msk: np.ndarray, border: int = 5) -> np.ndarray:
+    """Mark the ±border boundary band of a binary mask with 100
+    (light_stage.py:110-116's cv2 erode/dilate)."""
+    import cv2
+
+    kernel = np.ones((border, border), np.uint8)
+    eroded = cv2.erode(msk.copy(), kernel)
+    dilated = cv2.dilate(msk.copy(), kernel)
+    out = msk.copy()
+    out[(dilated - eroded) == 1] = 100
+    return out
+
+
+def _project_bbox_hull_mask(wbbox: np.ndarray, K: np.ndarray,
+                            ext: np.ndarray, H: int, W: int) -> np.ndarray:
+    """Binary mask of the world-bbox's projected convex hull
+    (base_utils.get_bound_2d_mask's role in light_stage.py:183-186)."""
+    import cv2
+
+    lo, hi = wbbox[:3], wbbox[3:6]
+    corners = np.array(
+        [[x, y, z] for x in (lo[0], hi[0]) for y in (lo[1], hi[1])
+         for z in (lo[2], hi[2])]
+    )
+    cam = corners @ ext[:3, :3].T + ext[:3, 3]
+    # guard: corners behind the camera would project nonsensically
+    cam[:, 2] = np.maximum(cam[:, 2], 1e-6)
+    pix = cam @ K.T
+    pix = (pix[:, :2] / pix[:, 2:3]).astype(np.int32)
+    mask = np.zeros((H, W), np.uint8)
+    hull = cv2.convexHull(pix.reshape(-1, 1, 2))
+    cv2.fillConvexPoly(mask, hull, 1)
+    return mask
+
+
+@dataclass
+class Dataset:
+    """One split of a light-stage capture rooted at ``data_root``."""
+
+    data_root: str
+    split: str = "train"
+    cameras: tuple = (0, -1, 1)   # [start, end, skip] over the rig
+    frames: tuple = (0, -1, 1)    # [start, end, skip] over time
+    input_ratio: float = 1.0
+    mask_border: int = 5
+    scene: str = ""               # registry/catalog compatibility; unused
+
+    H: int = field(init=False)
+    W: int = field(init=False)
+    near: float = field(init=False)
+    far: float = field(init=False)
+    wbbox: np.ndarray = field(init=False)
+
+    def __post_init__(self):
+        annots = np.load(
+            os.path.join(self.data_root, "annots.npy"), allow_pickle=True
+        ).item()
+        self.cams = annots["cams"]
+        n_cams = len(self.cams["K"])
+        c0, c1, cs = self.cameras
+        c1 = n_cams if c1 == -1 else c1
+        self.camera_ids = list(range(n_cams))[c0:c1:cs]
+
+        n_frames = len(annots["ims"])
+        f0, f1, fs = self.frames
+        f1 = n_frames if f1 == -1 else f1
+        self.frame_ids = list(range(n_frames))[f0:f1:fs]
+        # latent index = position within the selected frame range, so frame
+        # subsampling still yields dense [0, T) indices for latent tables
+        self._latent = {f: i for i, f in enumerate(self.frame_ids)}
+
+        # per-frame SMPL-vertex bbox (±5 cm), world bbox over all frames
+        # (light_stage.py:65-89)
+        bboxes = []
+        for f in self.frame_ids:
+            verts = np.load(
+                os.path.join(self.data_root, "new_vertices", f"{f}.npy")
+            )
+            bboxes.append(
+                np.concatenate([verts.min(0) - 0.05, verts.max(0) + 0.05])
+            )
+        bboxes = np.stack(bboxes)
+        self.wbbox = np.concatenate(
+            [bboxes[:, :3].min(0), bboxes[:, 3:6].max(0)]
+        ).astype(np.float32)
+
+        self.items = [
+            {"frame": f, "camera": c,
+             "path": annots["ims"][f]["ims"][c]}
+            for f in self.frame_ids
+            for c in self.camera_ids
+        ]
+
+        self._load_all()
+        self._derive_bounds()
+
+    # ---- image/mask loading ------------------------------------------------
+    def _mask_path(self, rel: str) -> str:
+        """mask_cihp > mask > images→mask substitution, all with .png
+        (light_stage.py:94-103's fallback chain, on capture-relative
+        paths)."""
+        stem = os.path.splitext(rel)[0] + ".png"
+        for cand in (
+            os.path.join(self.data_root, "mask_cihp", stem),
+            os.path.join(self.data_root, "mask", stem),
+            os.path.join(self.data_root, stem.replace("images", "mask")),
+        ):
+            if os.path.exists(cand):
+                return cand
+        raise FileNotFoundError(f"no mask found for {rel}")
+
+    def _read_item(self, item):
+        import cv2
+        from PIL import Image
+
+        img = np.asarray(
+            Image.open(os.path.join(self.data_root, item["path"])).convert(
+                "RGB"
+            ),
+            dtype=np.float32,
+        ) / 255.0
+        H, W = img.shape[:2]
+        with Image.open(self._mask_path(item["path"])) as m:
+            msk = np.asarray(m)
+        if msk.ndim == 3:
+            msk = msk[..., 0]
+        msk = (msk != 0).astype(np.uint8)
+        msk = cv2.resize(msk, (W, H), interpolation=cv2.INTER_NEAREST)
+
+        K = np.array(self.cams["K"][item["camera"]], np.float64).copy()
+        D = np.array(self.cams["D"][item["camera"]], np.float64)
+        img = cv2.undistort(img, K, D)
+        msk = cv2.undistort(msk, K, D)
+
+        if self.input_ratio != 1.0:
+            img = cv2.resize(img, None, fx=self.input_ratio,
+                             fy=self.input_ratio,
+                             interpolation=cv2.INTER_AREA)
+            msk = cv2.resize(msk, None, fx=self.input_ratio,
+                             fy=self.input_ratio,
+                             interpolation=cv2.INTER_NEAREST)
+            K[:2] *= self.input_ratio
+
+        img = img.copy()
+        img[msk == 0] = 0.0
+        msk = _erode_dilate_band(msk, self.mask_border)
+
+        R = np.array(self.cams["R"][item["camera"]], np.float64)
+        T = np.array(self.cams["T"][item["camera"]], np.float64).reshape(3, 1)
+        T = T / 1000.0  # ZJU annots store millimetres (light_stage.py:163)
+        ext = np.concatenate([R, T], axis=1)  # world→camera [3,4]
+        return img, msk, K, ext
+
+    def _rays_for(self, K, ext, ys, xs, latent: int):
+        """[len(ys), 7] world rays through pixel centers + latent column
+        (light_stage.py:208-219's pixel→camera→world chain)."""
+        c2w = np.eye(4)
+        c2w[:3] = ext
+        c2w = np.linalg.inv(c2w)
+        d_pix = np.stack([xs, ys, np.ones_like(xs)], -1).astype(np.float64)
+        d = d_pix @ np.linalg.inv(K).T @ c2w[:3, :3].T
+        d = d / np.linalg.norm(d, axis=-1, keepdims=True)
+        o = np.broadcast_to(c2w[:3, 3], d.shape)
+        t = np.full((len(d_pix), 1), float(latent))
+        return np.concatenate([o, d, t], -1).astype(np.float32)
+
+    def _load_all(self):
+        fg_rays, fg_rgbs, bg_rays, bg_rgbs = [], [], [], []
+        self._eval = []
+        rng = np.random.default_rng(0)
+        for item in self.items:
+            img, msk, K, ext = self._read_item(item)
+            H, W = img.shape[:2]
+            latent = self._latent[item["frame"]]
+
+            if self.split != "train":
+                ys, xs = np.mgrid[0:H, 0:W].astype(np.float64)
+                rays = self._rays_for(K, ext, ys.ravel(), xs.ravel(), latent)
+                self._eval.append(
+                    {"rays": rays, "rgb": img.reshape(-1, 3),
+                     "H": H, "W": W, "mask": msk}
+                )
+                continue
+
+            ys, xs = np.nonzero(msk == 1)  # interior fg, band excluded
+            fg_rays.append(self._rays_for(K, ext, ys, xs, latent))
+            fg_rgbs.append(img[ys, xs])
+
+            hull = _project_bbox_hull_mask(self.wbbox, K, ext, H, W)
+            ys_b, xs_b = np.nonzero(hull == 1)
+            bg_rays.append(self._rays_for(K, ext, ys_b, xs_b, latent))
+            bg_rgbs.append(img[ys_b, xs_b])
+
+        if self.split == "train":
+            fg_r = np.concatenate(fg_rays)
+            fg_c = np.concatenate(fg_rgbs)
+            bg_r = np.concatenate(bg_rays)
+            bg_c = np.concatenate(bg_rgbs)
+            # two equal segments ⇒ uniform sampling is 50/50 fg/bg in
+            # expectation (the reference's fg_num = N_rays // 2)
+            idx = rng.integers(0, len(bg_r), size=len(fg_r))
+            self.rays = np.concatenate([fg_r, bg_r[idx]])
+            self.rgbs = np.concatenate([fg_c, bg_c[idx]]).astype(np.float32)
+        self.n_images = len(self._eval) if self.split != "train" else len(
+            self.items
+        )
+        ref = self._eval[0] if self._eval else None
+        self.H = ref["H"] if ref else 0
+        self.W = ref["W"] if ref else 0
+
+    def _derive_bounds(self):
+        """Scalar near/far from camera-to-bbox-corner distances."""
+        lo, hi = self.wbbox[:3], self.wbbox[3:6]
+        corners = np.array(
+            [[x, y, z] for x in (lo[0], hi[0]) for y in (lo[1], hi[1])
+             for z in (lo[2], hi[2])]
+        )
+        dists = []
+        for c in self.camera_ids:
+            R = np.array(self.cams["R"][c], np.float64)
+            T = np.array(self.cams["T"][c], np.float64).reshape(3) / 1000.0
+            center = -R.T @ T
+            dists.append(np.linalg.norm(corners - center, axis=-1))
+        dists = np.stack(dists)
+        self.near = float(max(dists.min() * 0.8, 0.05))
+        self.far = float(dists.max() * 1.2)
+
+    # ---- framework contract ------------------------------------------------
+    def ray_bank(self):
+        return self.rays, self.rgbs
+
+    def __len__(self) -> int:
+        if self.split == "train":
+            return 1_000_000
+        return len(self._eval)
+
+    def image_batch(self, index: int) -> dict:
+        e = self._eval[index]
+        return {
+            "rays": e["rays"],
+            "rgb": e["rgb"],
+            "H": e["H"], "W": e["W"],
+            "mask": e["mask"],
+            "wbounds": self.wbbox,
+            "near": np.float32(self.near),
+            "far": np.float32(self.far),
+        }
+
+    @classmethod
+    def from_cfg(cls, cfg, split: str) -> "Dataset":
+        node = cfg.train_dataset if split == "train" else cfg.test_dataset
+        return cls(
+            data_root=node.data_root,
+            split=node.get("split", split),
+            cameras=tuple(node.get("cameras", [0, -1, 1])),
+            frames=tuple(node.get("frames", [0, -1, 1])),
+            input_ratio=float(node.get("input_ratio", 1.0)),
+            scene=cfg.get("scene", ""),
+        )
+
+
+def make_dataset(cfg, split: str) -> Dataset:
+    return Dataset.from_cfg(cfg, split)

@@ -28,6 +28,7 @@ _ALIASES: dict[str, str] = {
     "src.datasets.nerf.blender": f"{_PKG}.datasets.blender",
     "src.datasets.img_fit.synthetic": f"{_PKG}.datasets.img_fit",
     "src.datasets.latent": f"{_PKG}.datasets.latent",
+    "src.datasets.light_stage": f"{_PKG}.datasets.light_stage",
     "src.models.nerf.network": f"{_PKG}.models.nerf.network",
     "src.models.img_fit.network": f"{_PKG}.models.img_fit.network",
     "src.models.nerf.renderer.volume_renderer": f"{_PKG}.renderer.volume",

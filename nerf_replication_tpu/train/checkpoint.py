@@ -110,11 +110,24 @@ def load_network(model_dir: str, params, epoch: int = -1):
     if target is None:
         return params, -1
 
-    ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(_abs(target))
     # accept either the raw param tree or the {"params": ...} wrapper
     wrapped = isinstance(params, dict) and set(params.keys()) == {"params"}
     inner = params["params"] if wrapped else params
+    # partial restore against the caller's template: only the "params" item
+    # of the bundle is read (opt_state/step/recorder are skipped), and each
+    # leaf restores with the template's dtype/shape/sharding — topology-safe
+    # on sharded multi-host restores and free of the orbax "sharding info
+    # not provided" warning that blind PyTreeCheckpointer.restore emits
+    template = {"params": inner}
+    ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    restored = ckptr.restore(
+        _abs(target),
+        args=ocp.args.PyTreeRestore(
+            item=template,
+            transforms={},
+            restore_args=ocp.checkpoint_utils.construct_restore_args(template),
+        ),
+    )
     loaded = jax.tree.map(
         lambda t, r: np.asarray(r).astype(t.dtype).reshape(t.shape),
         inner,

@@ -69,6 +69,7 @@ class Trainer:
         self.process_index = jax.process_index()
         self._step_fn = None
         self._step_fn_pool = None
+        self._val_render = None
 
     def epoch_iters(self, bank_size: int) -> int:
         """Steps per epoch. ep_iter=-1 (the reference's 'no resampling'
@@ -151,15 +152,30 @@ class Trainer:
     def val(self, state, epoch: int, test_dataset, recorder: Recorder | None = None,
             max_images: int | None = None, log=print):
         """Epoch-boundary validation (trainer.py:98-130): render whole test
-        images via the chunked path, run the evaluator per image."""
-        renderer = self.loss.renderer
+        images and run the evaluator per image. Renders go through the shared
+        gate (renderer/gate.py): chunked single-device by default, sequence-
+        parallel over the mesh's data axis under ``eval.sharded: true`` — on
+        a pod, in-training validation must not render 800² images on the
+        chief chip alone."""
+        # cache keyed on the dataset: the sharded gate bakes the dataset's
+        # near/far jit-static, so a different test set needs a fresh gate
+        if self._val_render is None or self._val_render[0] is not test_dataset:
+            from ..renderer.gate import full_image_render_fn
+
+            self._val_render = (
+                test_dataset,
+                full_image_render_fn(
+                    self.cfg, self.network, self.loss.renderer, test_dataset,
+                    use_grid=False,
+                ),
+            )
         params = {"params": state.params}
         n = len(test_dataset)
         if max_images is not None:
             n = min(n, max_images)
         for i in range(n):
             batch = test_dataset.image_batch(i)
-            out = renderer.render_chunked(
+            out = self._val_render[1](
                 params,
                 {
                     "rays": jnp.asarray(batch["rays"]),

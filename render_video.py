@@ -21,10 +21,17 @@ RADIUS = 4.0
 
 
 def spiral_frames(renderer, params, H, W, focal, near, far, n_frames=N_FRAMES,
-                  phi_deg=PHI_DEG, radius=RADIUS, progress=True):
-    """Render the 360° spiral as a list of uint8 [H, W, 3] frames."""
+                  phi_deg=PHI_DEG, radius=RADIUS, progress=True,
+                  render_fn=None):
+    """Render the 360° spiral as a list of uint8 [H, W, 3] frames.
+
+    ``render_fn`` overrides the per-frame renderer (e.g. the shared gate's
+    sequence-parallel path on a pod); defaults to the occupancy-accelerated
+    single-device march."""
     from nerf_replication_tpu.datasets.rays import get_rays_np, pose_spherical
 
+    if render_fn is None:
+        render_fn = renderer.render_accelerated
     thetas = np.linspace(-180.0, 180.0, n_frames, endpoint=False)
     if progress:
         from tqdm import tqdm
@@ -36,7 +43,7 @@ def spiral_frames(renderer, params, H, W, focal, near, far, n_frames=N_FRAMES,
         rays_o, rays_d = get_rays_np(H, W, focal, c2w)
         rays = np.concatenate([rays_o, rays_d], -1).reshape(-1, 6)
         batch = {"rays": rays, "near": np.float32(near), "far": np.float32(far)}
-        out = renderer.render_accelerated(params, batch)
+        out = render_fn(params, batch)
         key = "rgb_map_f" if "rgb_map_f" in out else "rgb_map_c"
         rgb = np.clip(np.asarray(out[key]).reshape(H, W, 3), 0.0, 1.0)
         frames.append((rgb * 255).astype(np.uint8))
@@ -52,14 +59,25 @@ def render_360_video(cfg, args=None):
 
     network, params, _ = load_trained_network(cfg)
     renderer = make_renderer(cfg, network)
-    if bool(cfg.task_arg.get("accelerated_renderer", False)) and args is not None:
+    use_grid = bool(
+        cfg.task_arg.get("accelerated_renderer", False)
+    ) and args is not None
+    if use_grid:
         renderer.load_occupancy_grid(default_grid_path(args.cfg_file))
 
     test_ds = make_dataset(cfg, "test")
+    # the shared whole-image gate: single-device by default, sequence-
+    # parallel over the mesh under ``eval.sharded: true`` (renderer/gate.py)
+    from nerf_replication_tpu.renderer.gate import full_image_render_fn
+
+    render_fn = full_image_render_fn(
+        cfg, network, renderer, test_ds, use_grid=use_grid
+    )
     frames = spiral_frames(
         renderer, params, test_ds.H, test_ds.W, test_ds.focal,
         test_ds.near, test_ds.far,
         n_frames=int(cfg.task_arg.get("video_frames", N_FRAMES)),
+        render_fn=render_fn,
     )
     os.makedirs(cfg.result_dir, exist_ok=True)
     out_path = _write_video(os.path.join(cfg.result_dir, "video"), frames)

@@ -51,73 +51,12 @@ def run_dataset(cfg, args=None):
 
 
 def _full_image_render_fn(cfg, network, renderer, test_ds, use_grid=False):
-    """Whole-image renderer for the eval CLIs: single-device by default;
-    ``eval.sharded: true`` on a multi-device runtime shards the ray axis of
-    each image over the mesh's data axis (sequence parallelism —
-    parallel/sequence.py) with in-shard chunking for memory. ``use_grid``
-    selects the occupancy-accelerated ESS+ERT march (a grid must already be
-    loaded on the renderer)."""
-    import jax
+    """Whole-image renderer for the eval CLIs — the shared render gate
+    (renderer/gate.py): single-device chunked by default, sequence-parallel
+    over the mesh's data axis under ``eval.sharded: true``."""
+    from nerf_replication_tpu.renderer.gate import full_image_render_fn
 
-    sharded = (
-        bool(cfg.get("eval", {}).get("sharded", False))
-        and jax.device_count() > 1
-    )
-    if not sharded:
-        if use_grid:
-            return renderer.render_accelerated
-        return lambda params, batch: renderer.render_chunked(params, batch)
-
-    import jax.numpy as jnp
-
-    from nerf_replication_tpu.parallel.mesh import make_mesh_from_cfg
-    from nerf_replication_tpu.parallel.sequence import (
-        build_sequence_parallel_march,
-        build_sequence_parallel_renderer,
-    )
-
-    # the sharded builders bake near/far as jit-static march bounds
-    near, far = float(test_ds.near), float(test_ds.far)
-
-    def check_bounds(batch):
-        # the single-device paths honor per-batch bounds; the sharded
-        # executables can't — reject a mismatch instead of silently
-        # rendering at the wrong depth range
-        if float(batch["near"]) != near or float(batch["far"]) != far:
-            raise ValueError(
-                f"eval.sharded baked bounds ({near}, {far}) but the batch "
-                f"carries ({float(batch['near'])}, {float(batch['far'])})"
-            )
-
-    mesh = make_mesh_from_cfg(cfg)
-    if use_grid:
-        march = build_sequence_parallel_march(
-            mesh, network, renderer.march_options, near=near, far=far,
-            chunk_size=renderer.march_options.chunk_size,
-        )
-
-        def render(params, batch):
-            check_bounds(batch)
-            out = march(params, jnp.asarray(batch["rays"]),
-                        renderer.occupancy_grid, renderer.grid_bbox)
-            renderer.accumulate_truncated(out.pop("n_truncated"))
-            return out
-
-        return render
-
-    # reuse the renderer's own eval options — a second from_cfg would be
-    # a divergence point if Renderer ever adjusts them
-    options = renderer.eval_options
-    sp = build_sequence_parallel_renderer(
-        mesh, network, options, near=near, far=far,
-        chunk_size=options.chunk_size,
-    )
-
-    def render(params, batch):
-        check_bounds(batch)
-        return sp(params, jnp.asarray(batch["rays"]))
-
-    return render
+    return full_image_render_fn(cfg, network, renderer, test_ds, use_grid)
 
 
 def run_network(cfg, args=None):

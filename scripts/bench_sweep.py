@@ -15,6 +15,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -32,7 +33,20 @@ def main(argv=None):
     p.add_argument("--out", default="")
     args = p.parse_args(argv)
 
-    out_f = open(args.out, "w") if args.out else None
+    # append mode, opened lazily on first write: a sweep that dies early (or
+    # wedges after one point) must never destroy a prior run's records.
+    # Downstream, promote_bench_defaults keeps only the LAST record per
+    # sweep point (by ts) — a re-measurement replaces its history.
+    out_f = None
+
+    def _emit(rec):
+        nonlocal out_f
+        if args.out:
+            if out_f is None:
+                out_f = open(args.out, "a")
+            out_f.write(json.dumps(rec) + "\n")
+            out_f.flush()
+
     for n_rays in args.rays:
         for dtype in args.dtypes:
             for remat in args.remat:
@@ -61,11 +75,9 @@ def main(argv=None):
                     # sweep and lose every prior record
                     rec = {"error": f"point exceeded {args.point_timeout}s"}
                 rec.update(n_rays=n_rays, dtype=dtype, remat=remat == "true",
-                           config=args.config)
+                           config=args.config, ts=round(time.time(), 1))
                 print(json.dumps(rec), flush=True)
-                if out_f:  # written per point: a crash keeps prior records
-                    out_f.write(json.dumps(rec) + "\n")
-                    out_f.flush()
+                _emit(rec)  # written per point: a crash keeps prior records
     if out_f:
         out_f.close()
 

@@ -3,12 +3,15 @@
 Capability parity with the reference's vendored instant-ngp script
 (scripts/colmap2nerf.py:27-440): optionally run COLMAP (feature extraction,
 matching, mapping) on an image folder when the binary is present, then parse
-the text model (cameras.txt / images.txt) into camera intrinsics +
-camera-to-world poses in the NeRF convention, recentre/rescale the scene, and
-write transforms.json with per-frame sharpness scores.
+the model — binary cameras.bin/images.bin or text cameras.txt/images.txt —
+into camera intrinsics + camera-to-world poses in the NeRF convention,
+recentre/rescale the scene, and write transforms.json with per-frame
+sharpness scores.
 
-Written from the COLMAP text-model format spec (qw qx qy qz tx ty tz are
-world→camera); not a copy of the vendored script.
+Written from the COLMAP model format specs (qw qx qy qz tx ty tz are
+world→camera; binary is little-endian structs with NUL-terminated names);
+not a copy of the vendored script (ref read_write_model.py:503 is the
+capability being matched).
 
     python scripts/colmap2nerf.py --images data/scene/images \
         [--run_colmap] [--text data/scene/colmap_text] \
@@ -80,6 +83,103 @@ def parse_images_txt(path):
         out.append((parts[9], int(parts[8]), qvec, tvec))
         i += 1  # the 2D-points partner line, possibly empty
     return out
+
+
+# COLMAP binary model support (the capability ref src/utils/colmap/
+# read_write_model.py:503 provides): model_id → (name, #params), from the
+# COLMAP camera-model table. Only ids that `intrinsics` understands are
+# listed; an unknown id fails loudly there with the model name.
+_CAMERA_MODELS = {
+    0: ("SIMPLE_PINHOLE", 3),
+    1: ("PINHOLE", 4),
+    2: ("SIMPLE_RADIAL", 4),
+    3: ("RADIAL", 5),
+    4: ("OPENCV", 8),
+    5: ("OPENCV_FISHEYE", 8),
+    6: ("FULL_OPENCV", 12),
+    7: ("FOV", 5),
+    8: ("SIMPLE_RADIAL_FISHEYE", 4),
+    9: ("RADIAL_FISHEYE", 5),
+    10: ("THIN_PRISM_FISHEYE", 12),
+}
+
+
+def parse_cameras_bin(path):
+    """camera_id → dict(model, width, height, params), from cameras.bin.
+
+    Binary layout (little-endian): uint64 n_cameras, then per camera
+    int32 camera_id, int32 model_id, uint64 width, uint64 height,
+    double params[n_params(model_id)].
+    """
+    import struct
+
+    cams = {}
+    with open(path, "rb") as f:
+        (n,) = struct.unpack("<Q", f.read(8))
+        for _ in range(n):
+            cam_id, model_id, width, height = struct.unpack(
+                "<iiQQ", f.read(24)
+            )
+            if model_id not in _CAMERA_MODELS:
+                raise ValueError(f"unknown COLMAP camera model id {model_id}")
+            name, n_params = _CAMERA_MODELS[model_id]
+            params = struct.unpack(f"<{n_params}d", f.read(8 * n_params))
+            cams[cam_id] = {
+                "model": name,
+                "width": int(width),
+                "height": int(height),
+                "params": list(params),
+            }
+    return cams
+
+
+def parse_images_bin(path):
+    """[(image_name, camera_id, qvec, tvec)], from images.bin.
+
+    Binary layout (little-endian): uint64 n_images, then per image
+    int32 image_id, double qvec[4], double tvec[3], int32 camera_id,
+    NUL-terminated name, uint64 n_points2D, then n_points2D ×
+    (double x, double y, int64 point3D_id) which we skip.
+    """
+    import struct
+
+    out = []
+    with open(path, "rb") as f:
+        (n,) = struct.unpack("<Q", f.read(8))
+        for _ in range(n):
+            vals = struct.unpack("<i7di", f.read(64))
+            qvec = list(vals[1:5])
+            tvec = list(vals[5:8])
+            cam_id = vals[8]
+            name = bytearray()
+            while True:
+                c = f.read(1)
+                if c in (b"", b"\x00"):
+                    break
+                name += c
+            (n_pts,) = struct.unpack("<Q", f.read(8))
+            f.seek(24 * n_pts, 1)  # (x, y, point3D_id) records
+            out.append((name.decode("utf-8"), cam_id, qvec, tvec))
+    return out
+
+
+def parse_model(model_dir):
+    """(cameras, images) from a COLMAP model dir, binary or text.
+
+    Prefers cameras.bin/images.bin (COLMAP's default export — no
+    `colmap model_converter` round-trip needed), falls back to
+    cameras.txt/images.txt.
+    """
+    bin_path = os.path.join(model_dir, "cameras.bin")
+    if os.path.exists(bin_path):
+        return (
+            parse_cameras_bin(bin_path),
+            parse_images_bin(os.path.join(model_dir, "images.bin")),
+        )
+    return (
+        parse_cameras_txt(os.path.join(model_dir, "cameras.txt")),
+        parse_images_txt(os.path.join(model_dir, "images.txt")),
+    )
 
 
 def intrinsics(cam):
@@ -201,8 +301,9 @@ def run_colmap(images_dir: str, workspace: str):
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--images", required=True, help="image folder")
-    parser.add_argument("--text", default=None,
-                        help="COLMAP text-model dir (cameras.txt/images.txt)")
+    parser.add_argument("--text", "--model", dest="text", default=None,
+                        help="COLMAP model dir — binary (cameras.bin/"
+                             "images.bin) or text (cameras.txt/images.txt)")
     parser.add_argument("--run_colmap", action="store_true")
     parser.add_argument("--video_in", default="",
                         help="extract frames from this video into --images "
@@ -225,8 +326,7 @@ def main(argv=None):
     if text is None:
         raise SystemExit("need --text (or --run_colmap)")
 
-    cams = parse_cameras_txt(os.path.join(text, "cameras.txt"))
-    images = parse_images_txt(os.path.join(text, "images.txt"))
+    cams, images = parse_model(text)
     if not images:
         raise SystemExit("no registered images in the COLMAP model")
 

@@ -1,0 +1,80 @@
+"""Promote the best measured sweep point into BENCH_DEFAULTS.json.
+
+The driver runs a plain `python bench.py` (no envs) at round end; bench.py
+reads BENCH_DEFAULTS.json as its fallback defaults, so promoting the winning
+(n_rays, dtype, remat) from a sweep makes the driver's headline use the best
+known config instead of the conservative 4096-ray default.
+
+    python scripts/promote_bench_defaults.py BENCH_SWEEP_REMAT.jsonl \
+        [more.jsonl ...] [--config lego.yaml]
+
+Sweep files are append-only (a crash must not destroy prior records), so a
+point may appear many times across runs; only the LAST record per
+(config, n_rays, dtype, remat) key counts — a re-measured point replaces its
+stale history instead of a stale fast record winning forever. Error records
+are never promoted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("sweeps", nargs="+", help="sweep .jsonl files to scan")
+    p.add_argument("--config", default="lego.yaml",
+                   help="only consider points measured on this config")
+    p.add_argument("--out", default=os.path.join(_REPO, "BENCH_DEFAULTS.json"))
+    args = p.parse_args(argv)
+
+    # last record per sweep point wins (files are append-only across runs)
+    latest: dict[tuple, dict] = {}
+    for path in args.sweeps:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("config", "lego.yaml") != args.config:
+                continue
+            key = (rec.get("n_rays"), rec.get("dtype"), rec.get("remat"))
+            # recency by the record's ts (absent on pre-r3 records ⇒ oldest);
+            # ties (same run) resolve to file/line order
+            if key not in latest or rec.get("ts", 0) >= latest[key].get("ts", 0):
+                latest[key] = rec
+
+    valid = [r for r in latest.values()
+             if isinstance(r.get("value"), (int, float))]
+    best = max(valid, key=lambda r: r["value"], default=None)
+    if best is None:
+        print("promote: no valid points found; leaving defaults untouched")
+        return 1
+
+    defaults = {
+        "n_rays": int(best["n_rays"]),
+        "dtype": best.get("dtype", "bfloat16"),
+        "remat": "true" if best.get("remat") else "false",
+        "config": args.config,
+        "measured_rays_per_sec": round(float(best["value"]), 1),
+        "source": "scripts/promote_bench_defaults.py",
+    }
+    with open(args.out, "w") as f:
+        json.dump(defaults, f, indent=1)
+        f.write("\n")
+    print(f"promote: wrote {args.out}: {json.dumps(defaults)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

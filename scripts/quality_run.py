@@ -41,6 +41,10 @@ def main(argv=None):
     p.add_argument("--force_platform", default=os.environ.get(
         "BENCH_FORCE_PLATFORM", ""))
     p.add_argument("--tag", default="quality")
+    p.add_argument("--config", default="lego.yaml",
+                   help="config under configs/nerf/ (e.g. lego_hash.yaml)")
+    p.add_argument("--out_prefix", default="QUALITY",
+                   help="repo-root prefix for the .jsonl trace and .md report")
     p.add_argument("opts", nargs="*", default=[],
                    help="trailing cfg key/value overrides (smoke runs)")
     args = p.parse_args(argv)
@@ -63,9 +67,35 @@ def main(argv=None):
     from nerf_replication_tpu.train.trainer import Trainer
 
     scene = "procedural"
-    if not os.path.exists(
-        os.path.join(args.scene_root, scene, "transforms_train.json")
-    ):
+    tjson = os.path.join(args.scene_root, scene, "transforms_train.json")
+    stale = False
+    if os.path.exists(tjson):
+        # a scene dir left by an earlier run at a different resolution or
+        # view count would silently train on the wrong scene (or trip the
+        # dataset's capture-size guard) — regenerate instead
+        from PIL import Image
+
+        first = os.path.join(args.scene_root, scene, "train", "r_0.png")
+        n_train = len(json.load(open(tjson)).get("frames", []))
+        tjson_test = os.path.join(
+            args.scene_root, scene, "transforms_test.json"
+        )
+        n_test = -1
+        if os.path.exists(tjson_test):
+            n_test = len(json.load(open(tjson_test)).get("frames", []))
+        if (not os.path.exists(first) or n_train != args.views
+                or n_test != args.test_views):
+            stale = True
+        else:
+            with Image.open(first) as im:
+                stale = im.size != (args.H, args.H)
+        if stale:
+            print(f"scene at {args.scene_root} is stale; regenerating",
+                  flush=True)
+            import shutil
+
+            shutil.rmtree(os.path.join(args.scene_root, scene))
+    if stale or not os.path.exists(tjson):
         print(f"generating {args.views}-view {args.H}² scene …", flush=True)
         generate_scene(
             args.scene_root, scene=scene, H=args.H, W=args.H,
@@ -73,7 +103,7 @@ def main(argv=None):
         )
 
     cfg = make_cfg(
-        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        os.path.join(_REPO, "configs", "nerf", args.config),
         [
             "scene", scene,
             "exp_name", args.tag,
@@ -112,7 +142,7 @@ def main(argv=None):
     trace = []
     host_step = 0
     crossed_at = None
-    trace_path = os.path.join(_REPO, "QUALITY.jsonl")
+    trace_path = os.path.join(_REPO, args.out_prefix + ".jsonl")
     with open(trace_path, "w") as tf:
         while time.time() - t0 < budget_s:
             # one burst of steps between host syncs
@@ -182,7 +212,7 @@ def main(argv=None):
     lines = [
         "# QUALITY — trained artifact trace",
         "",
-        f"Scene: procedural {args.H}²×{args.views} views; config lego.yaml "
+        f"Scene: procedural {args.H}²×{args.views} views; config {args.config} "
         f"(N_rays={args.n_rays}, bf16); budget {args.minutes:.0f} min on "
         f"`{jax.devices()[0].device_kind}`.",
         "",
@@ -219,9 +249,9 @@ def main(argv=None):
                 f"⇒ naive wall-clock-to-30 dB ≈ {b['t_s'] + max(eta, 0):.0f} s "
                 "(log-shaped convergence makes this a lower bound)."
             )
-    with open(os.path.join(_REPO, "QUALITY.md"), "w") as f:
+    with open(os.path.join(_REPO, args.out_prefix + ".md"), "w") as f:
         f.write("\n".join(lines) + "\n")
-    print("wrote QUALITY.md")
+    print(f"wrote {args.out_prefix}.md")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,96 @@
+#!/bin/bash
+# Staged TPU measurement battery (VERDICT r2 #4: the committed outage machinery).
+#
+# The axon tunnel on this machine is MONOCLIENT (one process may hold it),
+# wedges for hours after HBM-OOM compile storms, and can FLAP — answer one
+# probe then wedge again. This script encodes that operational knowledge:
+#
+#   * watch:   probe every 5 min (budget: WATCH_PROBES, default 60 ≈ 5 h);
+#              require TWO consecutive good probes 60 s apart before
+#              declaring a window (a single probe is not a usable window)
+#   * battery: run the measurement stages SERIALLY, each with its own
+#              timeout and its own incremental output file, ordered so the
+#              most valuable short stages land first if the window is short
+#
+# Usage:   bash scripts/tpu_battery.sh            # watch, then full battery
+#          WATCH_PROBES=0 bash scripts/tpu_battery.sh   # skip watch, run now
+#
+# Stages (each standalone-rerunnable):
+#   1. remat sweep 16k/64k/131k bf16   -> BENCH_SWEEP_REMAT.jsonl
+#      + promote best point            -> BENCH_DEFAULTS.json (bench.py reads)
+#   2. quality run (35 min, chip)      -> QUALITY.jsonl/md + grid + video
+#   3. lego_hash sweep                 -> BENCH_SWEEP_HASH.jsonl
+#      (not promoted: the driver headline stays on lego.yaml so vs_baseline
+#      remains apples-to-apples with the reference's big-MLP number)
+#   4. hash shootout XLA vs Pallas     -> BENCH_HASH.jsonl
+#   5. profile step (top-op table)     -> PROFILE_STEP.jsonl
+#   6. scale check 800x800             -> SCALE_CHECK.jsonl
+#
+# NEVER pkill by pattern on this box — kill by exact PID only (the driver's
+# own command line matches almost any pattern).
+set -u
+cd "$(dirname "$0")/.."
+
+WATCH_PROBES=${WATCH_PROBES:-60}
+PROBE_SLEEP=${PROBE_SLEEP:-300}
+log() { echo "[battery $(date +%H:%M:%S)] $*"; }
+
+probe_once() {
+  timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+if [ "$WATCH_PROBES" -gt 0 ]; then
+  up=0
+  for i in $(seq 1 "$WATCH_PROBES"); do
+    if probe_once; then
+      log "probe $i: UP — confirming (tunnel can flap)"
+      sleep 60
+      if probe_once; then
+        log "probe $i: CONFIRMED up"
+        up=1
+        break
+      fi
+      log "probe $i: flapped back down"
+    else
+      log "probe $i: down"
+    fi
+    sleep "$PROBE_SLEEP"
+  done
+  if [ "$up" -ne 1 ]; then
+    log "tunnel never confirmed up within the watch budget; exiting"
+    exit 1
+  fi
+fi
+
+log "=== stage 1: remat sweep (big-MLP headline) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 3000 python scripts/bench_sweep.py \
+  --rays 16384 65536 131072 --dtypes bfloat16 --remat true --steps 30 \
+  --point_timeout 900 --out BENCH_SWEEP_REMAT.jsonl
+python scripts/promote_bench_defaults.py \
+  BENCH_SWEEP_REMAT.jsonl BENCH_SWEEP.jsonl --config lego.yaml
+
+log "=== stage 2: quality run (chip, 35 min) ==="
+timeout 4200 python scripts/quality_run.py --minutes 35 --H 400 --views 100 \
+  --test_views 4 --n_rays 16384 --eval_every_s 120 \
+  --scene_root data/quality_scene --target_psnr 21.55 \
+  task_arg.remat true 2>&1 | tail -40
+
+log "=== stage 3: lego_hash sweep (the 1M rays/s config) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 2400 python scripts/bench_sweep.py \
+  --config lego_hash.yaml --rays 16384 65536 262144 --dtypes bfloat16 \
+  --remat true --steps 30 --point_timeout 700 --out BENCH_SWEEP_HASH.jsonl
+
+mkdir -p data/logs
+log "=== stage 4: hash shootout (XLA vs Pallas) ==="
+timeout 1500 python scripts/bench_hash.py 2>data/logs/bench_hash.err \
+  | tee -a BENCH_HASH.jsonl
+
+log "=== stage 5: profile step ==="
+timeout 1200 python scripts/profile_step.py --n_rays 65536 --remat true \
+  2>data/logs/profile_step.err | tee -a PROFILE_STEP.jsonl
+
+log "=== stage 6: scale check 800x800 ==="
+timeout 1800 python scripts/scale_check.py --H 800 \
+  2>data/logs/scale_check.err | tee -a SCALE_CHECK.jsonl
+
+log "=== battery done ==="

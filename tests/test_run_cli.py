@@ -74,6 +74,26 @@ def test_gate_resolves_and_renders(setup, sharded, use_grid):
 
 
 @pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device CPU mesh")
+def test_sharded_gate_accepts_non_dyadic_matching_bounds(setup):
+    # near=0.1 is not exactly float32-representable: the batch carries
+    # np.float32(0.1) while the dataset holds the python float 0.1 — equal
+    # bounds must pass the gate (ADVICE r2: exact != compared f64 vs f32)
+    import types
+
+    cfg, network, params, renderer, test_ds = setup
+    cfg = cfg.clone()
+    cfg.defrost()
+    cfg.eval = {"sharded": True}
+    cfg.freeze()
+    ds = types.SimpleNamespace(near=0.1, far=0.3)
+    render = run_cli._full_image_render_fn(
+        cfg, network, renderer, ds, use_grid=False
+    )
+    out = render(params, _batch(test_ds, near=0.1, far=0.3))
+    assert np.isfinite(np.asarray(out["rgb_map_f"])).all()
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device CPU mesh")
 def test_sharded_gate_rejects_mismatched_bounds(setup):
     cfg, network, params, renderer, test_ds = setup
     cfg = cfg.clone()

@@ -40,6 +40,15 @@ from dataclasses import dataclass, field
 import numpy as np
 
 
+def _bbox_corners(wbbox: np.ndarray) -> np.ndarray:
+    """[8, 3] corner points of a [lo(3), hi(3)] world bbox."""
+    lo, hi = wbbox[:3], wbbox[3:6]
+    return np.array(
+        [[x, y, z] for x in (lo[0], hi[0]) for y in (lo[1], hi[1])
+         for z in (lo[2], hi[2])]
+    )
+
+
 def _erode_dilate_band(msk: np.ndarray, border: int = 5) -> np.ndarray:
     """Mark the ±border boundary band of a binary mask with 100
     (light_stage.py:110-116's cv2 erode/dilate)."""
@@ -59,11 +68,7 @@ def _project_bbox_hull_mask(wbbox: np.ndarray, K: np.ndarray,
     (base_utils.get_bound_2d_mask's role in light_stage.py:183-186)."""
     import cv2
 
-    lo, hi = wbbox[:3], wbbox[3:6]
-    corners = np.array(
-        [[x, y, z] for x in (lo[0], hi[0]) for y in (lo[1], hi[1])
-         for z in (lo[2], hi[2])]
-    )
+    corners = _bbox_corners(wbbox)
     cam = corners @ ext[:3, :3].T + ext[:3, 3]
     # guard: corners behind the camera would project nonsensically
     cam[:, 2] = np.maximum(cam[:, 2], 1e-6)
@@ -207,22 +212,22 @@ class Dataset:
         return np.concatenate([o, d, t], -1).astype(np.float32)
 
     def _load_all(self):
+        self.n_images = len(self.items)
+        if self.split != "train":
+            # eval items load LAZILY in image_batch — a real rig (20+ cams ×
+            # hundreds of frames at megapixel res) cannot hold every decoded
+            # image + [H·W, 7] ray grid in host RAM at once. Read one item
+            # here only to publish the H/W contract attributes.
+            img, _, _, _ = self._read_item(self.items[0])
+            self.H, self.W = img.shape[:2]
+            return
+
         fg_rays, fg_rgbs, bg_rays, bg_rgbs = [], [], [], []
-        self._eval = []
         rng = np.random.default_rng(0)
         for item in self.items:
             img, msk, K, ext = self._read_item(item)
             H, W = img.shape[:2]
             latent = self._latent[item["frame"]]
-
-            if self.split != "train":
-                ys, xs = np.mgrid[0:H, 0:W].astype(np.float64)
-                rays = self._rays_for(K, ext, ys.ravel(), xs.ravel(), latent)
-                self._eval.append(
-                    {"rays": rays, "rgb": img.reshape(-1, 3),
-                     "H": H, "W": W, "mask": msk}
-                )
-                continue
 
             ys, xs = np.nonzero(msk == 1)  # interior fg, band excluded
             fg_rays.append(self._rays_for(K, ext, ys, xs, latent))
@@ -233,30 +238,20 @@ class Dataset:
             bg_rays.append(self._rays_for(K, ext, ys_b, xs_b, latent))
             bg_rgbs.append(img[ys_b, xs_b])
 
-        if self.split == "train":
-            fg_r = np.concatenate(fg_rays)
-            fg_c = np.concatenate(fg_rgbs)
-            bg_r = np.concatenate(bg_rays)
-            bg_c = np.concatenate(bg_rgbs)
-            # two equal segments ⇒ uniform sampling is 50/50 fg/bg in
-            # expectation (the reference's fg_num = N_rays // 2)
-            idx = rng.integers(0, len(bg_r), size=len(fg_r))
-            self.rays = np.concatenate([fg_r, bg_r[idx]])
-            self.rgbs = np.concatenate([fg_c, bg_c[idx]]).astype(np.float32)
-        self.n_images = len(self._eval) if self.split != "train" else len(
-            self.items
-        )
-        ref = self._eval[0] if self._eval else None
-        self.H = ref["H"] if ref else 0
-        self.W = ref["W"] if ref else 0
+        fg_r = np.concatenate(fg_rays)
+        fg_c = np.concatenate(fg_rgbs)
+        bg_r = np.concatenate(bg_rays)
+        bg_c = np.concatenate(bg_rgbs)
+        # two equal segments ⇒ uniform sampling is 50/50 fg/bg in
+        # expectation (the reference's fg_num = N_rays // 2)
+        idx = rng.integers(0, len(bg_r), size=len(fg_r))
+        self.rays = np.concatenate([fg_r, bg_r[idx]])
+        self.rgbs = np.concatenate([fg_c, bg_c[idx]]).astype(np.float32)
+        self.H, self.W = H, W
 
     def _derive_bounds(self):
         """Scalar near/far from camera-to-bbox-corner distances."""
-        lo, hi = self.wbbox[:3], self.wbbox[3:6]
-        corners = np.array(
-            [[x, y, z] for x in (lo[0], hi[0]) for y in (lo[1], hi[1])
-             for z in (lo[2], hi[2])]
-        )
+        corners = _bbox_corners(self.wbbox)
         dists = []
         for c in self.camera_ids:
             R = np.array(self.cams["R"][c], np.float64)
@@ -274,18 +269,30 @@ class Dataset:
     def __len__(self) -> int:
         if self.split == "train":
             return 1_000_000
-        return len(self._eval)
+        return len(self.items)
 
     def image_batch(self, index: int) -> dict:
-        e = self._eval[index]
+        """One whole eval image, loaded and ray-gridded on demand (a rig's
+        full eval split does not fit in host RAM precomputed). Follows the
+        shared RayBankDataset contract (bank.py:image_batch — ``rgbs``,
+        ``i``, ``meta: {H, W}``) so the evaluators consume it unchanged;
+        ``mask``/``wbounds`` ride along for mask-aware extensions."""
+        item = self.items[index]
+        img, msk, K, ext = self._read_item(item)
+        H, W = img.shape[:2]
+        ys, xs = np.mgrid[0:H, 0:W].astype(np.float64)
+        rays = self._rays_for(
+            K, ext, ys.ravel(), xs.ravel(), self._latent[item["frame"]]
+        )
         return {
-            "rays": e["rays"],
-            "rgb": e["rgb"],
-            "H": e["H"], "W": e["W"],
-            "mask": e["mask"],
-            "wbounds": self.wbbox,
+            "rays": rays,
+            "rgbs": img.reshape(-1, 3),
             "near": np.float32(self.near),
             "far": np.float32(self.far),
+            "i": index,
+            "meta": {"H": H, "W": W},
+            "mask": msk,
+            "wbounds": self.wbbox,
         }
 
     @classmethod

@@ -30,17 +30,17 @@ from .mesh import DATA_AXIS
 
 
 def _chunked_over_rays(render_chunk, rays, chunk_size: int | None):
-    """Apply ``render_chunk([chunk, 6]) -> dict`` over a ray slice in
+    """Apply ``render_chunk([chunk, C]) -> dict`` over a ray slice in
     fixed-size ``lax.map`` chunks (zero-padded; per-ray outputs are unpadded
-    back to the slice length). ``chunk_size >= n`` short-circuits to one
-    direct call."""
+    back to the slice length; C = 6, or 7 with the time column).
+    ``chunk_size >= n`` short-circuits to one direct call."""
     n = rays.shape[0]  # static: per-shard slice length
     if chunk_size is None or chunk_size >= n:
         return render_chunk(rays)
     n_chunks = -(-n // chunk_size)
     pad = n_chunks * chunk_size - n
     rays_c = jnp.pad(rays, ((0, pad), (0, 0))).reshape(
-        n_chunks, chunk_size, 6
+        n_chunks, chunk_size, rays.shape[-1]
     )
     out = jax.lax.map(render_chunk, rays_c)
     return {k: v.reshape((-1,) + v.shape[2:])[:n] for k, v in out.items()}

@@ -190,13 +190,28 @@ def render_rays(
     key: jax.Array | None,
     options: RenderOptions,
 ) -> dict:
-    """Render a [N, 6] ray batch through coarse (+fine) networks.
+    """Render a [N, 6] (or [N, 7] time-conditioned) ray batch through
+    coarse (+fine) networks.
 
     ``apply_fn(pts, viewdirs, model)`` is the bound network (params already
     closed over); returns the reference's output dict keys
-    (`rgb_map_c/f`, `depth_map_c/f`, `acc_map_c/f`)."""
+    (`rgb_map_c/f`, `depth_map_c/f`, `acc_map_c/f`).
+
+    A 7th ray column (the per-frame latent/time index — light-stage and
+    dynamic-scene datasets) is broadcast onto every sample point as a 4th
+    point coordinate, so ``xyz_encoder`` receives the ``(x, y, z, t)`` the
+    dynamic encoder family (models/encoding/dynamic.py) consumes. Static
+    3-D encoders must be paired with 6-column rays — the extra coordinate
+    is a shape-static trace-time property, never a runtime branch."""
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
+    t_col = rays[..., 6:7] if rays.shape[-1] > 6 else None
     n_rays = rays.shape[0]
+
+    def _with_t(pts):
+        if t_col is None:
+            return pts
+        t = jnp.broadcast_to(t_col[..., None, :], pts.shape[:-1] + (1,))
+        return jnp.concatenate([pts, t], axis=-1)
 
     if options.remat:
         # trade FLOPs for HBM: recompute the MLP sweep during backward so
@@ -216,7 +231,7 @@ def render_rays(
     pts = rays_o[..., None, :] + rays_d[..., None, :] * z_vals[..., :, None]
     viewdirs = rays_d / jnp.linalg.norm(rays_d, axis=-1, keepdims=True)
 
-    raw_c = apply_fn(pts, viewdirs, "coarse")
+    raw_c = apply_fn(_with_t(pts), viewdirs, "coarse")
     rgb_c, depth_c, acc_c, weights_c = raw2outputs(
         raw_c, z_vals, rays_d, k_noise_c, options.raw_noise_std,
         options.white_bkgd,
@@ -239,7 +254,7 @@ def render_rays(
         pts_f = (
             rays_o[..., None, :] + rays_d[..., None, :] * z_vals_f[..., :, None]
         )
-        raw_f = apply_fn(pts_f, viewdirs, "fine")
+        raw_f = apply_fn(_with_t(pts_f), viewdirs, "fine")
         rgb_f, depth_f, acc_f, _ = raw2outputs(
             raw_f, z_vals_f, rays_d, k_noise_f, options.raw_noise_std,
             options.white_bkgd,
@@ -251,13 +266,14 @@ def render_rays(
 
 
 def _pad_to_chunks(rays: jax.Array, chunk_size: int):
-    """[N, 6] → ([n_chunks, chunk, 6], n, n_chunks, chunk) with zero-padding."""
+    """[N, C] → ([n_chunks, chunk, C], n, n_chunks, chunk) with zero-padding
+    (C = 6, or 7 with the time column)."""
     n = rays.shape[0]
     chunk = min(chunk_size, n)
     n_chunks = -(-n // chunk)
     pad = n_chunks * chunk - n
     return (
-        jnp.pad(rays, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 6),
+        jnp.pad(rays, ((0, pad), (0, 0))).reshape(n_chunks, chunk, rays.shape[-1]),
         n,
         n_chunks,
         chunk,

@@ -145,6 +145,10 @@ def main():
     except (OSError, ValueError):
         pass
 
+    from nerf_replication_tpu.utils.platform import enable_compilation_cache
+
+    enable_compilation_cache()
+
     n_rays = int(os.environ.get("BENCH_N_RAYS", defaults["n_rays"]))
     n_steps = int(os.environ.get("BENCH_STEPS", defaults["steps"]))
     config = os.environ.get("BENCH_CONFIG", defaults["config"])

@@ -71,6 +71,17 @@ def march_rays_accelerated(
     """Render a [N, 6] ray chunk with ESS + ERT. near/far/options are static."""
     import math
 
+    if rays.shape[-1] > 6:
+        # deliberate: an occupancy grid is a STATIC scene-geometry bake —
+        # marching time-conditioned (7-column) rays against it would skip
+        # space that is empty in one frame but occupied in another. Dynamic
+        # scenes render through the chunked volume path (which threads t).
+        raise ValueError(
+            "the occupancy-accelerated march only supports static [N, 6] "
+            f"rays, got {rays.shape[-1]} columns — time-conditioned scenes "
+            "must use the chunked volume renderer (accelerated_renderer: "
+            "false)"
+        )
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     n_rays = rays.shape[0]
     resolution = grid.shape[0]

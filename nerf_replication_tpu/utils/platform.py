@@ -38,3 +38,38 @@ def force_platform(platform: str = "cpu", device_count: int | None = None) -> No
     import jax
 
     jax.config.update("jax_platforms", platform)
+
+
+def enable_compilation_cache(path: str | None = None) -> None:
+    """Persistent XLA executable cache shared across processes.
+
+    Every battery stage / sweep point is a fresh Python process that would
+    otherwise re-pay 20-40 s TPU compiles for shapes an earlier stage
+    already built, and the CPU test suite re-compiles identical tiny
+    executables on every run. Safe everywhere: a cache miss is just the
+    normal compile path, and failures (read-only FS, unsupported backend)
+    degrade to no caching.
+
+    The default location anchors to the REPO root (this package's parent),
+    not the process cwd — battery stages launched from different
+    directories must resolve the same cache. A second call without an
+    explicit ``path`` is a no-op when a cache dir is already configured,
+    so an earlier caller's choice (e.g. the test harness's dedicated
+    cache) is never clobbered.
+    """
+    import jax
+
+    try:
+        if path is None:
+            if jax.config.jax_compilation_cache_dir:
+                return  # respect an earlier caller's cache choice
+            repo_root = os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            )
+            path = os.path.join(repo_root, "data", "jax_cache")
+        jax.config.update("jax_compilation_cache_dir", os.path.abspath(path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # -1 = no size floor (0 would filter every entry out)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass

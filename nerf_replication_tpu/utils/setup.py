@@ -34,6 +34,12 @@ def configure_runtime(cfg) -> None:
         from .platform import force_platform
 
         force_platform(platform)
+    # persistent executable cache: battery stages / sweep points are fresh
+    # processes that would otherwise re-pay identical compiles (no-op if a
+    # caller — e.g. the test harness — already configured a cache dir)
+    from .platform import enable_compilation_cache
+
+    enable_compilation_cache()
     if cfg.get("debug_nans", False):
         jax.config.update("jax_debug_nans", True)
     if cfg.get("fix_random", False):

@@ -57,6 +57,10 @@ def main(argv=None):
     import jax
     import numpy as np
 
+    from nerf_replication_tpu.utils.platform import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from nerf_replication_tpu.config import make_cfg
     from nerf_replication_tpu.datasets import make_dataset
     from nerf_replication_tpu.datasets.procedural import generate_scene

@@ -15,9 +15,14 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-from nerf_replication_tpu.utils.platform import force_platform  # noqa: E402
+from nerf_replication_tpu.utils.platform import (  # noqa: E402
+    enable_compilation_cache,
+    force_platform,
+)
 
 force_platform("cpu", device_count=8)
+# suite wall-clock is compile-dominated; cache executables across runs
+enable_compilation_cache("data/jax_cache_tests")
 
 import jax  # noqa: E402
 

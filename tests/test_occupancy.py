@@ -299,3 +299,28 @@ def test_sequence_parallel_march_matches_single_device(setup):
     # pad rows are sliced off before the sum, so the sharded diagnostic
     # equals the single-device per-ray count exactly
     assert int(out["n_truncated"]) == int(jnp.sum(ref["truncated"]))
+
+
+def test_accelerated_march_rejects_time_conditioned_rays(tmp_path, setup):
+    """An occupancy grid is a static-geometry bake: marching 7-column
+    (time-conditioned) rays against it would skip space that is empty in
+    one frame and occupied in another — the march must refuse loudly and
+    point at the chunked volume path."""
+    cfg, network, params = setup
+    renderer = make_renderer(cfg, network)
+    grid = bake_occupancy_grid(params, network, cfg)
+    path = str(tmp_path / "grid_t.npz")
+    save_occupancy_grid(path, grid, cfg.train_dataset.scene_bbox, 0.5)
+    assert renderer.load_occupancy_grid(path)
+
+    rays7 = jnp.asarray(
+        np.concatenate(
+            [np.tile([0.0, 0.0, 4.0], (8, 1)),
+             np.tile([0.0, 0.0, -1.0], (8, 1)),
+             np.zeros((8, 1))], -1
+        ).astype(np.float32)
+    )
+    with pytest.raises(ValueError, match="static"):
+        renderer.render_accelerated(
+            params, {"rays": rays7, "near": 2.0, "far": 6.0}
+        )

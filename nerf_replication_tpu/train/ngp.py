@@ -1,0 +1,233 @@
+"""Occupancy-accelerated training — the instant-ngp speed lever, TPU-native.
+
+The reference bakes its occupancy grid ONCE from an already-trained network
+and uses it only at eval (occupancy_grid.py, volume_renderer.py:268-358).
+Instant-ngp's actual training speed comes from the grid being LIVE during
+training: the MLP never evaluates empty space, cutting points/ray from the
+dense S-march to the K ≪ S occupied samples. This module is that capability,
+designed for XLA rather than translated from the CUDA original
+(hashencoder.cu's training loop):
+
+* **One jitted step, uniform executable.** The density grid rides inside the
+  train state (:class:`NGPTrainState.grid_ema`); each step (a) marches the
+  sampled rays through the SAME static-shape ESS+ERT two-phase march the
+  eval path uses (renderer/accelerated.py — differentiable: grads flow to
+  the MLP through the compacted [N, K] query), and (b) refreshes the grid
+  EMA on a random subsample of cells with a scatter-max. No ``lax.cond``,
+  no host round-trips, no retrace: grid maintenance is amortized
+  continuously instead of instant-ngp's every-16-steps host-driven update.
+* **Warm start = march everything.** ``grid_ema`` initializes above the
+  density threshold, so early steps march densely (every cell "occupied")
+  and the EMA decay + updates carve out the empty space as the network
+  learns — the static-shape equivalent of instant-ngp's warmup. Caveat:
+  while the grid is still dense, rays whose S march positions exceed the
+  K = ``max_march_samples`` budget truncate their far content — per-step
+  stats report ``truncated_frac`` so the warm-up blind spot is visible in
+  the trace (it falls toward zero as the grid carves; size K or raise
+  ``ngp_density_threshold`` if it persists).
+* **One network.** NGP training drives the ``fine`` MLP only (hierarchical
+  coarse→fine sampling is what the grid replaces); eval goes through the
+  accelerated march with the live grid.
+
+Config keys (all under ``task_arg``): ``ngp_training: true`` switches
+scripts/quality_run.py onto this trainer; ``ngp_grid_res`` (64),
+``ngp_grid_decay`` (0.95 per ``ngp_grid_update_every``-step window, applied
+continuously), ``ngp_grid_update_every`` (16), ``ngp_density_threshold``
+(0.01), plus the shared march knobs ``render_step_size`` /
+``max_march_samples`` / ``transmittance_threshold``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.training.train_state import TrainState
+
+from ..datasets.sampling import sample_rays, sample_step_key
+from ..renderer.accelerated import MarchOptions, march_rays_accelerated
+from .loss import mse, mse_to_psnr
+from .optim import make_optimizer
+
+
+class NGPTrainState(TrainState):
+    """TrainState + the live density EMA ([R, R, R] float32)."""
+
+    grid_ema: jax.Array = None
+
+
+class NGPTrainer:
+    """Occupancy-accelerated trainer (one fused jitted step)."""
+
+    def __init__(self, cfg, network):
+        ta = cfg.task_arg
+        self.cfg = cfg
+        self.network = network
+        self.n_rays = int(ta.get("N_rays", 1024))
+        self.near = float(ta.near)
+        self.far = float(ta.far)
+        self.bbox = jnp.asarray(cfg.train_dataset.scene_bbox, jnp.float32)
+        self.march = MarchOptions.from_cfg(cfg)
+        self.grid_res = int(ta.get("ngp_grid_res", 64))
+        self.threshold = float(ta.get("ngp_density_threshold", 0.01))
+        update_every = int(ta.get("ngp_grid_update_every", 16))
+        decay_window = float(ta.get("ngp_grid_decay", 0.95))
+        # continuous equivalent of "×decay every `update_every` steps"
+        self.decay_step = float(decay_window ** (1.0 / update_every))
+        # cells refreshed per step: full-grid coverage every update window
+        self.cells_per_step = max(self.grid_res**3 // update_every, 1)
+        self.process_index = jax.process_index()
+        self._step_fn = None
+        self._render_fns: dict = {}
+
+    # -- state ---------------------------------------------------------------
+    def init_state(self, params, tx) -> NGPTrainState:
+        """Grid starts fully occupied (ema above threshold ⇒ dense march)
+        so the first steps have gradients everywhere; decay + live updates
+        then carve out the empty space."""
+        ema0 = jnp.full(
+            (self.grid_res,) * 3, 4.0 * self.threshold, jnp.float32
+        )
+        return NGPTrainState.create(
+            apply_fn=self.network.apply, params=params, tx=tx,
+            grid_ema=ema0,
+        )
+
+    # -- jitted step ---------------------------------------------------------
+    def _build_step(self):
+        n_rays = self.n_rays
+        near, far = self.near, self.far
+        bbox, options = self.bbox, self.march
+        network = self.network
+        res, thr = self.grid_res, self.threshold
+        decay, n_cells = self.decay_step, self.cells_per_step
+        process_index = self.process_index
+        remat = bool(self.cfg.task_arg.get("remat", False))
+
+        def apply_fn_for(params):
+            fn = lambda pts, dirs, model: network.apply(  # noqa: E731
+                {"params": params}, pts, dirs, model=model
+            )
+            return jax.checkpoint(fn, static_argnums=(2,)) if remat else fn
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step_fn(state, bank_rays, bank_rgbs, base_key):
+            key = sample_step_key(base_key, state.step, process_index)
+            k_sample, k_cells, k_jitter = jax.random.split(key, 3)
+            rays, rgbs = sample_rays(k_sample, bank_rays, bank_rgbs, n_rays)
+
+            grid = state.grid_ema > thr  # bool [R,R,R], jit-static shape
+
+            def loss_fn(p):
+                out = march_rays_accelerated(
+                    apply_fn_for(p), rays, near, far, grid, bbox, options
+                )
+                l = mse(out["rgb_map_f"], rgbs)
+                return l, {
+                    "loss": l,
+                    "psnr": mse_to_psnr(l),
+                    "occupancy": jnp.mean(grid.astype(jnp.float32)),
+                    # rays losing far content to the K budget (dense-grid
+                    # warm-up makes this nonzero; must fall as cells carve)
+                    "truncated_frac": jnp.mean(
+                        out["truncated"].astype(jnp.float32)
+                    ),
+                }
+
+            (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            new_state = state.apply_gradients(grads=grads)
+
+            # grid maintenance: decay everywhere, scatter-max a random cell
+            # subsample with the LIVE network's density at a jittered point
+            # inside each cell (stop_gradient: maintenance must not backprop)
+            idx = jax.random.randint(
+                k_cells, (n_cells,), 0, res * res * res
+            )
+            iz = idx % res
+            iy = (idx // res) % res
+            ix = idx // (res * res)
+            cell = jnp.stack([ix, iy, iz], axis=-1).astype(jnp.float32)
+            u = jax.random.uniform(k_jitter, (n_cells, 3))
+            lo, hi = bbox[0], bbox[1]
+            pts = lo + (cell + u) / res * (hi - lo)
+            dirs = jnp.zeros((n_cells, 3), jnp.float32)
+            raw = network.apply(
+                {"params": jax.lax.stop_gradient(new_state.params)},
+                pts[:, None, :], dirs, model="fine",
+            )
+            sigma = jax.nn.relu(raw[..., 0, 3])
+            ema = state.grid_ema.reshape(-1) * decay
+            ema = ema.at[idx].max(sigma)
+            new_state = new_state.replace(grid_ema=ema.reshape(res, res, res))
+            return new_state, stats
+
+        return step_fn
+
+    def step(self, state, bank_rays, bank_rgbs, base_key):
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        return self._step_fn(state, bank_rays, bank_rgbs, base_key)
+
+    # -- eval ----------------------------------------------------------------
+    def render_image(self, state, batch: dict) -> dict:
+        """Full-image eval through the accelerated march with the live grid
+        (the chunked coarse+fine path is meaningless here: NGP training
+        leaves the coarse network untrained by design). Jitted executables
+        are cached per (n_chunks, chunk) shape like Renderer's eval paths."""
+        from ..renderer.volume import _pad_to_chunks, _unpad_outputs
+
+        grid = state.grid_ema > self.threshold
+        rays_p, n, n_chunks, chunk = _pad_to_chunks(
+            jnp.asarray(batch["rays"]), self.march.chunk_size
+        )
+
+        render = self._render_fns.get((n_chunks, chunk))
+        if render is None:
+            network, near, far = self.network, self.near, self.far
+            bbox, options = self.bbox, self.march
+
+            @jax.jit
+            def render(params, rays_p, grid):
+                apply_fn = lambda pts, dirs, model: network.apply(  # noqa: E731
+                    {"params": params}, pts, dirs, model=model
+                )
+
+                def body(chunk_rays):
+                    return march_rays_accelerated(
+                        apply_fn, chunk_rays, near, far, grid, bbox, options
+                    )
+
+                return jax.lax.map(body, rays_p)
+
+            self._render_fns[(n_chunks, chunk)] = render
+
+        out = render(state.params, rays_p, grid)
+        out = _unpad_outputs(out, n)
+        # surface the K-budget diagnostic like Renderer.render_accelerated
+        # does instead of silently dropping far content
+        n_trunc = int(np.asarray(jnp.sum(out.pop("truncated"))))
+        if n_trunc:
+            print(
+                f"ngp render_image: {n_trunc} rays exceeded the "
+                f"max_march_samples={self.march.max_samples} budget while "
+                "still transparent (far contributions truncated)"
+            )
+        return out
+
+
+def make_ngp_trainer(cfg, network) -> NGPTrainer:
+    return NGPTrainer(cfg, network)
+
+
+def make_ngp_state(cfg, network, key):
+    """(state, schedule) with the grid warm-started fully occupied."""
+    from ..models import init_params_for
+
+    params = init_params_for(cfg)(network, key)
+    tx, schedule = make_optimizer(cfg)
+    trainer = NGPTrainer(cfg, network)
+    return trainer.init_state(params["params"], tx), schedule

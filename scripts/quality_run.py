@@ -122,17 +122,46 @@ def main(argv=None):
         ],
     )
 
+    ngp = bool(cfg.task_arg.get("ngp_training", False))
     network = make_network(cfg)
-    loss = make_loss(cfg, network)
     evaluator = make_evaluator(cfg)
-    trainer = Trainer(cfg, network, loss, evaluator)
-    state, schedule = make_train_state(cfg, network, jax.random.PRNGKey(0))
+    if ngp:
+        # occupancy-accelerated training (train/ngp.py): live-grid march,
+        # fine network only; eval goes through the march with the live grid
+        from nerf_replication_tpu.train.ngp import (
+            make_ngp_state,
+            make_ngp_trainer,
+        )
+
+        trainer = make_ngp_trainer(cfg, network)
+        state, schedule = make_ngp_state(cfg, network, jax.random.PRNGKey(0))
+    else:
+        loss = make_loss(cfg, network)
+        trainer = Trainer(cfg, network, loss, evaluator)
+        state, schedule = make_train_state(cfg, network, jax.random.PRNGKey(0))
+
+    def run_val(state, epoch):
+        if not ngp:
+            return trainer.val(
+                state, epoch=epoch, test_dataset=test_ds,
+                max_images=args.test_views,
+            )
+        for i in range(min(len(test_ds), args.test_views)):
+            batch = test_ds.image_batch(i)
+            out = trainer.render_image(state, {"rays": batch["rays"]})
+            evaluator.evaluate(
+                {k: np.asarray(v) for k, v in out.items()}, batch
+            )
+        result = evaluator.summarize()
+        print(f"val step {epoch}: " + "  ".join(
+            f"{k}: {v:.4f}" for k, v in result.items()), flush=True)
+        return result
 
     train_ds = make_dataset(cfg, "train")
     test_ds = make_dataset(cfg, "test")
     bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
     pool = None
-    if trainer.precrop_iters > 0:
+    if not ngp and trainer.precrop_iters > 0:
         pool = jax.device_put(
             train_ds.precrop_index_pool(
                 float(cfg.task_arg.get("precrop_frac", 0.5))
@@ -151,20 +180,24 @@ def main(argv=None):
         while time.time() - t0 < budget_s:
             # one burst of steps between host syncs
             for _ in range(100):
-                use_pool = pool is not None and host_step < trainer.precrop_iters
-                state, stats = trainer.step(
-                    state, bank[0], bank[1], base_key,
-                    index_pool=pool if use_pool else None,
-                )
+                if ngp:
+                    state, stats = trainer.step(
+                        state, bank[0], bank[1], base_key
+                    )
+                else:
+                    use_pool = (
+                        pool is not None and host_step < trainer.precrop_iters
+                    )
+                    state, stats = trainer.step(
+                        state, bank[0], bank[1], base_key,
+                        index_pool=pool if use_pool else None,
+                    )
                 host_step += 1
             jax.block_until_ready(stats)
             elapsed = time.time() - t0
             if elapsed >= next_eval or elapsed >= budget_s:
                 next_eval = elapsed + args.eval_every_s
-                result = trainer.val(
-                    state, epoch=host_step, test_dataset=test_ds,
-                    max_images=args.test_views,
-                )
+                result = run_val(state, host_step)
                 rec = {
                     "t_s": round(elapsed, 1), "step": host_step,
                     "loss": float(stats["loss"]), **result,
@@ -187,10 +220,16 @@ def main(argv=None):
     )
 
     params = {"params": state.params}
-    grid = bake_occupancy_grid(params, network, cfg)
+    if ngp:
+        # NGP mode maintains the grid live during training — save THAT
+        # (baking from the coarse net would be garbage: NGP trains fine only)
+        grid = np.asarray(state.grid_ema > trainer.threshold)
+        thresh = trainer.threshold
+    else:
+        grid = bake_occupancy_grid(params, network, cfg)
+        thresh = float(cfg.task_arg.get("occupancy_grid_threshold", 1.0))
     grid_path = os.path.join(cfg.trained_model_dir, "occupancy_grid.npz")
     bbox = cfg.train_dataset.scene_bbox
-    thresh = float(cfg.task_arg.get("occupancy_grid_threshold", 1.0))
     save_occupancy_grid(grid_path, grid, bbox, thresh)
     print(f"occupancy grid: {grid_path} "
           f"({100.0 * float(np.asarray(grid).mean()):.1f}% occupied)")

@@ -22,7 +22,9 @@ from nerf_replication_tpu.utils.platform import (  # noqa: E402
 
 force_platform("cpu", device_count=8)
 # suite wall-clock is compile-dominated; cache executables across runs
-enable_compilation_cache("data/jax_cache_tests")
+# (repo-anchored so pytest invoked from any cwd shares one cache)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+enable_compilation_cache(os.path.join(_REPO_ROOT, "data", "jax_cache_tests"))
 
 import jax  # noqa: E402
 

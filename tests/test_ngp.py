@@ -1,0 +1,107 @@
+"""Occupancy-accelerated training (train/ngp.py — the instant-ngp speed
+lever the reference lacks: its grid is baked once post-training and used
+only at eval, occupancy_grid.py). The live-grid step must train, the grid
+must actually carve out empty space, and eval must render through the march
+with the live grid."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.blender import Dataset
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.train.ngp import (
+    NGPTrainState,
+    make_ngp_state,
+    make_ngp_trainer,
+)
+
+NGP_EXTRA = (
+    "train_dataset.H", "32", "train_dataset.W", "32",
+    "test_dataset.H", "32", "test_dataset.W", "32",
+    "task_arg.N_rays", "256",
+    "task_arg.render_step_size", "0.08",
+    "task_arg.max_march_samples", "24",
+    "task_arg.march_chunk_size", "512",
+    "task_arg.ngp_grid_res", "32",
+    "task_arg.ngp_training", "true",
+)
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("ngp_scene"))
+    generate_scene(root, scene="procedural", H=32, W=32, n_train=8, n_test=2)
+    cfg = tiny_cfg(root, NGP_EXTRA)
+    net = make_network(cfg)
+    return root, cfg, net
+
+
+def test_ngp_trains_and_carves_occupancy(setup):
+    root, cfg, net = setup
+    trainer = make_ngp_trainer(cfg, net)
+    state, _ = make_ngp_state(cfg, net, jax.random.PRNGKey(0))
+    assert isinstance(state, NGPTrainState)
+    # warm start: everything occupied ⇒ dense march with gradients everywhere
+    assert float(jnp.mean(state.grid_ema > trainer.threshold)) == 1.0
+
+    ds = Dataset(data_root=root, scene="procedural", split="train", H=32, W=32)
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(1)
+
+    losses, occs = [], []
+    for i in range(600):
+        state, stats = trainer.step(state, bank[0], bank[1], key)
+        if i % 50 == 0 or i == 599:
+            losses.append(float(stats["loss"]))
+            occs.append(float(stats["occupancy"]))
+    assert np.all(np.isfinite(losses))
+    # learning: loss clearly descends
+    assert losses[-1] < losses[0] * 0.5
+    # the speed lever: the live grid has carved out real empty space
+    assert occs[0] == 1.0 and occs[-1] < 0.9
+
+    # eval through the march with the live grid
+    tds = Dataset(data_root=root, scene="procedural", split="test", H=32, W=32)
+    b = tds.image_batch(0)
+    out = trainer.render_image(state, {"rays": b["rays"]})
+    rgb = np.asarray(out["rgb_map_f"])
+    assert rgb.shape == (32 * 32, 3) and np.isfinite(rgb).all()
+    # trained output beats an untrained render on PSNR
+    fresh, _ = make_ngp_state(cfg, net, jax.random.PRNGKey(2))
+    rgb0 = np.asarray(trainer.render_image(fresh, {"rays": b["rays"]})["rgb_map_f"])
+    gt = np.asarray(b["rgbs"])
+    mse_t = float(np.mean((rgb - gt) ** 2))
+    mse_0 = float(np.mean((rgb0 - gt) ** 2))
+    assert mse_t < mse_0 * 0.5
+
+
+def test_ngp_grid_update_is_densitydriven(setup):
+    """Cells the network marks empty must decay below the threshold while
+    cells over real content stay occupied (scatter-max vs decay race)."""
+    root, cfg, net = setup
+    trainer = make_ngp_trainer(cfg, net)
+    state, _ = make_ngp_state(cfg, net, jax.random.PRNGKey(0))
+    ds = Dataset(data_root=root, scene="procedural", split="train", H=32, W=32)
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(1)
+    for _ in range(600):
+        state, _ = trainer.step(state, bank[0], bank[1], key)
+    grid = np.asarray(state.grid_ema > trainer.threshold)
+    # the procedural scene's content (sphere r=1.1 + box) fills well under
+    # 100% of the [-1.5, 1.5]^3 bbox but is not empty either
+    occ = grid.mean()
+    assert 0.05 < occ < 0.9
+    # the bbox center (inside the sphere) must remain occupied
+    c = trainer.grid_res // 2
+    assert grid[c - 1 : c + 1, c - 1 : c + 1, c - 1 : c + 1].any()

@@ -1,0 +1,194 @@
+"""Direct numerical parity against the reference implementation.
+
+The strongest behavioral-parity evidence available: run the reference's own
+PyTorch modules (read-only from /root/reference, CPU) as oracles against this
+framework's JAX implementations — identical weights, identical inputs,
+outputs must match to float32 tolerance. Covers the four math surfaces every
+PSNR depends on: the frequency encoder, the NeRF MLP forward, volume
+compositing (raw2outputs), and deterministic inverse-CDF sampling
+(sample_pdf).
+
+Skipped wholesale when the reference tree or torch is unavailable.
+"""
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_REF = "/root/reference"
+
+torch = pytest.importorskip("torch")
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(_REF, "src")),
+    reason="reference tree not present",
+)
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """Import the reference's modules with their import-time quirks tamed:
+    src.config parses sys.argv and loads configs/default.yaml relative to
+    the CWD at import, and volume_renderer imports ipdb unconditionally."""
+    old_argv, old_cwd = sys.argv[:], os.getcwd()
+    sys.argv = ["parity", "--cfg_file", "configs/nerf/lego.yaml"]
+    os.chdir(_REF)
+    sys.path.insert(0, _REF)
+    if "ipdb" not in sys.modules:
+        sys.modules["ipdb"] = types.ModuleType("ipdb")  # debug-only import
+    if "imp" not in sys.modules:
+        # `imp` was removed in Python 3.12; the reference only uses it in
+        # make_network (plugin loading), which these oracle tests never call
+        sys.modules["imp"] = types.ModuleType("imp")
+    try:
+        import src.models.encoding as ref_encoding
+        import src.models.nerf.network as ref_network
+        import src.models.nerf.renderer.volume_renderer as ref_renderer
+
+        yield types.SimpleNamespace(
+            encoding=ref_encoding,
+            network=ref_network,
+            renderer=ref_renderer,
+        )
+    finally:
+        sys.argv = old_argv
+        os.chdir(old_cwd)
+
+
+def test_frequency_encoder_matches_reference(ref):
+    """Same xyz → identical embedding, including the interleaved
+    sin/cos-per-frequency ordering (ref freq.py:23-26)."""
+    from nerf_replication_tpu.models.encoding.freq import frequency_encoder
+
+    enc_t, out_dim_t = (
+        lambda eo: (lambda x: eo.embed(x), eo.out_dim)
+    )(ref.encoding.FreqEncoder(
+        include_input=True, input_dims=3, max_freq_log2=9, num_freqs=10,
+        log_sampling=True, periodic_fns=[torch.sin, torch.cos],
+    ))
+    enc_j, out_dim_j = frequency_encoder(
+        input_dim=3, n_freqs=10, include_input=True, log_sampling=True
+    )
+    assert out_dim_j == out_dim_t == 63
+
+    x = np.random.default_rng(0).uniform(-1.5, 1.5, (64, 3)).astype(np.float32)
+    out_t = enc_t(torch.from_numpy(x)).numpy()
+    out_j = np.asarray(enc_j(x))
+    np.testing.assert_allclose(out_j, out_t, rtol=1e-6, atol=1e-6)
+
+
+def _copy_torch_weights_to_flax(ref_mlp, D):
+    """torch Linear [out, in] weights → flax kernel [in, out] param tree."""
+    def pair(linear):
+        return {
+            "kernel": linear.weight.detach().numpy().T,
+            "bias": linear.bias.detach().numpy(),
+        }
+
+    params = {
+        f"pts_linear_{i}": pair(ref_mlp.pts_linears[i]) for i in range(D)
+    }
+    params["feature_linear"] = pair(ref_mlp.feature_linear)
+    params["alpha_linear"] = pair(ref_mlp.alpha_linear)
+    params["views_linear_0"] = pair(ref_mlp.views_linears[0])
+    params["rgb_linear"] = pair(ref_mlp.rgb_linear)
+    return params
+
+
+def test_nerf_mlp_forward_matches_reference(ref):
+    """The flagship MLP (D=8, W=256, skip at 4, viewdirs) with the
+    reference's own randomly-initialized weights copied over: identical
+    embedded inputs → identical raw (rgb, sigma) outputs. Output ordering
+    (rgb first, alpha last — ref network.py:69-70) included."""
+    import jax
+
+    from nerf_replication_tpu.models.nerf.network import NeRFMLP
+
+    D, W, in_ch, in_ch_views = 8, 256, 63, 27
+    torch.manual_seed(0)
+    ref_mlp = ref.network.NeRF(
+        D=D, W=W, input_ch=in_ch, input_ch_views=in_ch_views,
+        skips=[4], use_viewdirs=True,
+    ).eval()
+
+    ours = NeRFMLP(
+        D=D, W=W, input_ch=in_ch, input_ch_views=in_ch_views,
+        skips=(4,), use_viewdirs=True,
+    )
+    params = {"params": _copy_torch_weights_to_flax(ref_mlp, D)}
+
+    x = np.random.default_rng(1).normal(
+        size=(128, in_ch + in_ch_views)
+    ).astype(np.float32)
+    with torch.no_grad():
+        out_t = ref_mlp(torch.from_numpy(x)).numpy()
+    out_j = np.asarray(ours.apply(params, x))
+    assert out_j.shape == out_t.shape == (128, 4)
+    np.testing.assert_allclose(out_j, out_t, rtol=1e-5, atol=1e-5)
+
+
+def test_raw2outputs_matches_reference(ref):
+    """Identical raw network outputs + z_vals + ray dirs → identical
+    composited rgb/depth/acc/weights (ref volume_renderer.py:20-81),
+    both with and without the white background."""
+    from nerf_replication_tpu.renderer.volume import raw2outputs
+
+    rng = np.random.default_rng(2)
+    n_rays, n_samples = 64, 48
+    raw = rng.normal(size=(n_rays, n_samples, 4)).astype(np.float32)
+    z_vals = np.sort(
+        rng.uniform(2.0, 6.0, (n_rays, n_samples)).astype(np.float32), -1
+    )
+    rays_d = rng.normal(size=(n_rays, 3)).astype(np.float32)
+
+    for white_bkgd in (False, True):
+        rgb_t, depth_t, acc_t, w_t = ref.renderer.Renderer.raw2outputs(
+            None,
+            torch.from_numpy(raw),
+            torch.from_numpy(z_vals),
+            torch.from_numpy(rays_d),
+            raw_noise_std=0,
+            white_bkgd=white_bkgd,
+        )
+        rgb_j, depth_j, acc_j, w_j = raw2outputs(
+            raw, z_vals, rays_d, key=None, raw_noise_std=0.0,
+            white_bkgd=white_bkgd,
+        )
+        np.testing.assert_allclose(
+            np.asarray(rgb_j), rgb_t.numpy(), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(depth_j), depth_t.numpy(), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(acc_j), acc_t.numpy(), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(w_j), w_t.numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_sample_pdf_det_matches_reference(ref):
+    """Deterministic (det=True) hierarchical sampling: same bins/weights →
+    the same fine z samples (ref volume_renderer.py:82-151). The stochastic
+    path cannot be compared directly (different RNGs); det exercises the
+    whole CDF-inversion pipeline."""
+    from nerf_replication_tpu.renderer.volume import sample_pdf
+
+    rng = np.random.default_rng(3)
+    n_rays, n_bins, n_fine = 32, 63, 128
+    bins = np.sort(
+        rng.uniform(2.0, 6.0, (n_rays, n_bins)).astype(np.float32), -1
+    )
+    weights = rng.uniform(0.0, 1.0, (n_rays, n_bins - 1)).astype(np.float32)
+
+    out_t = ref.renderer.Renderer.sample_pdf(
+        None, torch.from_numpy(bins), torch.from_numpy(weights),
+        n_fine, det=True,
+    ).numpy()
+    out_j = np.asarray(sample_pdf(None, bins, weights, n_fine, det=True))
+    np.testing.assert_allclose(out_j, out_t, rtol=1e-4, atol=1e-4)

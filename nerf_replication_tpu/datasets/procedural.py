@@ -235,3 +235,44 @@ def generate_light_stage_capture(
         np.array({"cams": cams, "ims": ims}, dtype=object),
     )
     return root
+
+
+def ensure_scene(root: str, scene: str = "procedural", H: int = 64,
+                 W: int = 64, n_train: int = 20, n_test: int = 4) -> str:
+    """Generate the scene unless an up-to-date one already exists.
+
+    A dir left by an earlier run at a different resolution or view count
+    would silently train on the wrong scene (or trip the dataset's
+    capture-size guard) — verify the first train image's size and both
+    splits' frame counts, and regenerate on any mismatch.
+    """
+    scene_dir = os.path.join(root, scene)
+    tjson = os.path.join(scene_dir, "transforms_train.json")
+    stale = not os.path.exists(tjson)
+    if not stale:
+        from PIL import Image
+
+        def frames(split):
+            p = os.path.join(scene_dir, f"transforms_{split}.json")
+            if not os.path.exists(p):
+                return -1
+            with open(p) as f:
+                return len(json.load(f).get("frames", []))
+
+        first = os.path.join(scene_dir, "train", "r_0.png")
+        if (not os.path.exists(first) or frames("train") != n_train
+                or frames("test") != n_test):
+            stale = True
+        else:
+            with Image.open(first) as im:
+                stale = im.size != (W, H)
+        if stale:
+            import shutil
+
+            shutil.rmtree(scene_dir)
+            print(f"scene at {scene_dir} is stale; regenerating", flush=True)
+    if stale:
+        print(f"generating {n_train}-view {H}x{W} scene …", flush=True)
+        generate_scene(root, scene=scene, H=H, W=W, n_train=n_train,
+                       n_test=n_test)
+    return scene_dir

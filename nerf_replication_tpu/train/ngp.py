@@ -83,6 +83,14 @@ class NGPTrainer:
         self._render_fns: dict = {}
 
     # -- state ---------------------------------------------------------------
+    def make_state(self, key):
+        """(state, schedule) with fresh params and the warm-started grid."""
+        from ..models import init_params_for
+
+        params = init_params_for(self.cfg)(self.network, key)
+        tx, schedule = make_optimizer(self.cfg)
+        return self.init_state(params["params"], tx), schedule
+
     def init_state(self, params, tx) -> NGPTrainState:
         """Grid starts fully occupied (ema above threshold ⇒ dense march)
         so the first steps have gradients everywhere; decay + live updates
@@ -173,6 +181,29 @@ class NGPTrainer:
         return self._step_fn(state, bank_rays, bank_rgbs, base_key)
 
     # -- eval ----------------------------------------------------------------
+    def val(self, state, test_dataset, evaluator, max_images=None, log=print):
+        """Whole-image validation mirroring Trainer.val: render every test
+        image through the live-grid march, feed the evaluator, summarize.
+        The single implementation behind quality_run's NGP mode and
+        scripts/bench_ngp.py — eval semantics must not fork."""
+        import numpy as np
+
+        n = len(test_dataset)
+        if max_images is not None:
+            n = min(n, max_images)
+        for i in range(n):
+            batch = test_dataset.image_batch(i)
+            out = self.render_image(state, {"rays": batch["rays"]})
+            evaluator.evaluate(
+                {k: np.asarray(v) for k, v in out.items()}, batch
+            )
+        result = evaluator.summarize()
+        if result:
+            log("ngp val: " + "  ".join(
+                f"{k}: {v:.4f}" for k, v in result.items()
+            ))
+        return result
+
     def render_image(self, state, batch: dict) -> dict:
         """Full-image eval through the accelerated march with the live grid
         (the chunked coarse+fine path is meaningless here: NGP training
@@ -221,13 +252,3 @@ class NGPTrainer:
 
 def make_ngp_trainer(cfg, network) -> NGPTrainer:
     return NGPTrainer(cfg, network)
-
-
-def make_ngp_state(cfg, network, key):
-    """(state, schedule) with the grid warm-started fully occupied."""
-    from ..models import init_params_for
-
-    params = init_params_for(cfg)(network, key)
-    tx, schedule = make_optimizer(cfg)
-    trainer = NGPTrainer(cfg, network)
-    return trainer.init_state(params["params"], tx), schedule

@@ -1,0 +1,159 @@
+"""NGP-vs-standard training benchmark: throughput and quality per second.
+
+Two arms on the same procedural scene and the same hash-grid config
+(configs/nerf/lego_hash.yaml):
+
+* ``std`` — the flagship coarse+fine hierarchical trainer (train/trainer.py)
+* ``ngp`` — occupancy-accelerated training (train/ngp.py): live-grid march,
+  fine network only
+
+Each arm trains under a fixed wall-clock budget, then evaluates PSNR on held
+-out views through its own eval path. Appends one JSON line per arm to the
+--out file: {"arm", "rays_per_sec", "steps", "psnr", "ssim", "occupancy"
+(ngp only), "t_s", "config", "ts"}.
+
+    python scripts/bench_ngp.py --seconds 120 [--H 200] [--n_rays 4096]
+        [--force_platform cpu] [--out BENCH_NGP.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--seconds", type=float, default=120.0,
+                   help="train budget per arm (excludes compile + eval)")
+    p.add_argument("--H", type=int, default=200)
+    p.add_argument("--views", type=int, default=60)
+    p.add_argument("--test_views", type=int, default=2)
+    p.add_argument("--n_rays", type=int, default=4096)
+    p.add_argument("--scene_root", default="data/bench_ngp_scene")
+    p.add_argument("--arms", nargs="+", default=["std", "ngp"])
+    p.add_argument("--out", default="BENCH_NGP.jsonl")
+    p.add_argument("--force_platform", default=os.environ.get(
+        "BENCH_FORCE_PLATFORM", ""))
+    p.add_argument("opts", nargs="*", default=[])
+    args = p.parse_args(argv)
+
+    if args.force_platform:
+        from nerf_replication_tpu.utils.platform import force_platform
+
+        force_platform(args.force_platform)
+
+    from nerf_replication_tpu.utils.platform import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    import jax
+
+    from nerf_replication_tpu.config import make_cfg
+    from nerf_replication_tpu.datasets import make_dataset
+    from nerf_replication_tpu.datasets.procedural import ensure_scene
+    from nerf_replication_tpu.evaluators import make_evaluator
+    from nerf_replication_tpu.models import make_network
+
+    scene = "procedural"
+    ensure_scene(args.scene_root, scene=scene, H=args.H, W=args.H,
+                 n_train=args.views, n_test=args.test_views)
+
+    def build_cfg(extra):
+        return make_cfg(
+            os.path.join(_REPO, "configs", "nerf", "lego_hash.yaml"),
+            [
+                "scene", scene,
+                "train_dataset.data_root", args.scene_root,
+                "test_dataset.data_root", args.scene_root,
+                "train_dataset.H", str(args.H), "train_dataset.W", str(args.H),
+                "test_dataset.H", str(args.H), "test_dataset.W", str(args.H),
+                "test_dataset.cams", "[0, -1, 1]",
+                "task_arg.N_rays", str(args.n_rays),
+                "task_arg.precrop_iters", "0",
+                *extra,
+                *args.opts,
+            ],
+        )
+
+    out_f = open(args.out, "a")
+
+    for arm in args.arms:
+        if arm == "ngp":
+            cfg = build_cfg((
+                "task_arg.ngp_training", "true",
+                "task_arg.ngp_grid_res", "128",
+            ))
+        else:
+            cfg = build_cfg(())
+        network = make_network(cfg)
+        evaluator = make_evaluator(cfg)
+        train_ds = make_dataset(cfg, "train")
+        test_ds = make_dataset(cfg, "test")
+        bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+        key = jax.random.PRNGKey(1)
+
+        if arm == "ngp":
+            from nerf_replication_tpu.train.ngp import make_ngp_trainer
+
+            trainer = make_ngp_trainer(cfg, network)
+            state, _ = trainer.make_state(jax.random.PRNGKey(0))
+        else:
+            from nerf_replication_tpu.train import make_loss, make_train_state
+            from nerf_replication_tpu.train.trainer import Trainer
+
+            loss = make_loss(cfg, network)
+            trainer = Trainer(cfg, network, loss, evaluator)
+            state, _ = make_train_state(cfg, network, jax.random.PRNGKey(0))
+
+        # compile outside the timed window
+        state, stats = trainer.step(state, bank[0], bank[1], key)
+        jax.block_until_ready(stats)
+
+        steps = 0
+        t0 = time.time()
+        while time.time() - t0 < args.seconds:
+            for _ in range(20):
+                state, stats = trainer.step(state, bank[0], bank[1], key)
+            jax.block_until_ready(stats)
+            steps += 20
+        dt = time.time() - t0
+
+        if arm == "ngp":
+            result = trainer.val(
+                state, test_ds, evaluator, max_images=args.test_views
+            )
+        else:
+            result = trainer.val(
+                state, epoch=steps, test_dataset=test_ds,
+                max_images=args.test_views,
+            )
+
+        rec = {
+            "arm": arm,
+            "rays_per_sec": round(steps * args.n_rays / dt, 1),
+            "steps": steps,
+            "t_s": round(dt, 1),
+            "psnr": round(float(result.get("psnr", 0.0)), 3),
+            "ssim": round(float(result.get("ssim", 0.0)), 4),
+            "config": "lego_hash.yaml",
+            "n_rays": args.n_rays,
+            "ts": round(time.time(), 1),
+        }
+        if arm == "ngp":
+            rec["occupancy"] = round(float(stats["occupancy"]), 4)
+            rec["truncated_frac"] = round(float(stats["truncated_frac"]), 4)
+        print(json.dumps(rec), flush=True)
+        out_f.write(json.dumps(rec) + "\n")
+        out_f.flush()
+    out_f.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -63,7 +63,6 @@ def main(argv=None):
 
     from nerf_replication_tpu.config import make_cfg
     from nerf_replication_tpu.datasets import make_dataset
-    from nerf_replication_tpu.datasets.procedural import generate_scene
     from nerf_replication_tpu.evaluators import make_evaluator
     from nerf_replication_tpu.models import make_network
     from nerf_replication_tpu.train import make_loss, make_train_state
@@ -71,40 +70,10 @@ def main(argv=None):
     from nerf_replication_tpu.train.trainer import Trainer
 
     scene = "procedural"
-    tjson = os.path.join(args.scene_root, scene, "transforms_train.json")
-    stale = False
-    if os.path.exists(tjson):
-        # a scene dir left by an earlier run at a different resolution or
-        # view count would silently train on the wrong scene (or trip the
-        # dataset's capture-size guard) — regenerate instead
-        from PIL import Image
+    from nerf_replication_tpu.datasets.procedural import ensure_scene
 
-        first = os.path.join(args.scene_root, scene, "train", "r_0.png")
-        n_train = len(json.load(open(tjson)).get("frames", []))
-        tjson_test = os.path.join(
-            args.scene_root, scene, "transforms_test.json"
-        )
-        n_test = -1
-        if os.path.exists(tjson_test):
-            n_test = len(json.load(open(tjson_test)).get("frames", []))
-        if (not os.path.exists(first) or n_train != args.views
-                or n_test != args.test_views):
-            stale = True
-        else:
-            with Image.open(first) as im:
-                stale = im.size != (args.H, args.H)
-        if stale:
-            print(f"scene at {args.scene_root} is stale; regenerating",
-                  flush=True)
-            import shutil
-
-            shutil.rmtree(os.path.join(args.scene_root, scene))
-    if stale or not os.path.exists(tjson):
-        print(f"generating {args.views}-view {args.H}² scene …", flush=True)
-        generate_scene(
-            args.scene_root, scene=scene, H=args.H, W=args.H,
-            n_train=args.views, n_test=args.test_views,
-        )
+    ensure_scene(args.scene_root, scene=scene, H=args.H, W=args.H,
+                 n_train=args.views, n_test=args.test_views)
 
     cfg = make_cfg(
         os.path.join(_REPO, "configs", "nerf", args.config),
@@ -128,13 +97,10 @@ def main(argv=None):
     if ngp:
         # occupancy-accelerated training (train/ngp.py): live-grid march,
         # fine network only; eval goes through the march with the live grid
-        from nerf_replication_tpu.train.ngp import (
-            make_ngp_state,
-            make_ngp_trainer,
-        )
+        from nerf_replication_tpu.train.ngp import make_ngp_trainer
 
         trainer = make_ngp_trainer(cfg, network)
-        state, schedule = make_ngp_state(cfg, network, jax.random.PRNGKey(0))
+        state, schedule = trainer.make_state(jax.random.PRNGKey(0))
     else:
         loss = make_loss(cfg, network)
         trainer = Trainer(cfg, network, loss, evaluator)
@@ -146,16 +112,9 @@ def main(argv=None):
                 state, epoch=epoch, test_dataset=test_ds,
                 max_images=args.test_views,
             )
-        for i in range(min(len(test_ds), args.test_views)):
-            batch = test_ds.image_batch(i)
-            out = trainer.render_image(state, {"rays": batch["rays"]})
-            evaluator.evaluate(
-                {k: np.asarray(v) for k, v in out.items()}, batch
-            )
-        result = evaluator.summarize()
-        print(f"val step {epoch}: " + "  ".join(
-            f"{k}: {v:.4f}" for k, v in result.items()), flush=True)
-        return result
+        return trainer.val(
+            state, test_ds, evaluator, max_images=args.test_views
+        )
 
     train_ds = make_dataset(cfg, "train")
     test_ds = make_dataset(cfg, "test")

@@ -81,6 +81,11 @@ BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 2400 python scripts/bench_swe
   --remat true --steps 30 --point_timeout 700 --out BENCH_SWEEP_HASH.jsonl
 
 mkdir -p data/logs
+log "=== stage 3b: NGP-vs-standard training bench ==="
+timeout 1800 python scripts/bench_ngp.py --seconds 120 \
+  --out BENCH_NGP.jsonl precision.compute_dtype bfloat16 \
+  2>data/logs/bench_ngp.err | tee -a data/logs/bench_ngp.out
+
 log "=== stage 4: hash shootout (XLA vs Pallas) ==="
 timeout 1500 python scripts/bench_hash.py 2>data/logs/bench_hash.err \
   | tee -a BENCH_HASH.jsonl

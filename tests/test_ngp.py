@@ -20,11 +20,7 @@ from test_train import tiny_cfg
 from nerf_replication_tpu.datasets.blender import Dataset
 from nerf_replication_tpu.datasets.procedural import generate_scene
 from nerf_replication_tpu.models import make_network
-from nerf_replication_tpu.train.ngp import (
-    NGPTrainState,
-    make_ngp_state,
-    make_ngp_trainer,
-)
+from nerf_replication_tpu.train.ngp import NGPTrainState, make_ngp_trainer
 
 NGP_EXTRA = (
     "train_dataset.H", "32", "train_dataset.W", "32",
@@ -50,7 +46,7 @@ def setup(tmp_path_factory):
 def test_ngp_trains_and_carves_occupancy(setup):
     root, cfg, net = setup
     trainer = make_ngp_trainer(cfg, net)
-    state, _ = make_ngp_state(cfg, net, jax.random.PRNGKey(0))
+    state, _ = trainer.make_state(jax.random.PRNGKey(0))
     assert isinstance(state, NGPTrainState)
     # warm start: everything occupied ⇒ dense march with gradients everywhere
     assert float(jnp.mean(state.grid_ema > trainer.threshold)) == 1.0
@@ -78,7 +74,7 @@ def test_ngp_trains_and_carves_occupancy(setup):
     rgb = np.asarray(out["rgb_map_f"])
     assert rgb.shape == (32 * 32, 3) and np.isfinite(rgb).all()
     # trained output beats an untrained render on PSNR
-    fresh, _ = make_ngp_state(cfg, net, jax.random.PRNGKey(2))
+    fresh, _ = trainer.make_state(jax.random.PRNGKey(2))
     rgb0 = np.asarray(trainer.render_image(fresh, {"rays": b["rays"]})["rgb_map_f"])
     gt = np.asarray(b["rgbs"])
     mse_t = float(np.mean((rgb - gt) ** 2))
@@ -91,7 +87,7 @@ def test_ngp_grid_update_is_densitydriven(setup):
     cells over real content stay occupied (scatter-max vs decay race)."""
     root, cfg, net = setup
     trainer = make_ngp_trainer(cfg, net)
-    state, _ = make_ngp_state(cfg, net, jax.random.PRNGKey(0))
+    state, _ = trainer.make_state(jax.random.PRNGKey(0))
     ds = Dataset(data_root=root, scene="procedural", split="train", H=32, W=32)
     bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
     key = jax.random.PRNGKey(1)

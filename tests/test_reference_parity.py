@@ -57,6 +57,11 @@ def ref():
     finally:
         sys.argv = old_argv
         os.chdir(old_cwd)
+        # don't leak the reference tree onto sys.path for later modules
+        try:
+            sys.path.remove(_REF)
+        except ValueError:
+            pass
 
 
 def test_frequency_encoder_matches_reference(ref):

@@ -219,6 +219,20 @@ def fit(cfg, network=None, log=print):
     from ..utils.setup import configure_runtime
     from .recorder import make_recorder
 
+    if bool(cfg.task_arg.get("ngp_training", False)):
+        # the epoch-loop entry drives the hierarchical Trainer; silently
+        # training the wrong path under an NGP config would be worse than
+        # refusing. Config-only check, so it fires BEFORE multihost_init
+        # joins the (possibly blocking) pod barrier. NGP training currently
+        # runs through its own drivers.
+        raise NotImplementedError(
+            "task_arg.ngp_training is not wired into the epoch-loop entry "
+            "yet — run occupancy-accelerated training via "
+            "scripts/quality_run.py ... task_arg.ngp_training true, or "
+            "drive train.ngp.NGPTrainer directly (scripts/bench_ngp.py "
+            "shows the loop)"
+        )
+
     # multi-host runtime first (parity: NCCL process-group init,
     # reference train.py:116-120)
     multihost_init(cfg)

@@ -101,3 +101,13 @@ def test_ngp_grid_update_is_densitydriven(setup):
     # the bbox center (inside the sphere) must remain occupied
     c = trainer.grid_res // 2
     assert grid[c - 1 : c + 1, c - 1 : c + 1, c - 1 : c + 1].any()
+
+
+def test_fit_refuses_ngp_config(setup):
+    """The epoch-loop entry must refuse an ngp_training config loudly
+    instead of silently training the hierarchical path under it."""
+    from nerf_replication_tpu.train.trainer import fit
+
+    _, cfg, net = setup
+    with pytest.raises(NotImplementedError, match="ngp_training"):
+        fit(cfg, network=net, log=lambda *a, **k: None)

@@ -250,6 +250,27 @@ if __name__ == "__main__":
         # emit a diagnostic line (value null) so the record is actionable
         # rather than an opaque non-zero exit.
         traceback.print_exc()
+        # make the failure record actionable: point at the best current
+        # measurement instead of leaving a bare null (round-2's record was
+        # an opaque failure while the real numbers sat in the sweep files).
+        # Prefer the config this run was benchmarking; fall back to any.
+        best_known = None
+        try:
+            import glob
+
+            from nerf_replication_tpu.utils.sweeps import best_point
+
+            paths = glob.glob(os.path.join(_REPO, "BENCH_SWEEP*.jsonl"))
+            cfg_name = os.environ.get("BENCH_CONFIG", "lego.yaml")
+            rec = best_point(paths, config=cfg_name) or best_point(paths)
+            if rec is not None:
+                best_known = {
+                    k: rec.get(k)
+                    for k in ("value", "n_rays", "dtype", "remat")
+                }
+                best_known["config"] = rec.get("config", "lego.yaml")
+        except Exception:
+            pass
         print(
             json.dumps(
                 {
@@ -258,6 +279,7 @@ if __name__ == "__main__":
                     "unit": "rays/s",
                     "vs_baseline": None,
                     "error": f"{type(exc).__name__}: {exc}",
+                    "best_known_measurement": best_known,
                 }
             )
         )

@@ -23,6 +23,7 @@ import os
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 
 def main(argv=None):
@@ -33,30 +34,11 @@ def main(argv=None):
     p.add_argument("--out", default=os.path.join(_REPO, "BENCH_DEFAULTS.json"))
     args = p.parse_args(argv)
 
-    # last record per sweep point wins (files are append-only across runs)
-    latest: dict[tuple, dict] = {}
-    for path in args.sweeps:
-        try:
-            with open(path) as f:
-                lines = f.readlines()
-        except OSError:
-            continue
-        for line in lines:
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if rec.get("config", "lego.yaml") != args.config:
-                continue
-            key = (rec.get("n_rays"), rec.get("dtype"), rec.get("remat"))
-            # recency by the record's ts (absent on pre-r3 records ⇒ oldest);
-            # ties (same run) resolve to file/line order
-            if key not in latest or rec.get("ts", 0) >= latest[key].get("ts", 0):
-                latest[key] = rec
+    # last record per sweep point wins (files are append-only across runs);
+    # the recency rule lives once in utils/sweeps.py, shared with bench.py
+    from nerf_replication_tpu.utils.sweeps import best_point
 
-    valid = [r for r in latest.values()
-             if isinstance(r.get("value"), (int, float))]
-    best = max(valid, key=lambda r: r["value"], default=None)
+    best = best_point(args.sweeps, config=args.config)
     if best is None:
         print("promote: no valid points found; leaving defaults untouched")
         return 1

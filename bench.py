@@ -25,92 +25,6 @@ import jax.numpy as jnp
 _REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_RAYS_PER_SEC = 1024 / 0.222  # reference log.txt mean iter time
 
-
-def _init_backend_with_retry(
-    retries: int = 3, delay_s: float = 15.0, hang_timeout_s: float = 120.0
-):
-    """Touch the device backend, retrying on transient init failures.
-
-    Round 1's bench failed rc=1 with "Unable to initialize backend 'axon':
-    UNAVAILABLE" — the TPU tunnel can be momentarily sick. Two distinct
-    failure modes need distinct handling:
-
-    * init RAISES (UNAVAILABLE): transient — bounded retry with a stderr
-      diagnostic turns a flaky chip into a delayed number.
-    * init HANGS (tunnel wedged): a timeout must bound the wait, or the
-      whole driver time budget is eaten (round 1's rc=124).
-
-    Each probe runs in a SUBPROCESS: it can be killed on hang, its failure
-    isn't cached in this process's backend state, and (axon is monoclient)
-    it releases the tunnel on exit before the real in-process init. The
-    in-process init itself then runs in a watchdog thread with the same
-    timeout and feeds the same retry loop — a wedge or UNAVAILABLE between
-    probe exit and attach is handled, not just the probe.
-    """
-    import subprocess
-    import threading
-
-    def _attach_in_process():
-        """Bounded in-process jax.devices(): (devices|None, error|None)."""
-        result: dict = {}
-
-        def attach():
-            try:
-                result["devices"] = jax.devices()
-            except Exception as exc:
-                result["error"] = exc
-
-        t = threading.Thread(target=attach, daemon=True)
-        t.start()
-        t.join(hang_timeout_s)
-        if t.is_alive():
-            return None, RuntimeError(
-                f"in-process backend init hung >{hang_timeout_s:.0f}s"
-            )
-        return result.get("devices"), result.get("error")
-
-    last = "unknown"
-    attempt = 0
-    while attempt < retries:
-        attempt += 1
-        try:
-            p = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True,
-                text=True,
-                timeout=hang_timeout_s,
-            )
-            if p.returncode == 0:
-                devices, err = _attach_in_process()
-                if devices is not None:
-                    print(
-                        f"bench: backend '{jax.default_backend()}' up, "
-                        f"{len(devices)} device(s): {devices[0].device_kind}",
-                        file=sys.stderr,
-                    )
-                    return devices
-                if isinstance(err, RuntimeError) and "hung" in str(err):
-                    # a thread stuck in backend init holds the init lock:
-                    # further in-process attempts block on it — fail fast
-                    raise err
-                last = str(err)
-            else:
-                tail = (p.stderr or p.stdout).strip().splitlines()
-                last = tail[-1] if tail else "probe exited nonzero"
-        except subprocess.TimeoutExpired:
-            last = f"backend init hung >{hang_timeout_s:.0f}s (tunnel wedged?)"
-            # measured on this machine: the terminal restarts itself after an
-            # OOM storm and answers again after a few minutes — honor the
-            # caller's full retry budget instead of bailing after one re-probe
-        print(
-            f"bench: backend probe {attempt}/{retries} failed: {last}",
-            file=sys.stderr,
-        )
-        if attempt < retries:
-            time.sleep(delay_s)
-    raise RuntimeError(f"backend unavailable after {retries} attempts: {last}")
-
-
 def main():
     from nerf_replication_tpu.config import make_cfg
     from nerf_replication_tpu.models.nerf.network import make_network
@@ -128,11 +42,11 @@ def main():
         # after an HBM-OOM storm the axon terminal restarts itself and can
         # take minutes to answer again — the retry budget is env-tunable so
         # sweeps can ride out the recovery window
-        _init_backend_with_retry(
-            retries=int(os.environ.get("BENCH_INIT_RETRIES", 3)),
-            delay_s=float(os.environ.get("BENCH_INIT_DELAY_S", 15)),
-            hang_timeout_s=float(os.environ.get("BENCH_INIT_TIMEOUT_S", 120)),
+        from nerf_replication_tpu.utils.platform import (
+            init_backend_with_retry,
         )
+
+        init_backend_with_retry()  # budget via BENCH_INIT_* env vars
 
     # Measured-best defaults: scripts/tpu_battery.sh promotes the winning
     # sweep point into BENCH_DEFAULTS.json so the driver's plain

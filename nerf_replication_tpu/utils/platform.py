@@ -73,3 +73,115 @@ def enable_compilation_cache(path: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         pass
+
+
+def init_backend_with_retry(
+    retries: int | None = None,
+    delay_s: float | None = None,
+    hang_timeout_s: float | None = None,
+):
+    """Touch the device backend, retrying on transient init failures.
+
+    The axon TPU tunnel on this machine is monoclient and can WEDGE (init
+    hangs forever) or flap (UNAVAILABLE) — measured behavior: after an
+    HBM-OOM compile storm the terminal restarts itself and answers again
+    minutes later. Every chip-facing entry point must bound its first
+    backend touch or a wedged tunnel silently eats its whole time budget
+    (round-3 failure mode: quality_run hung 20 min at 0% CPU on init).
+
+    Two failure modes, two handlings:
+
+    * init RAISES (UNAVAILABLE): transient — bounded retry.
+    * init HANGS: probe in a SUBPROCESS (killable, doesn't poison this
+      process's backend state, releases the monoclient tunnel on exit),
+      then attach in-process under a watchdog thread.
+
+    Defaults come from ``BENCH_INIT_RETRIES`` / ``BENCH_INIT_DELAY_S`` /
+    ``BENCH_INIT_TIMEOUT_S`` so sweep drivers can widen the budget.
+    Returns the device list; raises RuntimeError when the budget is spent.
+    """
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    import jax
+
+    if retries is None:
+        retries = int(os.environ.get("BENCH_INIT_RETRIES", 3))
+    if delay_s is None:
+        delay_s = float(os.environ.get("BENCH_INIT_DELAY_S", 15))
+    if hang_timeout_s is None:
+        hang_timeout_s = float(os.environ.get("BENCH_INIT_TIMEOUT_S", 120))
+
+    def _attach_in_process():
+        result: dict = {}
+
+        def attach():
+            try:
+                result["devices"] = jax.devices()
+            except Exception as exc:
+                result["error"] = exc
+
+        t = threading.Thread(target=attach, daemon=True)
+        t.start()
+        t.join(hang_timeout_s)
+        if t.is_alive():
+            return None, RuntimeError(
+                f"in-process backend init hung >{hang_timeout_s:.0f}s"
+            )
+        return result.get("devices"), result.get("error")
+
+    last = "unknown"
+    attempt = 0
+    while attempt < retries:
+        attempt += 1
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                text=True,
+                timeout=hang_timeout_s,
+            )
+            if p.returncode == 0:
+                devices, err = _attach_in_process()
+                if devices is not None:
+                    print(
+                        f"backend '{jax.default_backend()}' up, "
+                        f"{len(devices)} device(s): {devices[0].device_kind}",
+                        file=sys.stderr,
+                    )
+                    return devices
+                if isinstance(err, RuntimeError) and "hung" in str(err):
+                    # a thread stuck in backend init holds the init lock:
+                    # further in-process attempts block on it — fail fast
+                    raise err
+                last = str(err)
+            else:
+                tail = (p.stderr or p.stdout).strip().splitlines()
+                last = tail[-1] if tail else "probe exited nonzero"
+        except subprocess.TimeoutExpired:
+            last = f"backend init hung >{hang_timeout_s:.0f}s (tunnel wedged?)"
+        print(
+            f"backend probe {attempt}/{retries} failed: {last}",
+            file=sys.stderr,
+        )
+        if attempt < retries:
+            time.sleep(delay_s)
+    raise RuntimeError(f"backend unavailable after {retries} attempts: {last}")
+
+
+def setup_backend(force_platform_name: str | None = None) -> None:
+    """One-call backend setup for chip-facing entry points.
+
+    ``force_platform_name`` set (e.g. "cpu"): pin that platform — CI /
+    smoke / driver-dryrun path, no tunnel touched. Unset: guarded init of
+    the real backend (``init_backend_with_retry``) so a wedged axon tunnel
+    fails the entry point loudly instead of hanging it. Every script that
+    can run on the chip routes through this — the round-3 20-minute silent
+    hang was one entry point missing the guard.
+    """
+    if force_platform_name:
+        force_platform(force_platform_name)
+    else:
+        init_backend_with_retry()

@@ -44,10 +44,9 @@ def main(argv=None):
     p.add_argument("opts", nargs="*", default=[])
     args = p.parse_args(argv)
 
-    if args.force_platform:
-        from nerf_replication_tpu.utils.platform import force_platform
+    from nerf_replication_tpu.utils.platform import setup_backend
 
-        force_platform(args.force_platform)
+    setup_backend(args.force_platform)
 
     from nerf_replication_tpu.utils.platform import enable_compilation_cache
 

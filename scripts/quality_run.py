@@ -49,15 +49,15 @@ def main(argv=None):
                    help="trailing cfg key/value overrides (smoke runs)")
     args = p.parse_args(argv)
 
-    if args.force_platform:
-        from nerf_replication_tpu.utils.platform import force_platform
+    from nerf_replication_tpu.utils.platform import (
+        enable_compilation_cache,
+        setup_backend,
+    )
 
-        force_platform(args.force_platform)
+    setup_backend(args.force_platform)
 
     import jax
     import numpy as np
-
-    from nerf_replication_tpu.utils.platform import enable_compilation_cache
 
     enable_compilation_cache()
 

@@ -54,10 +54,9 @@ def main(argv=None):
                    help="trailing cfg key/value overrides (CPU smoke: tiny net)")
     args = p.parse_args(argv)
 
-    if args.force_platform:
-        from nerf_replication_tpu.utils.platform import force_platform
+    from nerf_replication_tpu.utils.platform import setup_backend
 
-        force_platform(args.force_platform)
+    setup_backend(args.force_platform)
 
     import jax
     import jax.numpy as jnp

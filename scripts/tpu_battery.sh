@@ -9,16 +9,18 @@
 #              require TWO consecutive good probes 60 s apart before
 #              declaring a window (a single probe is not a usable window)
 #   * battery: run the measurement stages SERIALLY, each with its own
-#              timeout and its own incremental output file, ordered so the
-#              most valuable short stages land first if the window is short
+#              timeout and its own incremental output file, ordered by
+#              VALUE: the quality artifact first (the single most important
+#              output, on the only shape proven to compile here), then the
+#              sweeps whose cold compiles are long and can wedge the tunnel
 #
 # Usage:   bash scripts/tpu_battery.sh            # watch, then full battery
 #          WATCH_PROBES=0 bash scripts/tpu_battery.sh   # skip watch, run now
 #
 # Stages (each standalone-rerunnable):
-#   1. remat sweep 16k/64k/131k bf16   -> BENCH_SWEEP_REMAT.jsonl
+#   1. quality run (35 min, chip)      -> QUALITY.jsonl/md + grid + video
+#   2. remat sweep 16k/64k bf16        -> BENCH_SWEEP_REMAT.jsonl
 #      + promote best point            -> BENCH_DEFAULTS.json (bench.py reads)
-#   2. quality run (35 min, chip)      -> QUALITY.jsonl/md + grid + video
 #   3. lego_hash sweep                 -> BENCH_SWEEP_HASH.jsonl
 #      (not promoted: the driver headline stays on lego.yaml so vs_baseline
 #      remains apples-to-apples with the reference's big-MLP number)
@@ -62,23 +64,29 @@ if [ "$WATCH_PROBES" -gt 0 ]; then
   fi
 fi
 
-log "=== stage 1: remat sweep (big-MLP headline) ==="
-BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 3000 python scripts/bench_sweep.py \
-  --rays 16384 65536 131072 --dtypes bfloat16 --remat true --steps 30 \
-  --point_timeout 900 --out BENCH_SWEEP_REMAT.jsonl
+log "=== stage 1: quality run (chip, 35 min) — the #1 artifact, so it goes first ==="
+# 4096 rays / no remat: the one shape PROVEN to compile through this tunnel
+# (round 2's headline). Round-3 lesson: a 16k-ray remat graph took >15 min of
+# remote compile and the point timeout killed it — never put an unproven
+# compile in front of the quality artifact.
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 \
+timeout 5400 python scripts/quality_run.py --minutes 35 --H 400 --views 100 \
+  --test_views 4 --n_rays 4096 --eval_every_s 120 \
+  --scene_root data/quality_scene --target_psnr 21.55 2>&1 | tail -40
+
+log "=== stage 2: remat sweep (big-MLP headline) ==="
+# point_timeout must cover a cold 15-20 min remote compile (measured r3);
+# a killed compile caches nothing and the work is lost.
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 9000 python scripts/bench_sweep.py \
+  --rays 16384 65536 --dtypes bfloat16 --remat true --steps 30 \
+  --point_timeout 2400 --out BENCH_SWEEP_REMAT.jsonl
 python scripts/promote_bench_defaults.py \
   BENCH_SWEEP_REMAT.jsonl BENCH_SWEEP.jsonl --config lego.yaml
 
-log "=== stage 2: quality run (chip, 35 min) ==="
-timeout 4200 python scripts/quality_run.py --minutes 35 --H 400 --views 100 \
-  --test_views 4 --n_rays 16384 --eval_every_s 120 \
-  --scene_root data/quality_scene --target_psnr 21.55 \
-  task_arg.remat true 2>&1 | tail -40
-
 log "=== stage 3: lego_hash sweep (the 1M rays/s config) ==="
-BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 2400 python scripts/bench_sweep.py \
-  --config lego_hash.yaml --rays 16384 65536 262144 --dtypes bfloat16 \
-  --remat true --steps 30 --point_timeout 700 --out BENCH_SWEEP_HASH.jsonl
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 7500 python scripts/bench_sweep.py \
+  --config lego_hash.yaml --rays 65536 262144 --dtypes bfloat16 \
+  --remat true --steps 30 --point_timeout 2400 --out BENCH_SWEEP_HASH.jsonl
 
 mkdir -p data/logs
 log "=== stage 3b: NGP-vs-standard training bench ==="

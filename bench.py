@@ -41,7 +41,10 @@ def main():
     else:
         # after an HBM-OOM storm the axon terminal restarts itself and can
         # take minutes to answer again — the retry budget is env-tunable so
-        # sweeps can ride out the recovery window
+        # sweeps can ride out the recovery window. Deliberately NOT
+        # setup_backend(): that helper hard-exits on failure, and bench must
+        # instead catch the error below to emit its JSON failure record
+        # (the driver's one-line contract) before its own os._exit.
         from nerf_replication_tpu.utils.platform import (
             init_backend_with_retry,
         )
@@ -77,6 +80,10 @@ def main():
             os.environ.get("BENCH_DTYPE", defaults["dtype"]),
             "task_arg.remat",
             os.environ.get("BENCH_REMAT", str(defaults["remat"]).lower()),
+            # K optimizer steps per device dispatch (lax.scan) — the lever
+            # for the latency-bound small-batch regime (PERF.md)
+            "task_arg.scan_steps",
+            os.environ.get("BENCH_SCAN_STEPS", str(defaults.get("scan_steps", 1))),
         ],
     )
     network = make_network(cfg)
@@ -99,15 +106,20 @@ def main():
     bank_rays = jnp.concatenate([origins, dirs], axis=-1).astype(jnp.float32)
     bank_rgbs = jax.random.uniform(k3, (n_bank, 3), jnp.float32)
 
-    # warmup: compile + 3 steps
-    state, stats = trainer.step(state, bank_rays, bank_rgbs, base_key)
+    # scan_steps>1: K steps per dispatch; n_steps rounds UP to whole bursts
+    scan_k = trainer.scan_steps
+    n_bursts = max(1, -(-n_steps // scan_k))
+    n_steps = n_bursts * scan_k
+
+    # warmup: compile + 3 bursts
+    state, stats = trainer.multi_step(state, bank_rays, bank_rgbs, base_key)
     for _ in range(3):
-        state, stats = trainer.step(state, bank_rays, bank_rgbs, base_key)
+        state, stats = trainer.multi_step(state, bank_rays, bank_rgbs, base_key)
     jax.block_until_ready(stats)
 
     t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, stats = trainer.step(state, bank_rays, bank_rgbs, base_key)
+    for _ in range(n_bursts):
+        state, stats = trainer.multi_step(state, bank_rays, bank_rgbs, base_key)
     jax.block_until_ready(stats)
     dt = time.perf_counter() - t0
 
@@ -151,6 +163,7 @@ def main():
                 "dtype": dtype,
                 "peak_flops": peak,
                 "n_rays": n_rays,
+                "scan_steps": scan_k,
             }
         )
     )
@@ -182,6 +195,7 @@ if __name__ == "__main__":
                     k: rec.get(k)
                     for k in ("value", "n_rays", "dtype", "remat")
                 }
+                best_known["scan_steps"] = rec.get("scan_steps", 1)
                 best_known["config"] = rec.get("config", "lego.yaml")
         except Exception:
             pass

@@ -66,9 +66,18 @@ class Trainer:
             self.far = float(cfg.task_arg.far)
         self.precrop_iters = int(cfg.task_arg.get("precrop_iters", 0))
         self.ep_iter = int(cfg.get("ep_iter", 500))
+        # scan_steps > 1 runs K optimizer steps inside ONE jitted lax.scan:
+        # the flagship step is latency-bound at small batches (~40 sequential
+        # small matmuls/step — PERF.md), and scanning removes K-1 host
+        # dispatches and lets XLA pipeline across step boundaries. Numerics
+        # are step-for-step identical to K single calls: the per-step key is
+        # derived from state.step, which apply_gradients advances inside the
+        # scan exactly as it does outside (tested).
+        self.scan_steps = max(1, int(cfg.task_arg.get("scan_steps", 1)))
         self.process_index = jax.process_index()
         self._step_fn = None
         self._step_fn_pool = None
+        self._multi_step_fns: dict[int, object] = {}
         self._val_render = None
 
     def epoch_iters(self, bank_size: int) -> int:
@@ -101,6 +110,44 @@ class Trainer:
 
         return step_fn
 
+    def _build_multi_step(self, k_steps: int):
+        n_rays = self.n_rays
+        process_index = self.process_index
+        near, far, loss = self.near, self.far, self.loss
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def multi_step_fn(state, bank_rays, bank_rgbs, base_key):
+            def body(st, _):
+                key = sample_step_key(base_key, st.step, process_index)
+                k_sample, k_render = jax.random.split(key)
+                grads, stats = sampled_grad_step(
+                    loss, st.params, bank_rays, bank_rgbs, n_rays, near,
+                    far, k_sample, k_render,
+                )
+                return st.apply_gradients(grads=grads), stats
+
+            state, stats_seq = jax.lax.scan(body, state, None, length=k_steps)
+            # the caller sees the LAST step's stats, same as k sequential
+            # calls; per-step traces inside a burst are not observable
+            return state, jax.tree_util.tree_map(lambda x: x[-1], stats_seq)
+
+        return multi_step_fn
+
+    def multi_step(self, state, bank_rays, bank_rgbs, base_key, k_steps=None):
+        """Run ``k_steps`` optimizer steps in one device dispatch (lax.scan).
+
+        The precrop index-pool variant is excluded on purpose: precrop lasts
+        a few hundred steps at most and burst boundaries would straddle the
+        precrop→full transition; train_epoch single-steps until the pool
+        retires, then switches to bursts."""
+        k = int(k_steps if k_steps is not None else self.scan_steps)
+        if k <= 1:
+            return self.step(state, bank_rays, bank_rgbs, base_key)
+        fn = self._multi_step_fns.get(k)
+        if fn is None:
+            fn = self._multi_step_fns[k] = self._build_multi_step(k)
+        return fn(state, bank_rays, bank_rgbs, base_key)
+
     def step(self, state, bank_rays, bank_rgbs, base_key, index_pool=None):
         """One optimization step; dispatches to the precrop or full variant."""
         if index_pool is not None:
@@ -126,27 +173,49 @@ class Trainer:
         # track the step on the host: int(state.step) would block on the
         # in-flight device step and serialize async dispatch
         host_step = int(state.step)
-        for it in range(max_iter):
+        it = 0
+        while it < max_iter:
             data_time = time.time() - end
             use_pool = pool is not None and host_step < self.precrop_iters
-            state, stats = self.step(
-                state, bank_rays, bank_rgbs, base_key,
-                index_pool=pool if use_pool else None,
+            if use_pool or self.scan_steps <= 1:
+                k = 1
+                state, stats = self.step(
+                    state, bank_rays, bank_rgbs, base_key,
+                    index_pool=pool if use_pool else None,
+                )
+            else:
+                # burst of K steps in one dispatch; clamp at the epoch end
+                # (the clamped tail compiles one extra small executable)
+                k = min(self.scan_steps, max_iter - it)
+                state, stats = self.multi_step(
+                    state, bank_rays, bank_rgbs, base_key, k
+                )
+            host_step += k
+            # log when a burst crosses a log_interval boundary (k=1 ⇒ the
+            # reference cadence, trainer.py:79)
+            should_log = (
+                it == 0
+                or (it + k - 1) // log_interval > (it - 1) // log_interval
+                or it + k >= max_iter
             )
-            host_step += 1
-            if it % log_interval == 0 or it == max_iter - 1:
+            if should_log:
                 # host sync only at the logging cadence
-                stats_host = {k: float(v) for k, v in stats.items()}
+                stats_host = {kk: float(v) for kk, v in stats.items()}
                 recorder.update_loss_stats(stats_host)
             recorder.step = host_step
-            recorder.batch_time.update(time.time() - end)
+            # per-step time so the console line stays comparable across
+            # scan_steps settings (and with the reference's batch: column)
+            recorder.batch_time.update((time.time() - end) / k)
             recorder.data_time.update(data_time)
             end = time.time()
-            if it % log_interval == 0 or it == max_iter - 1:
+            if should_log:
                 lr = float(schedule(host_step))
                 mem = _device_mem_mb()
-                log(recorder.console_line(epoch, it, max_iter, lr, mem))
+                log(recorder.console_line(
+                    epoch, min(it + k - 1, max_iter - 1), max_iter, lr, mem
+                ))
                 recorder.record("train")
+            it += k
         return state, stats
 
     def val(self, state, epoch: int, test_dataset, recorder: Recorder | None = None,

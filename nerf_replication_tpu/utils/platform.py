@@ -183,5 +183,17 @@ def setup_backend(force_platform_name: str | None = None) -> None:
     """
     if force_platform_name:
         force_platform(force_platform_name)
-    else:
+        return
+    try:
         init_backend_with_retry()
+    except RuntimeError as exc:
+        import sys
+
+        print(f"backend init failed: {exc}", file=sys.stderr)
+        sys.stderr.flush()
+        # hard exit: a watchdogged attach thread may be wedged in C++
+        # backend code and would block normal interpreter shutdown —
+        # the stage must die NOW so its outer timeout budget survives.
+        # (bench.py deliberately does NOT route through here: it must
+        # catch the error itself to emit its JSON failure record first.)
+        os._exit(1)

@@ -26,6 +26,8 @@ def main(argv=None):
                    default=[1024, 4096, 16384, 65536])
     p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
     p.add_argument("--remat", nargs="+", default=["false", "true"])
+    p.add_argument("--scan_steps", type=int, nargs="+", default=[1],
+                   help="K optimizer steps per dispatch (lax.scan burst)")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--point_timeout", type=float, default=1200.0)
     p.add_argument("--config", default="lego.yaml",
@@ -47,37 +49,41 @@ def main(argv=None):
             out_f.write(json.dumps(rec) + "\n")
             out_f.flush()
 
-    for n_rays in args.rays:
-        for dtype in args.dtypes:
-            for remat in args.remat:
-                env = dict(
-                    os.environ,
-                    BENCH_N_RAYS=str(n_rays),
-                    BENCH_STEPS=str(args.steps),
-                    BENCH_REMAT=remat,
-                    BENCH_DTYPE=dtype,
-                    BENCH_CONFIG=args.config,
-                )
-                try:
-                    r = subprocess.run(
-                        [sys.executable, os.path.join(_REPO, "bench.py")],
-                        env=env, capture_output=True, text=True,
-                        timeout=args.point_timeout,
-                    )
-                    line = (r.stdout.strip().splitlines() or ["{}"])[-1]
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        rec = {"error": line or r.stderr[-200:]}
-                except subprocess.TimeoutExpired:
-                    # one stuck point (e.g. a long tunnel-recovery wait under
-                    # a big BENCH_INIT_RETRIES budget) must not abort the
-                    # sweep and lose every prior record
-                    rec = {"error": f"point exceeded {args.point_timeout}s"}
-                rec.update(n_rays=n_rays, dtype=dtype, remat=remat == "true",
-                           config=args.config, ts=round(time.time(), 1))
-                print(json.dumps(rec), flush=True)
-                _emit(rec)  # written per point: a crash keeps prior records
+    import itertools
+
+    for n_rays, dtype, remat, scan_k in itertools.product(
+        args.rays, args.dtypes, args.remat, args.scan_steps
+    ):
+        env = dict(
+            os.environ,
+            BENCH_N_RAYS=str(n_rays),
+            BENCH_STEPS=str(args.steps),
+            BENCH_REMAT=remat,
+            BENCH_DTYPE=dtype,
+            BENCH_CONFIG=args.config,
+            BENCH_SCAN_STEPS=str(scan_k),
+        )
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(_REPO, "bench.py")],
+                env=env, capture_output=True, text=True,
+                timeout=args.point_timeout,
+            )
+            line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                rec = {"error": line or r.stderr[-200:]}
+        except subprocess.TimeoutExpired:
+            # one stuck point (e.g. a long tunnel-recovery wait under
+            # a big BENCH_INIT_RETRIES budget) must not abort the
+            # sweep and lose every prior record
+            rec = {"error": f"point exceeded {args.point_timeout}s"}
+        rec.update(n_rays=n_rays, dtype=dtype, remat=remat == "true",
+                   scan_steps=scan_k, config=args.config,
+                   ts=round(time.time(), 1))
+        print(json.dumps(rec), flush=True)
+        _emit(rec)  # written per point: a crash keeps prior records
     if out_f:
         out_f.close()
 

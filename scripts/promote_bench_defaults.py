@@ -10,7 +10,7 @@ known config instead of the conservative 4096-ray default.
 
 Sweep files are append-only (a crash must not destroy prior records), so a
 point may appear many times across runs; only the LAST record per
-(config, n_rays, dtype, remat) key counts — a re-measured point replaces its
+(config, n_rays, dtype, remat, scan_steps) key counts — a re-measured point replaces its
 stale history instead of a stale fast record winning forever. Error records
 are never promoted.
 """
@@ -47,6 +47,7 @@ def main(argv=None):
         "n_rays": int(best["n_rays"]),
         "dtype": best.get("dtype", "bfloat16"),
         "remat": "true" if best.get("remat") else "false",
+        "scan_steps": int(best.get("scan_steps", 1)),
         "config": args.config,
         "measured_rays_per_sec": round(float(best["value"]), 1),
         "source": "scripts/promote_bench_defaults.py",

@@ -19,6 +19,7 @@
 #
 # Stages (each standalone-rerunnable):
 #   1. quality run (35 min, chip)      -> QUALITY.jsonl/md + grid + video
+#   1b. scan-burst sweep @4096         -> BENCH_SWEEP.jsonl (cheap compiles)
 #   2. remat sweep 16k/64k bf16        -> BENCH_SWEEP_REMAT.jsonl
 #      + promote best point            -> BENCH_DEFAULTS.json (bench.py reads)
 #   3. lego_hash sweep                 -> BENCH_SWEEP_HASH.jsonl
@@ -73,6 +74,17 @@ BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 \
 timeout 5400 python scripts/quality_run.py --minutes 35 --H 400 --views 100 \
   --test_views 4 --n_rays 4096 --eval_every_s 120 \
   --scene_root data/quality_scene --target_psnr 21.55 2>&1 | tail -40
+
+log "=== stage 1b: scan-burst sweep on the proven 4096-ray shape ==="
+# K optimizer steps per device dispatch (task_arg.scan_steps, lax.scan)
+# directly attacks the measured latency bound at 4096 rays (16.9% MFU,
+# ~40 sequential small matmuls/step); this shape's compile is known-cheap,
+# so it's the lowest-risk shot at a big headline jump.
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 5400 python scripts/bench_sweep.py \
+  --rays 4096 --dtypes bfloat16 --remat false --scan_steps 8 32 --steps 96 \
+  --point_timeout 1800 --out BENCH_SWEEP.jsonl
+python scripts/promote_bench_defaults.py \
+  BENCH_SWEEP.jsonl BENCH_SWEEP_REMAT.jsonl --config lego.yaml
 
 log "=== stage 2: remat sweep (big-MLP headline) ==="
 # point_timeout must cover a cold 15-20 min remote compile (measured r3);

@@ -303,3 +303,20 @@ def test_setup_backend_forced_platform_skips_the_probe(monkeypatch):
     import jax
 
     assert jax.default_backend() == "cpu"
+
+
+def test_setup_backend_hard_exits_on_init_failure(monkeypatch):
+    """setup_backend must convert a spent init budget into an immediate
+    os._exit(1): a watchdogged attach thread can be wedged in C++ backend
+    code, so normal interpreter shutdown may hang behind it — the stage
+    must die while its outer timeout budget is still intact."""
+    from nerf_replication_tpu.utils import platform as plat
+
+    def fail(*a, **k):
+        raise RuntimeError("backend unavailable after N attempts")
+
+    exits = []
+    monkeypatch.setattr(plat, "init_backend_with_retry", fail)
+    monkeypatch.setattr(os, "_exit", lambda code: exits.append(code))
+    plat.setup_backend(None)
+    assert exits == [1]

@@ -33,14 +33,23 @@ def main(argv=None):
     p.add_argument("--H", type=int, default=400)
     p.add_argument("--views", type=int, default=100)
     p.add_argument("--test_views", type=int, default=4)
-    p.add_argument("--scene_root", default="data/quality_scene")
+    p.add_argument("--scene_root", default=None,
+                   help="default data/quality_scene_h{H} — keyed by "
+                        "resolution because ensure_scene rmtree's a root "
+                        "whose images mismatch, so two concurrent runs at "
+                        "different H sharing a root would destroy each "
+                        "other's scene mid-flight")
     p.add_argument("--target_psnr", type=float, default=21.55,
                    help="reference log.txt final PSNR (475 epochs)")
     p.add_argument("--n_rays", type=int, default=4096)
     p.add_argument("--eval_every_s", type=float, default=120.0)
     p.add_argument("--force_platform", default=os.environ.get(
         "BENCH_FORCE_PLATFORM", ""))
-    p.add_argument("--tag", default="quality")
+    p.add_argument("--tag", default=None,
+                   help="exp_name; default quality_{config-stem}_{H} so "
+                        "concurrent runs get disjoint model/record/result "
+                        "dirs (the recorder WIPES its record dir on a "
+                        "non-resume start)")
     p.add_argument("--config", default="lego.yaml",
                    help="config under configs/nerf/ (e.g. lego_hash.yaml)")
     p.add_argument("--out_prefix", default="QUALITY",
@@ -48,6 +57,15 @@ def main(argv=None):
     p.add_argument("opts", nargs="*", default=[],
                    help="trailing cfg key/value overrides (smoke runs)")
     args = p.parse_args(argv)
+    if args.scene_root is None:
+        # keyed by the FULL scene signature: ensure_scene rmtree's on any
+        # resolution OR view-count mismatch, so every parameter it checks
+        # must be in the key or concurrent runs can still clobber each other
+        args.scene_root = (f"data/quality_scene_h{args.H}"
+                           f"_v{args.views}_t{args.test_views}")
+    if args.tag is None:
+        stem = os.path.splitext(args.config)[0]
+        args.tag = f"quality_{stem}_{args.H}"
 
     from nerf_replication_tpu.utils.platform import (
         enable_compilation_cache,
@@ -135,7 +153,17 @@ def main(argv=None):
     host_step = 0
     crossed_at = None
     trace_path = os.path.join(_REPO, args.out_prefix + ".jsonl")
-    with open(trace_path, "w") as tf:
+    # append, never truncate: a restart with the same prefix must not destroy
+    # the previous run's records (two traces were lost that way already); a
+    # run-header line marks each run's start so restarts stay attributable
+    with open(trace_path, "a") as tf:
+        tf.write(json.dumps({
+            "run_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "config": args.config, "H": args.H, "views": args.views,
+            "n_rays": args.n_rays, "minutes": args.minutes,
+            "device": jax.devices()[0].device_kind,
+        }) + "\n")
+        tf.flush()
         while time.time() - t0 < budget_s:
             # one burst of steps between host syncs
             for _ in range(100):

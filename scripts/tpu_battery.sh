@@ -73,7 +73,7 @@ log "=== stage 1: quality run (chip, 35 min) — the #1 artifact, so it goes fir
 BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 \
 timeout 5400 python scripts/quality_run.py --minutes 35 --H 400 --views 100 \
   --test_views 4 --n_rays 4096 --eval_every_s 120 \
-  --scene_root data/quality_scene --target_psnr 21.55 2>&1 | tail -40
+  --target_psnr 21.55 2>&1 | tail -40
 
 log "=== stage 1b: scan-burst sweep on the proven 4096-ray shape ==="
 # K optimizer steps per device dispatch (task_arg.scan_steps, lax.scan)

@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-3 follow-up battery: the measurements the main battery could not
+# deliver because the tunnel's compile helper dies (HTTP 500) on 64k+-ray
+# graphs. Everything here uses shapes in the proven-compilable class
+# (<= 16384 rays).
+#
+#   1. hash-config training sweep at 4096/16384, scan on/off — the lego_hash
+#      config has no trained-throughput number yet, and the one data point
+#      (651 rays/s from bench_ngp) contradicts the encoder microbench by ~50x
+#   2. profile of the hash step at the same shape — names the guilty op
+#   3. profile of the actual big-MLP headline shape (4096, no remat; the
+#      main battery's stage 5 profiled 65536+remat, which cannot compile)
+#
+# Serialize behind the main battery (monoclient tunnel): run only when no
+# other chip job is alive.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[followup $(date +%H:%M:%S)] $*"; }
+
+# same watch discipline as tpu_battery.sh: the tunnel wedges after killed
+# compiles and recovers on its own; require two consecutive good probes
+WATCH_PROBES=${WATCH_PROBES:-60}
+PROBE_SLEEP=${PROBE_SLEEP:-300}
+probe_once() { timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; }
+if [ "$WATCH_PROBES" -gt 0 ]; then
+  up=0
+  for i in $(seq 1 "$WATCH_PROBES"); do
+    if probe_once; then
+      log "probe $i: UP — confirming"
+      sleep 60
+      if probe_once; then log "probe $i: CONFIRMED up"; up=1; break; fi
+      log "probe $i: flapped back down"
+    else
+      log "probe $i: down"
+    fi
+    sleep "$PROBE_SLEEP"
+  done
+  [ "$up" -eq 1 ] || { log "tunnel never confirmed up; exiting"; exit 1; }
+fi
+
+log "=== f1: lego_hash training sweep (proven-compilable shapes) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 7200 python scripts/bench_sweep.py \
+  --config lego_hash.yaml --rays 4096 16384 --dtypes bfloat16 \
+  --remat false --scan_steps 1 8 --steps 60 \
+  --point_timeout 2400 --out BENCH_SWEEP_HASH.jsonl
+
+log "=== f2: profile the hash step ==="
+mkdir -p data/logs
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 2400 python scripts/profile_step.py \
+  --config lego_hash.yaml --n_rays 4096 --remat false \
+  2>data/logs/profile_hash.err | tee -a PROFILE_STEP.jsonl
+
+log "=== f3: profile the big-MLP headline shape ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 2400 python scripts/profile_step.py \
+  --config lego.yaml --n_rays 4096 --remat false \
+  2>data/logs/profile_headline.err | tee -a PROFILE_STEP.jsonl
+
+log "=== f1c: big-MLP scan sweep at 8192 rays (fits HBM no-remat; untried) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 5400 python scripts/bench_sweep.py \
+  --rays 8192 --dtypes bfloat16 --remat false --scan_steps 8 32 --steps 60 \
+  --point_timeout 2400 --out BENCH_SWEEP.jsonl
+python scripts/promote_bench_defaults.py \
+  BENCH_SWEEP.jsonl BENCH_SWEEP_REMAT.jsonl --config lego.yaml
+
+log "=== f4: scale check 800x800 (battery stage 6 lost to the wedge) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 1800 python scripts/scale_check.py \
+  --H 800 2>data/logs/scale_check.err | tee -a SCALE_CHECK.jsonl
+
+log "=== followup-b done ==="

@@ -117,6 +117,13 @@ def hash_encode(
         log2_hashmap_size,
     )
     d = input_dim
+    # flatten leading batch dims around the gather: renderer batches arrive
+    # [rays, samples, D], and gather/scatter with two batch dims lowers far
+    # worse on TPU than the flat [N, D] shape the encoder microbench runs
+    # (PERF.md round 3: 1.4 G points/s flat vs a ~50x-slower training step)
+    batch_shape = x.shape[:-1]
+    if len(batch_shape) != 1:
+        x = x.reshape(-1, d)
     outs = []
     for lvl in range(num_levels):
         scale = scales[lvl]
@@ -142,7 +149,10 @@ def hash_encode(
             contrib = w[..., None] * vals
             acc = contrib if acc is None else acc + contrib
         outs.append(acc)
-    return jnp.concatenate(outs, axis=-1)
+    out = jnp.concatenate(outs, axis=-1)
+    if len(batch_shape) != 1:
+        out = out.reshape(*batch_shape, out.shape[-1])
+    return out
 
 
 class HashGridEncoder(nn.Module):

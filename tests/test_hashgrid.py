@@ -105,6 +105,32 @@ def test_hash_encode_matches_numpy_oracle(
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+def test_hash_encode_batched_matches_flat():
+    """[rays, samples, D] input must equal the flat [N, D] result reshaped —
+    pins the batch-dims flattening added for the TPU gather lowering
+    (PERF.md round 3): renderer batches arrive 3-D, the fast-path
+    microbench shape is 2-D, and the two must stay numerically identical
+    in both the forward and the table-gradient (scatter-add) direction."""
+    rng = np.random.default_rng(7)
+    offsets, _, _, _ = level_geometry(3, 4, 2.0, 4, 8)
+    table = jnp.asarray(rng.normal(0, 1, (offsets[-1], 2)).astype(np.float32))
+    x = rng.uniform(0, 1, (6, 5, 3)).astype(np.float32)
+
+    batched = hash_encode(jnp.asarray(x), table, 3, 4, 2.0, 4, 8)
+    flat = hash_encode(jnp.asarray(x.reshape(-1, 3)), table, 3, 4, 2.0, 4, 8)
+    assert batched.shape == (6, 5, flat.shape[-1])
+    np.testing.assert_array_equal(np.asarray(batched),
+                                  np.asarray(flat).reshape(6, 5, -1))
+
+    g_b = jax.grad(lambda t: jnp.sum(
+        hash_encode(jnp.asarray(x), t, 3, 4, 2.0, 4, 8) ** 2))(table)
+    g_f = jax.grad(lambda t: jnp.sum(
+        hash_encode(jnp.asarray(x.reshape(-1, 3)), t, 3, 4, 2.0, 4, 8) ** 2
+    ))(table)
+    np.testing.assert_allclose(np.asarray(g_b), np.asarray(g_f),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_level_geometry_static_hash_decision():
     """use_hash must flip exactly where the corner grid stops fitting its
     (8-rounded) table slice — including the floor-rounding edge where

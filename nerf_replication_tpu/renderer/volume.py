@@ -399,7 +399,11 @@ class Renderer:
         # per-frame-varying bounds from growing the executable cache
         # without bound
         near, far = float(batch["near"]), float(batch["far"])
-        cache_key = (n_chunks, chunk, near, far)
+        # march_options is in the key (frozen dataclass, hashable) so a
+        # caller adjusting the budget between renders — e.g. the offline
+        # video stage doubling max_samples — can never hit a stale
+        # executable built under the old options
+        cache_key = (n_chunks, chunk, near, far, self.march_options)
         fn = self._march_fns.get(cache_key)
         if fn is None:
             network = self.network

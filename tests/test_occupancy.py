@@ -257,8 +257,11 @@ def test_march_executable_cache_is_bounded(tmp_path, setup):
             params, {"rays": rays, "near": near, "far": 6.0}
         )
         assert len(renderer._march_fns) <= cap
-    # most recent entry is retained (LRU, not clear-on-full)
-    assert (1, 8, 2.0 + 0.01 * (cap + 3), 6.0) in renderer._march_fns
+    # most recent entry is retained (LRU, not clear-on-full); the key
+    # carries march_options so budget changes can't hit stale executables
+    assert (
+        1, 8, 2.0 + 0.01 * (cap + 3), 6.0, renderer.march_options
+    ) in renderer._march_fns
 
 
 @pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device CPU mesh")

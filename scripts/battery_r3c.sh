@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-3 battery, stage C: runs after battery_followup_r3b.sh releases the
+# tunnel (monoclient — wait on its pid or for no live chip job).
+#
+#   c1. NGP-vs-std training bench — informative now that the hash-encode
+#       batch-flattening fix is in (the 651 rays/s anomaly was measured
+#       before it); 300 s/arm gives the ngp arm time to carve the grid.
+#   c2. Quality run at 800×800 (VERDICT r2 #8: real-scale PSNR + both
+#       render paths on chip). 50 views bounds the bank at 1.15 GiB.
+#   c3. Promote whatever the sweeps found into BENCH_DEFAULTS.json so the
+#       driver's round-end bench.py runs the best measured shape.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[batteryC $(date +%H:%M:%S)] $*"; }
+
+WAIT_PID=${WAIT_PID:-}
+if [ -n "$WAIT_PID" ]; then
+  log "waiting for battery pid $WAIT_PID to release the tunnel"
+  while kill -0 "$WAIT_PID" 2>/dev/null; do sleep 60; done
+  log "pid $WAIT_PID gone; waiting 120 s for the tunnel to settle"
+  sleep 120
+fi
+
+log "=== c0: trisect the hash-step anomaly (names the guilty component) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 5400 python scripts/bench_hash_step.py \
+  --n_rays 4096 --steps 10 | tee -a BENCH_HASH_STEP.jsonl
+
+log "=== c1: NGP-vs-std with the hash-step fix ==="
+# bench_ngp appends its own records to BENCH_NGP.jsonl; don't tee a copy
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 3600 python scripts/bench_ngp.py \
+  --seconds 300 --H 200 --views 60 --n_rays 4096
+
+log "=== c2: quality at 800x800 (real-scale PSNR on chip) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 5400 python scripts/quality_run.py \
+  --minutes 45 --H 800 --views 50 --test_views 2 --n_rays 4096 \
+  --eval_every_s 240 --out_prefix QUALITY_800
+
+log "=== c3: promote best measured defaults ==="
+python scripts/promote_bench_defaults.py \
+  BENCH_SWEEP.jsonl BENCH_SWEEP_REMAT.jsonl BENCH_SWEEP_HASH.jsonl \
+  --config lego.yaml || true
+
+log "=== battery C done ==="

@@ -1,0 +1,213 @@
+"""Trisect the hash-config training-step anomaly on chip.
+
+PERF.md round 3: `lego_hash` trains at ~400-650 rays/s while its encoder
+microbench (scripts/bench_hash.py) does 1.4 G points/s fwd+bwd and the
+big-MLP step does 48k rays/s — the step is ~50x slower than its parts
+explain, and the batch-flattening fix did not close the gap. This script
+times each third of the step as its own executable at EXACT training shapes:
+
+    enc_coarse / enc_fine : hash_encode fwd+bwd (grad wrt table)
+    lossgrad              : full render + MSE value_and_grad (no optimizer)
+    lossgrad_freq         : same rays, frequency encoder + same-size MLP
+                            (control: isolates the encoder from the renderer)
+    opt_apply             : apply_gradients alone on precomputed grads
+    full_step             : the trainer's fused step
+
+The third that holds the missing seconds names the guilty component.
+
+    python scripts/bench_hash_step.py [--n_rays 4096] [--steps 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _timed(fn, args, steps, warmup=2):
+    import jax
+
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--n_rays", type=int, default=4096)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--config", default="lego_hash.yaml")
+    p.add_argument("--force_platform", default=os.environ.get(
+        "BENCH_FORCE_PLATFORM", ""))
+    args = p.parse_args(argv)
+
+    from nerf_replication_tpu.utils.platform import (
+        enable_compilation_cache,
+        setup_backend,
+    )
+
+    setup_backend(args.force_platform)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from nerf_replication_tpu.config import make_cfg
+    from nerf_replication_tpu.models.nerf.network import make_network
+    from nerf_replication_tpu.train.loss import make_loss
+    from nerf_replication_tpu.train.trainer import Trainer, make_train_state
+
+    def emit(stage, dt, extra=None):
+        rec = {"stage": stage, "s_per_call": round(dt, 5),
+               "n_rays": args.n_rays, "config": args.config}
+        if extra:
+            rec.update(extra)
+        print(json.dumps(rec), flush=True)
+
+    base_opts = [
+        "task_arg.N_rays", str(args.n_rays),
+        "task_arg.precrop_iters", "0",
+    ]
+    cfg = make_cfg(
+        os.path.join(_REPO, "configs", "nerf", args.config), base_opts
+    )
+    n_coarse = int(cfg.task_arg.N_samples)
+    n_fine = n_coarse + int(cfg.task_arg.N_importance)
+
+    # --- encoder alone at training scale (grad wrt table) ---------------
+    enc_cfg = cfg.network.xyz_encoder
+    if enc_cfg.type == "hashgrid":
+        from nerf_replication_tpu.models.encoding.hashgrid import (
+            hash_encode,
+            level_geometry,
+        )
+
+        input_dim = int(enc_cfg.input_dim)
+        num_levels = int(enc_cfg.num_levels)
+        base_res = int(enc_cfg.base_resolution)
+        log2_t = int(enc_cfg.log2_hashmap_size)
+        # same derivation as HashGridEncoder.scale_factor (hashgrid.py:173-183),
+        # incl. the desired_resolution==-1 fallback to per_level_scale
+        desired = int(enc_cfg.get("desired_resolution", -1))
+        if desired != -1:
+            pls = 2.0 ** (math.log2(desired / base_res) / (num_levels - 1))
+        else:
+            pls = float(enc_cfg.get("per_level_scale", 2.0))
+        offsets, _, _, _ = level_geometry(
+            input_dim, num_levels, pls, base_res, log2_t
+        )
+        table = jax.random.uniform(
+            jax.random.PRNGKey(0),
+            (int(offsets[-1]), int(enc_cfg.level_dim)), jnp.float32,
+            -1e-4, 1e-4,
+        )
+
+        def enc_loss(x, tab):
+            out = hash_encode(
+                x, tab, input_dim, num_levels, pls, base_res, log2_t
+            )
+            return jnp.sum(out * out)
+
+        enc_bwd = jax.jit(jax.grad(enc_loss, argnums=1))
+        for name, n_pts in (("enc_coarse", args.n_rays * n_coarse),
+                            ("enc_fine", args.n_rays * n_fine)):
+            x = jax.random.uniform(jax.random.PRNGKey(1), (n_pts, 3))
+            dt = _timed(enc_bwd, (x, table), args.steps)
+            emit(name, dt, {"n_pts": n_pts,
+                            "gpts_per_s": round(n_pts / dt / 1e9, 3)})
+
+    # --- full loss value_and_grad (no optimizer) -------------------------
+    network = make_network(cfg)
+    loss = make_loss(cfg, network)
+    state, _ = make_train_state(cfg, network, jax.random.PRNGKey(2))
+    near, far = float(cfg.task_arg.near), float(cfg.task_arg.far)
+    kb = jax.random.PRNGKey(3)
+    rays_o = jax.random.normal(kb, (args.n_rays, 3)) * 0.1
+    rays_d = jax.random.normal(jax.random.fold_in(kb, 1), (args.n_rays, 3))
+    rays_d = rays_d / jnp.linalg.norm(rays_d, axis=-1, keepdims=True)
+    batch = {
+        "rays": jnp.concatenate([rays_o, rays_d], -1),
+        "rgbs": jnp.full((args.n_rays, 3), 0.5, jnp.float32),
+        "near": near, "far": far,
+    }
+
+    def make_lossgrad(loss_obj):
+        def lossgrad(params, batch, key):
+            def f(p):
+                _, l, stats = loss_obj(
+                    {"params": p}, batch, key=key, train=True
+                )
+                return l, stats
+
+            (_, stats), grads = jax.value_and_grad(f, has_aux=True)(params)
+            return grads, stats
+
+        return jax.jit(lossgrad)
+
+    lg = make_lossgrad(loss)
+    grads, _ = lg(state.params, batch, jax.random.PRNGKey(4))
+    jax.block_until_ready(grads)
+    dt = _timed(lg, (state.params, batch, jax.random.PRNGKey(4)), args.steps)
+    emit("lossgrad", dt, {"rays_per_s": round(args.n_rays / dt, 1)})
+
+    # --- optimizer alone --------------------------------------------------
+    opt = jax.jit(lambda s, g: s.apply_gradients(grads=g))
+    dt = _timed(opt, (state, grads), args.steps)
+    emit("opt_apply", dt)
+
+    # --- the fused step ---------------------------------------------------
+    trainer = Trainer(cfg, network, loss)
+    step_fn = trainer._build_step(with_pool=False)
+    n_bank = 1 << 18
+    bo = jax.random.normal(jax.random.PRNGKey(5), (n_bank, 3)) * 0.1
+    bd = jax.random.normal(jax.random.PRNGKey(6), (n_bank, 3))
+    bd = bd / jnp.linalg.norm(bd, axis=-1, keepdims=True)
+    bank_rays = jnp.concatenate([bo, bd], -1).astype(jnp.float32)
+    bank_rgbs = jnp.full((n_bank, 3), 0.5, jnp.float32)
+    state2, _ = make_train_state(cfg, network, jax.random.PRNGKey(7))
+    # step_fn donates its state argument — thread it instead of reusing
+    s2, stats = step_fn(state2, bank_rays, bank_rgbs, jax.random.PRNGKey(8))
+    s2, stats = step_fn(s2, bank_rays, bank_rgbs, jax.random.PRNGKey(8))
+    jax.block_until_ready(stats)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        s2, stats = step_fn(s2, bank_rays, bank_rgbs, jax.random.PRNGKey(8))
+    jax.block_until_ready(stats)
+    dt = (time.perf_counter() - t0) / args.steps
+    emit("full_step", dt, {"rays_per_s": round(args.n_rays / dt, 1)})
+
+    # --- control: same trunk, frequency encoder ---------------------------
+    ctl_cfg = make_cfg(
+        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        base_opts + [
+            "network.nerf.W", str(int(cfg.network.nerf.W)),
+            "network.nerf.D", str(int(cfg.network.nerf.D)),
+            "network.nerf.skips", str(list(cfg.network.nerf.skips)),
+        ],
+    )
+    network_c = make_network(ctl_cfg)
+    loss_c = make_loss(ctl_cfg, network_c)
+    state_c, _ = make_train_state(ctl_cfg, network_c, jax.random.PRNGKey(9))
+    lgc = make_lossgrad(loss_c)
+    g2, _ = lgc(state_c.params, batch, jax.random.PRNGKey(10))
+    jax.block_until_ready(g2)
+    dt = _timed(lgc, (state_c.params, batch, jax.random.PRNGKey(10)),
+                args.steps)
+    emit("lossgrad_freq", dt, {"rays_per_s": round(args.n_rays / dt, 1)})
+
+
+if __name__ == "__main__":
+    main()

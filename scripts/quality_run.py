@@ -24,6 +24,9 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# BASELINE.md north star: >=30 dB on lego-class scenes
+NORTH_STAR_DB = 30.0
 sys.path.insert(0, _REPO)
 
 
@@ -278,15 +281,29 @@ def main(argv=None):
             f"Best PSNR {best.get('psnr', 0):.2f} dB at t={best['t_s']} s; "
             f"did not cross {args.target_psnr} dB in budget."
         )
+    # state the BASELINE.md north star (≥30 dB) outcome explicitly either
+    # way, not just when it is missed (VERDICT r2 weak #7)
+    north = next((r for r in trace if r.get("psnr", 0) >= NORTH_STAR_DB), None)
+    if north:
+        lines.append(
+            f"\n**North star (BASELINE.md ≥{NORTH_STAR_DB:g} dB): crossed at "
+            f"t={north['t_s']} s (step {north['step']}).**"
+        )
+    elif best:
+        lines.append(
+            f"\nNorth star (BASELINE.md ≥{NORTH_STAR_DB:g} dB): NOT reached — best "
+            f"{best.get('psnr', 0):.2f} dB; gap "
+            f"{NORTH_STAR_DB - best.get('psnr', 0):.2f} dB."
+        )
     if len(trace) >= 2 and best and best.get("psnr", 0) > 0:
         # crude wall-clock-to-30dB estimate from the tail slope
         a, b = trace[-2], trace[-1]
         dpsnr = b.get("psnr", 0) - a.get("psnr", 0)
         if dpsnr > 1e-3:
-            eta = (30.0 - b["psnr"]) * (b["t_s"] - a["t_s"]) / dpsnr
+            eta = (NORTH_STAR_DB - b["psnr"]) * (b["t_s"] - a["t_s"]) / dpsnr
             lines.append(
                 f"\nTail slope {dpsnr:.2f} dB / {b['t_s'] - a['t_s']:.0f} s "
-                f"⇒ naive wall-clock-to-30 dB ≈ {b['t_s'] + max(eta, 0):.0f} s "
+                f"⇒ naive wall-clock-to-north-star ≈ {b['t_s'] + max(eta, 0):.0f} s "
                 "(log-shaped convergence makes this a lower bound)."
             )
     with open(os.path.join(_REPO, args.out_prefix + ".md"), "w") as f:

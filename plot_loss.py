@@ -7,6 +7,7 @@ Our recorder emits the same line format, so this works on either framework's
 logs.
 
     python plot_loss.py --log_file data/record/train.log --out curves.png
+    python plot_loss.py --log_file QUALITY.jsonl --out curves.png
 """
 
 from __future__ import annotations
@@ -43,6 +44,33 @@ def parse_log_file(path: str):
                         "ssim": float(sm.group(1)) if sm else None,
                     }
                 )
+    return train, val
+
+
+def parse_quality_jsonl(path: str):
+    """Returns (train_rows, val_rows) from a QUALITY*.jsonl trace
+    (scripts/quality_run.py): each eval record carries step/loss/psnr/ssim;
+    run-header lines (no ``step``) are skipped."""
+    import json
+
+    train, val = [], []
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if "step" not in r:
+                continue
+            if "loss" in r:
+                train.append(
+                    {"step": int(r["step"]), "loss": float(r["loss"])}
+                )
+            val.append({"step": int(r["step"]), "psnr": r.get("psnr"),
+                        "ssim": r.get("ssim")})
     return train, val
 
 
@@ -85,10 +113,14 @@ def plot_metrics(train, val, out_path: str):
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--log_file", required=True)
+    parser.add_argument("--log_file", required=True,
+                        help="console log, or a QUALITY*.jsonl trace")
     parser.add_argument("--out", default="curves.png")
     args = parser.parse_args()
-    train, val = parse_log_file(args.log_file)
+    if args.log_file.endswith(".jsonl"):
+        train, val = parse_quality_jsonl(args.log_file)
+    else:
+        train, val = parse_log_file(args.log_file)
     print(f"parsed {len(train)} train lines, {len(val)} val samples")
     out = plot_metrics(train, val, args.out)
     print(f"figure saved to {out}")

@@ -96,3 +96,22 @@ def test_render_video_end_to_end(tmp_path):
 
     out_path = rv.render_360_video(cfg, args=None)
     assert os.path.exists(out_path) and os.path.getsize(out_path) > 0
+
+
+def test_plot_loss_parses_quality_jsonl(tmp_path):
+    import json
+
+    trace = tmp_path / "QUALITY_T.jsonl"
+    rows = [
+        {"run_start": "2026-07-31T00:00:00", "config": "lego.yaml"},
+        {"t_s": 10.0, "step": 100, "loss": 0.5, "psnr": 20.0, "ssim": 0.8},
+        {"t_s": 20.0, "step": 200, "loss": 0.25, "psnr": 25.0, "ssim": 0.9},
+    ]
+    trace.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    train, val = plot_loss.parse_quality_jsonl(str(trace))
+    assert [r["step"] for r in train] == [100, 200]
+    assert train[1]["loss"] == 0.25
+    assert val[1]["psnr"] == 25.0
+    out = tmp_path / "q.png"
+    plot_loss.plot_metrics(train, val, str(out))
+    assert out.exists() and out.stat().st_size > 0

@@ -6,12 +6,19 @@ big-MLP step does 48k rays/s — the step is ~50x slower than its parts
 explain, and the batch-flattening fix did not close the gap. This script
 times each third of the step as its own executable at EXACT training shapes:
 
-    enc_coarse / enc_fine : hash_encode fwd+bwd (grad wrt table)
-    lossgrad              : full render + MSE value_and_grad (no optimizer)
-    lossgrad_freq         : same rays, frequency encoder + same-size MLP
-                            (control: isolates the encoder from the renderer)
-    opt_apply             : apply_gradients alone on precomputed grads
-    full_step             : the trainer's fused step
+    enc_coarse / enc_fine   : hash_encode fwd+bwd (grad wrt table)
+    enc1_coarse / enc1_fine : candidate reformulation — ALL levels+corners
+                              through ONE gather (one scatter in the VJP
+                              instead of L*2^D); parity-checked first
+    lossgrad                : full render + MSE value_and_grad (no optimizer)
+    lossgrad_frozen_table   : lossgrad with the table excluded from
+                              differentiation (scatter-VJP discriminator)
+    lossgrad_onegather      : lossgrad with the one-gather encoder patched
+                              into the network (fix candidate in context)
+    lossgrad_freq           : same rays, frequency encoder + same-size MLP
+                              (control: isolates the encoder entirely)
+    opt_apply               : apply_gradients alone on precomputed grads
+    full_step               : the trainer's fused step
 
 The third that holds the missing seconds names the guilty component.
 
@@ -26,6 +33,8 @@ import math
 import os
 import sys
 import time
+
+import numpy as np
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -106,7 +115,7 @@ def main(argv=None):
             pls = 2.0 ** (math.log2(desired / base_res) / (num_levels - 1))
         else:
             pls = float(enc_cfg.get("per_level_scale", 2.0))
-        offsets, _, _, _ = level_geometry(
+        offsets, scales, resolutions, use_hash = level_geometry(
             input_dim, num_levels, pls, base_res, log2_t
         )
         table = jax.random.uniform(
@@ -126,6 +135,66 @@ def main(argv=None):
                             ("enc_fine", args.n_rays * n_fine)):
             x = jax.random.uniform(jax.random.PRNGKey(1), (n_pts, 3))
             dt = _timed(enc_bwd, (x, table), args.steps)
+            emit(name, dt, {"n_pts": n_pts,
+                            "gpts_per_s": round(n_pts / dt / 1e9, 3)})
+
+        # candidate reformulation: ALL levels x corners through ONE gather,
+        # so autodiff emits ONE scatter-add instead of L*2^D of them — if
+        # per-scatter-op overhead is what the training step is paying, this
+        # variant wins and becomes the production formulation
+        from nerf_replication_tpu.models.encoding.hashgrid import (
+            _corner_index,
+        )
+
+        def hash_encode_onegather(x, tab):
+            idx_cols, w_cols = [], []
+            for lvl in range(num_levels):
+                pos = x * scales[lvl] + 0.5
+                pos_grid = jnp.floor(pos)
+                frac = pos - pos_grid
+                pos_grid = pos_grid.astype(jnp.int32)
+                for corner_bits in range(1 << input_dim):
+                    sel = [(corner_bits >> dd) & 1
+                           for dd in range(input_dim)]
+                    corner = pos_grid + jnp.asarray(sel, jnp.int32)
+                    w = jnp.ones(x.shape[:-1], x.dtype)
+                    for dd in range(input_dim):
+                        w = w * (frac[..., dd] if sel[dd]
+                                 else 1.0 - frac[..., dd])
+                    idx = _corner_index(
+                        corner, resolutions[lvl],
+                        offsets[lvl + 1] - offsets[lvl], use_hash[lvl],
+                    )
+                    idx_cols.append(idx + offsets[lvl])
+                    w_cols.append(w)
+            idx = jnp.stack(idx_cols, axis=-1)  # [N, L*2^D]
+            w = jnp.stack(w_cols, axis=-1)
+            vals = jnp.take(tab, idx, axis=0)   # ONE gather
+            n, c = x.shape[0], tab.shape[-1]
+            out = (w[..., None] * vals).reshape(
+                n, num_levels, 1 << input_dim, c
+            ).sum(axis=2)
+            return out.reshape(n, num_levels * c)
+
+        def enc1_loss(x, tab):
+            out = hash_encode_onegather(x, tab)
+            return jnp.sum(out * out)
+
+        enc1_bwd = jax.jit(jax.grad(enc1_loss, argnums=1))
+        for name, n_pts in (("enc1_coarse", args.n_rays * n_coarse),
+                            ("enc1_fine", args.n_rays * n_fine)):
+            x = jax.random.uniform(jax.random.PRNGKey(1), (n_pts, 3))
+            # parity vs the production formulation before timing it
+            if n_pts == args.n_rays * n_coarse:
+                ref = hash_encode(
+                    x[:256], table, input_dim, num_levels, pls, base_res,
+                    log2_t,
+                )
+                alt = hash_encode_onegather(x[:256], table)
+                np.testing.assert_allclose(
+                    np.asarray(ref), np.asarray(alt), rtol=1e-5, atol=1e-7
+                )
+            dt = _timed(enc1_bwd, (x, table), args.steps)
             emit(name, dt, {"n_pts": n_pts,
                             "gpts_per_s": round(n_pts / dt / 1e9, 3)})
 
@@ -162,6 +231,78 @@ def main(argv=None):
     jax.block_until_ready(grads)
     dt = _timed(lg, (state.params, batch, jax.random.PRNGKey(4)), args.steps)
     emit("lossgrad", dt, {"rays_per_s": round(args.n_rays / dt, 1)})
+
+    # --- lossgrad with the hash table FROZEN (scatter-VJP discriminator):
+    # differentiate only the non-embedding params; the table rides along as
+    # a closed-over constant. Fast here + slow above convicts the table-
+    # gradient scatter; slow here too exonerates it.
+    frozen, trainable = {}, {}
+    if "xyz_encoder" in state.params:
+        from flax.traverse_util import flatten_dict, unflatten_dict
+
+        flat = flatten_dict(state.params)
+        frozen = {k: v for k, v in flat.items() if "embeddings" in k}
+        trainable = {k: v for k, v in flat.items() if "embeddings" not in k}
+        if not frozen:
+            # non-hashgrid encoders name their params differently; an
+            # empty frozen set would silently time the same computation as
+            # lossgrad — skip the stage (loudly), keep the rest running
+            print(json.dumps({"stage": "lossgrad_frozen_table",
+                              "skipped": "no 'embeddings' param"}),
+                  flush=True)
+    if frozen:
+
+        def lossgrad_frozen(tr, fr, batch, key):
+            # fr enters as an argument (not a closure) so the 100 MB table
+            # isn't baked into the executable as a constant
+            def f(tr_):
+                p = unflatten_dict({**fr, **tr_})
+                _, l, stats = loss({"params": p}, batch, key=key, train=True)
+                return l, stats
+
+            (_, stats), g = jax.value_and_grad(f, has_aux=True)(tr)
+            return g, stats
+
+        lgf = jax.jit(lossgrad_frozen)
+        g3, _ = lgf(trainable, frozen, batch, jax.random.PRNGKey(4))
+        jax.block_until_ready(g3)
+        dt = _timed(lgf, (trainable, frozen, batch, jax.random.PRNGKey(4)),
+                    args.steps)
+        emit("lossgrad_frozen_table", dt,
+             {"rays_per_s": round(args.n_rays / dt, 1)})
+
+    # --- full loss with the ONE-GATHER encoder in context -----------------
+    # hashgrid.HashGridEncoder resolves hash_encode through its module
+    # global at call time, so patching it swaps the formulation for a
+    # freshly built network without touching production code
+    if enc_cfg.type == "hashgrid":
+        import nerf_replication_tpu.models.encoding.hashgrid as hg_mod
+
+        orig_encode = hg_mod.hash_encode
+
+        def patched(x, tab, input_dim_, num_levels_, pls_, base_res_,
+                    log2_t_):
+            batch_shape = x.shape[:-1]
+            if len(batch_shape) != 1:
+                x = x.reshape(-1, x.shape[-1])
+            out = hash_encode_onegather(x, tab)
+            if len(batch_shape) != 1:
+                out = out.reshape(*batch_shape, out.shape[-1])
+            return out
+
+        hg_mod.hash_encode = patched
+        try:
+            network_1g = make_network(cfg)
+            loss_1g = make_loss(cfg, network_1g)
+            lg1 = make_lossgrad(loss_1g)
+            g1g, _ = lg1(state.params, batch, jax.random.PRNGKey(4))
+            jax.block_until_ready(g1g)
+            dt = _timed(lg1, (state.params, batch, jax.random.PRNGKey(4)),
+                        args.steps)
+            emit("lossgrad_onegather", dt,
+                 {"rays_per_s": round(args.n_rays / dt, 1)})
+        finally:
+            hg_mod.hash_encode = orig_encode
 
     # --- optimizer alone --------------------------------------------------
     opt = jax.jit(lambda s, g: s.apply_gradients(grads=g))

@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-3 battery, stage D: reference-style eval timing at real scale
+# (SURVEY.md §3.2 / VERDICT r2 #8's fps half). Requires battery C's c2 to
+# have trained+saved the 800x800 quality checkpoint and baked its grid.
+# Runs `run.py --type evaluate` on chip — per-image net_time + fps with the
+# first image excluded (ref run.py:73-87) — through BOTH render paths.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[batteryD $(date +%H:%M:%S)] $*"; }
+
+WAIT_PID=${WAIT_PID:-}
+if [ -n "$WAIT_PID" ]; then
+  log "waiting for battery pid $WAIT_PID to release the tunnel"
+  while kill -0 "$WAIT_PID" 2>/dev/null; do sleep 60; done
+  log "pid $WAIT_PID gone; waiting 120 s for the tunnel to settle"
+  sleep 120
+fi
+
+H=${H:-800}
+TAG="quality_lego_${H}"
+MODEL_DIR="data/trained_model/nerf/procedural/${TAG}"
+SCENE="data/quality_scene_h${H}_v50_t2"
+if [ ! -d "$MODEL_DIR" ]; then
+  log "no checkpoint at $MODEL_DIR (c2 did not finish?); skipping"
+  exit 0
+fi
+
+# array, not a string: unquoted [0,-1,1] in a flat string is a glob that
+# could match a single-char filename and silently corrupt the override
+OPTS=(scene procedural exp_name "${TAG}"
+  train_dataset.data_root "${SCENE}" test_dataset.data_root "${SCENE}"
+  train_dataset.H "${H}" train_dataset.W "${H}"
+  test_dataset.H "${H}" test_dataset.W "${H}"
+  test_dataset.cams "[0,-1,1]")
+
+log "=== d1: evaluate 800x800, vanilla chunked renderer ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 2400 python run.py \
+  --type evaluate --cfg_file configs/nerf/lego.yaml "${OPTS[@]}" \
+  task_arg.accelerated_renderer false 2>&1 | tail -15
+
+# the accelerated path loads logs/<cfg>/occupancy_grid.npz (reference
+# artifact layout); c2's bake lands next to the checkpoint. Fail d2 loudly
+# when the grid is missing — run.py would silently fall back to the
+# vanilla path and the d1-vs-d2 comparison would be meaningless.
+if [ ! -f "${MODEL_DIR}/occupancy_grid.npz" ]; then
+  log "d2 SKIPPED: no baked grid at ${MODEL_DIR}/occupancy_grid.npz"
+  exit 0
+fi
+mkdir -p logs/lego
+cp "${MODEL_DIR}/occupancy_grid.npz" logs/lego/occupancy_grid.npz
+
+log "=== d2: evaluate 800x800, occupancy-accelerated marcher ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 2400 python run.py \
+  --type evaluate --cfg_file configs/nerf/lego.yaml "${OPTS[@]}" \
+  task_arg.accelerated_renderer true 2>&1 | tail -15
+
+log "=== battery D done ==="

@@ -10,6 +10,10 @@ times each third of the step as its own executable at EXACT training shapes:
     enc1_coarse / enc1_fine : candidate reformulation — ALL levels+corners
                               through ONE gather (one scatter in the VJP
                               instead of L*2^D); parity-checked first
+    enc2_coarse / enc2_fine : candidate reformulation — per-LEVEL table
+                              slices as separate grad leaves (scatters hit
+                              small per-level operands, not the 12.4M-row
+                              concatenation); parity-checked first
     lossgrad                : full render + MSE value_and_grad (no optimizer)
     lossgrad_frozen_table   : lossgrad with the table excluded from
                               differentiation (scatter-VJP discriminator)
@@ -175,6 +179,63 @@ def main(argv=None):
                 n, num_levels, 1 << input_dim, c
             ).sum(axis=2)
             return out.reshape(n, num_levels * c)
+
+        # second candidate: per-LEVEL table slices as separate grad leaves.
+        # f2's cost analysis shows ~24.7 TB/step of modeled traffic — if the
+        # scatter lowering charges (a multiple of) the full operand, 16
+        # small per-level operands instead of one concatenated [12.4M, 2]
+        # table cut the traffic ~25x without changing the math
+        tables = [
+            table[int(offsets[lvl]):int(offsets[lvl + 1])]
+            for lvl in range(num_levels)
+        ]
+
+        def hash_encode_perlevel(x, tabs):
+            outs = []
+            for lvl in range(num_levels):
+                pos = x * scales[lvl] + 0.5
+                pos_grid = jnp.floor(pos)
+                frac = pos - pos_grid
+                pos_grid = pos_grid.astype(jnp.int32)
+                acc = None
+                for corner_bits in range(1 << input_dim):
+                    sel = [(corner_bits >> dd) & 1
+                           for dd in range(input_dim)]
+                    corner = pos_grid + jnp.asarray(sel, jnp.int32)
+                    w = jnp.ones(x.shape[:-1], x.dtype)
+                    for dd in range(input_dim):
+                        w = w * (frac[..., dd] if sel[dd]
+                                 else 1.0 - frac[..., dd])
+                    idx = _corner_index(
+                        corner, resolutions[lvl],
+                        offsets[lvl + 1] - offsets[lvl], use_hash[lvl],
+                    )
+                    vals = jnp.take(tabs[lvl], idx, axis=0)
+                    contrib = w[..., None] * vals
+                    acc = contrib if acc is None else acc + contrib
+                outs.append(acc)
+            return jnp.concatenate(outs, axis=-1)
+
+        def enc2_loss(x, tabs):
+            out = hash_encode_perlevel(x, tabs)
+            return jnp.sum(out * out)
+
+        enc2_bwd = jax.jit(jax.grad(enc2_loss, argnums=1))
+        for name, n_pts in (("enc2_coarse", args.n_rays * n_coarse),
+                            ("enc2_fine", args.n_rays * n_fine)):
+            x = jax.random.uniform(jax.random.PRNGKey(1), (n_pts, 3))
+            if n_pts == args.n_rays * n_coarse:
+                ref = hash_encode(
+                    x[:256], table, input_dim, num_levels, pls, base_res,
+                    log2_t,
+                )
+                alt = hash_encode_perlevel(x[:256], tables)
+                np.testing.assert_allclose(
+                    np.asarray(ref), np.asarray(alt), rtol=1e-5, atol=1e-7
+                )
+            dt = _timed(enc2_bwd, (x, tables), args.steps)
+            emit(name, dt, {"n_pts": n_pts,
+                            "gpts_per_s": round(n_pts / dt / 1e9, 3)})
 
         def enc1_loss(x, tab):
             out = hash_encode_onegather(x, tab)

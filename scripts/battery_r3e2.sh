@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-3 battery, stage E: byte-reduction probes for the HBM-bound
+# flagship step (f3: 48.2 GB/step, 557 GB/s achieved = 68% of peak, more
+# rays flat). Remat trades saved-activation traffic for recompute FLOPs —
+# exactly the right trade for a bandwidth-bound step with 71 FLOPs/byte —
+# but was only ever measured at 16k rays. Measure it at the headline shape.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[batteryE $(date +%H:%M:%S)] $*"; }
+
+WAIT_PID=${WAIT_PID:-}
+if [ -n "$WAIT_PID" ]; then
+  log "waiting for battery pid $WAIT_PID to release the tunnel"
+  while kill -0 "$WAIT_PID" 2>/dev/null; do sleep 60; done
+  log "pid $WAIT_PID gone; waiting 120 s for the tunnel to settle"
+  sleep 120
+fi
+
+log "=== e1: remat at the headline shape (4096/8192, scan burst) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 7200 python scripts/bench_sweep.py \
+  --rays 4096 8192 --dtypes bfloat16 --remat true --scan_steps 32 --steps 60 \
+  --point_timeout 2400 --out BENCH_SWEEP_REMAT.jsonl
+
+log "=== e2: profile the remat step (bytes/step vs the 48.2 GB no-remat) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 2400 python scripts/profile_step.py \
+  --config lego.yaml --n_rays 4096 --remat true \
+  2>data/logs/profile_remat.err | tee -a PROFILE_STEP.jsonl
+
+log "=== e3: promote whatever won ==="
+python scripts/promote_bench_defaults.py \
+  BENCH_SWEEP.jsonl BENCH_SWEEP_REMAT.jsonl --config lego.yaml || true
+
+log "=== battery E done ==="

@@ -81,6 +81,7 @@ def main(argv=None):
     bytes_hbm = float(ca.get("bytes accessed", 0.0))
     mem = compiled.memory_analysis()
     rec = {
+        "config": args.config, "ts": round(time.time(), 1),
         "n_rays": args.n_rays, "dtype": args.dtype, "remat": args.remat,
         "xla_flops_per_step": flops,
         "xla_gbytes_per_step": round(bytes_hbm / 2**30, 3),
@@ -107,6 +108,7 @@ def main(argv=None):
     dt = (time.perf_counter() - t0) / args.steps
     peak_bf16 = 197e12  # TPU v5 lite bf16 peak (PERF.md)
     print(json.dumps({
+        "config": args.config, "ts": round(time.time(), 1),
         "s_per_step": round(dt, 4),
         "rays_per_sec": round(args.n_rays / dt, 1),
         "mfu_vs_xla_flops": round(flops / dt / peak_bf16, 3) if flops else None,

@@ -84,6 +84,9 @@ def main():
             # for the latency-bound small-batch regime (PERF.md)
             "task_arg.scan_steps",
             os.environ.get("BENCH_SCAN_STEPS", str(defaults.get("scan_steps", 1))),
+            # space-separated trailing cfg overrides, e.g.
+            # BENCH_OPTS="network.xyz_encoder.custom_bwd true"
+            *os.environ.get("BENCH_OPTS", "").split(),
         ],
     )
     network = make_network(cfg)
@@ -164,6 +167,11 @@ def main():
                 "peak_flops": peak,
                 "n_rays": n_rays,
                 "scan_steps": scan_k,
+                **(
+                    {"opts": os.environ["BENCH_OPTS"]}
+                    if os.environ.get("BENCH_OPTS")
+                    else {}
+                ),
             }
         )
     )

@@ -155,6 +155,95 @@ def hash_encode(
     return out
 
 
+def _encode_with_per_level_bwd(
+    x: jax.Array,
+    table: jax.Array,
+    input_dim: int,
+    num_levels: int,
+    per_level_scale: float,
+    base_resolution: int,
+    log2_hashmap_size: int,
+) -> jax.Array:
+    """hash_encode with a hand-written backward that scatters per level.
+
+    Autodiff of ``hash_encode`` emits the table cotangent as L·2^D
+    scatter-adds against the ONE concatenated [total_entries, C] operand;
+    XLA's TPU lowering of those turns the train step into a modeled
+    24.7 TB/step memory-traffic program (PERF.md round 3, f2 cost
+    analysis) — ~400-650 rays/s where the encoder microbench runs at
+    1.4 G points/s. This VJP recomputes the (cheap, vectorized) index and
+    weight math in the backward and accumulates each level's gradient into
+    its own ≤(2^log2_T)·C slice before one concatenate, so every scatter
+    touches a small operand. The x cotangent is taken through autodiff of
+    the table-frozen forward — that path is gathers only, no scatters.
+
+    Replaces the atomic-add backward of the reference's CUDA kernel
+    (hashencoder.cu:254-267) with small-operand scatter-adds — the same
+    capability, lowered TPU-idiomatically.
+    """
+    static = (input_dim, num_levels, per_level_scale, base_resolution,
+              log2_hashmap_size)
+
+    @jax.custom_vjp
+    def encode(x, table):
+        return hash_encode(x, table, *static)
+
+    def fwd(x, table):
+        return encode(x, table), (x, table)
+
+    def bwd(res, g):
+        x, table = res
+        batch_shape = x.shape[:-1]
+        if len(batch_shape) != 1:
+            x_flat = x.reshape(-1, input_dim)
+            g_flat = g.reshape(-1, g.shape[-1])
+        else:
+            x_flat, g_flat = x, g
+        offsets, scales, resolutions, use_hash = level_geometry(*static)
+        c = table.shape[-1]
+
+        # dx through the frozen-table forward: gathers only
+        _, vjp_x = jax.vjp(lambda x_: hash_encode(x_, table, *static), x)
+        (dx,) = vjp_x(g)
+
+        # dtable per level: recompute idx/w (cheap vector math), then SORT
+        # the (index, weighted-cotangent) rows and segment_sum with
+        # indices_are_sorted=True — plain scatter-add lowers to ~25M rows/s
+        # on this TPU (PERF.md round 3: per-level AND whole-table scatters
+        # both measured seconds per step at the 134M rows/step scale)
+        grad_slices = []
+        for lvl in range(num_levels):
+            pos = x_flat * scales[lvl] + 0.5
+            pos_grid = jnp.floor(pos)
+            frac = pos - pos_grid
+            pos_grid = pos_grid.astype(jnp.int32)
+            g_lvl = g_flat[:, lvl * c:(lvl + 1) * c]
+            n_entries = offsets[lvl + 1] - offsets[lvl]
+            idx_cols, upd_cols = [], []
+            for corner_bits in range(1 << input_dim):
+                sel = [(corner_bits >> dd) & 1 for dd in range(input_dim)]
+                corner = pos_grid + jnp.asarray(sel, jnp.int32)
+                w = jnp.ones(x_flat.shape[:-1], x_flat.dtype)
+                for dd in range(input_dim):
+                    w = w * (frac[..., dd] if sel[dd] else 1.0 - frac[..., dd])
+                idx_cols.append(_corner_index(
+                    corner, resolutions[lvl], n_entries, use_hash[lvl]
+                ))
+                upd_cols.append(w[:, None] * g_lvl)
+            idx_lvl = jnp.concatenate(idx_cols, axis=0)
+            upd_lvl = jnp.concatenate(upd_cols, axis=0)
+            order = jnp.argsort(idx_lvl)
+            grad_slices.append(jax.ops.segment_sum(
+                jnp.take(upd_lvl, order, axis=0),
+                jnp.take(idx_lvl, order),
+                num_segments=int(n_entries), indices_are_sorted=True,
+            ).astype(table.dtype))
+        return dx, jnp.concatenate(grad_slices, axis=0)
+
+    encode.defvjp(fwd, bwd)
+    return encode(x, table)
+
+
 class HashGridEncoder(nn.Module):
     """Flax module owning the embedding table (uniform ±1e-4 init,
     hashgrid.py:184-186), with world-bounds normalization to [0, 1]
@@ -168,6 +257,7 @@ class HashGridEncoder(nn.Module):
     log2_hashmap_size: int = 19
     desired_resolution: int = -1
     bbox: tuple | None = None  # ((lo,)*D, (hi,)*D) world bounds
+    custom_bwd: bool = False  # per-level scatter VJP (see PERF.md round 3)
 
     @property
     def scale_factor(self) -> float:
@@ -209,7 +299,9 @@ class HashGridEncoder(nn.Module):
             # callers must pre-normalize; clip so out-of-range coords can't
             # wrap through uint32 into scrambled (but finite) table indices
             x = jnp.clip(x, 0.0, 1.0)
-        return hash_encode(
+        encode = (_encode_with_per_level_bwd if self.custom_bwd
+                  else hash_encode)
+        return encode(
             x,
             table,
             self.input_dim,
@@ -238,4 +330,5 @@ class HashGridEncoder(nn.Module):
             log2_hashmap_size=int(enc_cfg.get("log2_hashmap_size", 19)),
             desired_resolution=int(enc_cfg.get("desired_resolution", -1)),
             bbox=tuple(map(tuple, bbox)) if bbox is not None else None,
+            custom_bwd=bool(enc_cfg.get("custom_bwd", False)),
         )

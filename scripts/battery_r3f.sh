@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-3 battery, stage F: the honest-timing re-run + the fix candidate.
+#
+#   f1. bench_hash_step with per-call input variation (the first c0 run's
+#       argument-stationary loops produced physically impossible timings —
+#       see _timed's docstring) + the enc3 sorted-segment-sum probe
+#   f2. in-context A/B: bench.py on lego_hash with and without
+#       network.xyz_encoder.custom_bwd (the per-level sorted-segment VJP)
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[batteryF $(date +%H:%M:%S)] $*"; }
+
+WAIT_PID=${WAIT_PID:-}
+if [ -n "$WAIT_PID" ]; then
+  log "waiting for battery pid $WAIT_PID to release the tunnel"
+  while kill -0 "$WAIT_PID" 2>/dev/null; do sleep 60; done
+  log "pid $WAIT_PID gone; waiting 120 s for the tunnel to settle"
+  sleep 120
+fi
+
+log "=== F1: trisection re-run with varied inputs (honest timings) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 timeout 5400 python scripts/bench_hash_step.py \
+  --n_rays 4096 --steps 10 | tee -a BENCH_HASH_STEP.jsonl
+
+log "=== F2a: lego_hash step, plain autodiff backward (control) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 BENCH_CONFIG=lego_hash.yaml \
+  BENCH_N_RAYS=4096 BENCH_STEPS=30 BENCH_SCAN_STEPS=1 timeout 3600 \
+  python bench.py | tee -a BENCH_SWEEP_HASH.jsonl
+
+log "=== F2b: lego_hash step, custom_bwd (per-level sorted segment_sum) ==="
+BENCH_INIT_RETRIES=4 BENCH_INIT_DELAY_S=30 BENCH_CONFIG=lego_hash.yaml \
+  BENCH_N_RAYS=4096 BENCH_STEPS=30 BENCH_SCAN_STEPS=1 \
+  BENCH_OPTS="network.xyz_encoder.custom_bwd true" timeout 3600 \
+  python bench.py | tee -a BENCH_SWEEP_HASH.jsonl
+
+log "=== battery F done ==="

@@ -56,12 +56,15 @@ def main(argv=None):
     k1, k2 = jax.random.split(key)
     table = jax.random.uniform(k1, (offsets[-1], 2), jnp.float32, -1e-4, 1e-4)
 
-    def timed(fn, *a):
-        out = fn(*a)
+    def timed(fn, x, table):
+        # perturb x per call: identical-argument loops on the axon tunnel
+        # have produced physically impossible timings (see PERF.md round 3
+        # and bench_hash_step._timed) — distinct inputs defeat the elision
+        out = fn(x, table)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
-        for _ in range(args.steps):
-            out = fn(*a)
+        for i in range(args.steps):
+            out = fn(x + (i * 1e-7), table)
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / args.steps
 

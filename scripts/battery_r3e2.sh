@@ -1,7 +1,7 @@
 #!/bin/bash
 # Round-3 battery, stage E: byte-reduction probes for the HBM-bound
-# flagship step (f3: 48.2 GB/step, 557 GB/s achieved = 68% of peak, more
-# rays flat). Remat trades saved-activation traffic for recompute FLOPs —
+# flagship step (f3: 48.2 GiB = 51.7 GB/step, 597.8 GB/s achieved = 73% of
+# peak, more rays flat). Remat trades saved-activation traffic for recompute FLOPs —
 # exactly the right trade for a bandwidth-bound step with 71 FLOPs/byte —
 # but was only ever measured at 16k rays. Measure it at the headline shape.
 set -u

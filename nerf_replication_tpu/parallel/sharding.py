@@ -67,6 +67,34 @@ def data_sharding(mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def shard_index_pool(pool, bank_n: int, mesh):
+    """Shard a precrop index pool over the data axis as LOCAL indices.
+
+    The single-chip pool holds global flat ray indices; a data-sharded bank
+    gives shard ``d`` rows ``[d*L, (d+1)*L)``, so each shard needs the pool
+    members that fall inside its slice, rebased to shard-local offsets.
+    Segments are padded to equal length by cycling (sampling is uniform-
+    with-replacement already, so a cycled duplicate only nudges per-index
+    weights within a shard during the short precrop warm-up).
+    """
+    import numpy as np
+
+    n_data = mesh.shape[DATA_AXIS]
+    local = (bank_n // n_data)
+    pool = np.asarray(pool)
+    segments = []
+    for d in range(n_data):
+        seg = pool[(pool >= d * local) & (pool < (d + 1) * local)] - d * local
+        if seg.size == 0:
+            # a shard with no precrop rays (image rows split across shards)
+            # falls back to its whole slice rather than sampling nothing
+            seg = np.arange(local, dtype=pool.dtype)
+        segments.append(seg)
+    cap = max(s.size for s in segments)
+    padded = np.concatenate([np.resize(s, cap) for s in segments])
+    return jax.device_put(padded, data_sharding(mesh))
+
+
 def shard_bank(bank_rays, bank_rgbs, mesh):
     """Place the ray bank sharded over the data axis (each chip holds
     1/n of the rays — memory scaling the reference's full-bank-per-GPU

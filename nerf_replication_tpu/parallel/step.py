@@ -27,7 +27,7 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..datasets.sampling import sample_rays, sample_step_key
-from ..train.step_core import sampled_grad_step
+from ..train.step_core import sampled_grad_step, scan_k_steps
 from .collectives import tree_pmean
 from .mesh import DATA_AXIS
 from .sharding import data_sharding, tree_shardings
@@ -39,9 +39,19 @@ def build_dp_step(
     n_rays_global: int,
     near: float,
     far: float,
+    k_steps: int = 1,
+    with_pool: bool = False,
 ):
-    """shard_map DP step: ``(state, bank_rays, bank_rgbs, base_key) ->
-    (state, stats)`` with the bank sharded over the data axis."""
+    """shard_map DP step: ``(state, bank_rays, bank_rgbs, base_key[, pool])
+    -> (state, stats)`` with the bank sharded over the data axis.
+
+    ``k_steps > 1`` scans K optimizer steps inside the one dispatch (the
+    trainer's scan-burst idiom — PERF.md round 3: +33% on the latency-bound
+    flagship shape). ``with_pool`` adds a data-sharded local index pool for
+    precrop warm-up (each shard draws from ITS pool segment of shard-local
+    indices; see sharding.shard_index_pool). Signature matches the
+    single-chip ``Trainer._build_step`` so the epoch loop drives either.
+    """
     n_data = mesh.shape[DATA_AXIS]
     if n_rays_global % n_data != 0:
         raise ValueError(
@@ -51,25 +61,34 @@ def build_dp_step(
         )
     n_local = n_rays_global // n_data
 
-    def body(state, bank_rays, bank_rgbs, base_key):
+    def one_step(st, bank_rays, bank_rgbs, base_key, pool):
         # disjoint stream per (step, device-shard) — axis_index is global
         # across processes, so this is multi-controller-safe
-        key = sample_step_key(base_key, state.step)
+        key = sample_step_key(base_key, st.step)
         key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
         k_sample, k_render = jax.random.split(key)
         grads, stats = sampled_grad_step(
-            loss, state.params, bank_rays, bank_rgbs, n_local, near, far,
-            k_sample, k_render,
+            loss, st.params, bank_rays, bank_rgbs, n_local, near, far,
+            k_sample, k_render, index_pool=pool,
         )
         grads = tree_pmean(grads, DATA_AXIS)
         stats = tree_pmean(stats, DATA_AXIS)
-        new_state = state.apply_gradients(grads=grads)
-        return new_state, stats
+        return st.apply_gradients(grads=grads), stats
 
+    def body(state, bank_rays, bank_rgbs, base_key, *pool):
+        p = pool[0] if pool else None
+        return scan_k_steps(
+            lambda st: one_step(st, bank_rays, bank_rgbs, base_key, p),
+            state, k_steps,
+        )
+
+    in_specs = (P(), P(DATA_AXIS), P(DATA_AXIS), P())
+    if with_pool:
+        in_specs = in_specs + (P(DATA_AXIS),)
     smap = shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=in_specs,
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -82,9 +101,12 @@ def build_gspmd_step(
     n_rays: int,
     near: float,
     far: float,
+    k_steps: int = 1,
 ):
     """GSPMD dp×tp step: sharding constraints on the batch (data axis) and on
-    params (model axis, via sharding rules); XLA derives the collectives."""
+    params (model axis, via sharding rules); XLA derives the collectives.
+    ``k_steps > 1`` scans K optimizer steps inside the one dispatch (same
+    burst idiom as ``build_dp_step``)."""
     batch_sh = data_sharding(mesh)
     n_data = mesh.shape[DATA_AXIS]
     if n_rays % n_data != 0:
@@ -112,8 +134,8 @@ def build_gspmd_step(
         check_vma=False,
     )
 
-    def step(state, bank_rays, bank_rgbs, base_key):
-        key = sample_step_key(base_key, state.step)
+    def one_step(st, bank_rays, bank_rgbs, base_key):
+        key = sample_step_key(base_key, st.step)
         k_sample, k_render = jax.random.split(key)
 
         # data-sharded batch, sampled shard-locally
@@ -131,10 +153,16 @@ def build_gspmd_step(
             return l, stats
 
         (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
+            st.params
         )
-        new_state = state.apply_gradients(grads=grads)
+        new_state = st.apply_gradients(grads=grads)
         return new_state, stats
+
+    def step(state, bank_rays, bank_rgbs, base_key):
+        return scan_k_steps(
+            lambda st: one_step(st, bank_rays, bank_rgbs, base_key),
+            state, k_steps,
+        )
 
     return jax.jit(step, donate_argnums=(0,))
 

@@ -14,6 +14,20 @@ import jax
 from ..datasets.sampling import sample_rays
 
 
+def scan_k_steps(one_step, state, k_steps: int):
+    """Run ``one_step(state) -> (state, stats)`` K times inside one
+    ``lax.scan`` dispatch, returning the LAST step's stats (same
+    observability as K sequential calls — per-step traces inside a burst
+    are not observable). The single scan-burst idiom shared by the
+    single-chip, shard_map-DP, and GSPMD step builders."""
+    if k_steps == 1:
+        return one_step(state)
+    state, stats_seq = jax.lax.scan(
+        lambda st, _: one_step(st), state, None, length=k_steps
+    )
+    return state, jax.tree_util.tree_map(lambda x: x[-1], stats_seq)
+
+
 def sampled_grad_step(
     loss,
     params,

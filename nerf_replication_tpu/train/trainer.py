@@ -29,7 +29,7 @@ from flax.training.train_state import TrainState
 
 from ..datasets.sampling import sample_step_key
 from .checkpoint import load_model, load_pretrain, save_model, save_trained_config
-from .step_core import sampled_grad_step
+from .step_core import sampled_grad_step, scan_k_steps
 from .optim import make_optimizer
 from .recorder import Recorder
 
@@ -46,11 +46,16 @@ def make_train_state(cfg, network, key) -> tuple[TrainState, "optax.Schedule"]:
 
 
 class Trainer:
-    def __init__(self, cfg, network, loss, evaluator=None):
+    def __init__(self, cfg, network, loss, evaluator=None, mesh=None):
         self.cfg = cfg
         self.network = network
         self.loss = loss  # NeRFLoss: (params, batch, key, train) -> (out, loss, stats)
         self.evaluator = evaluator
+        # a live device mesh routes every step through the shard_map DP
+        # builder (parallel/step.py) — the reference turns DDP on inside its
+        # train entry (train.py:116-120, trainer.py:17-22), so the mesh is a
+        # Trainer-level mode here, not a separate driver
+        self.mesh = mesh
         # img_fit names the batch knob N_pixels (lego_view0.yaml:14)
         self.n_rays = int(
             cfg.task_arg.get("N_rays", cfg.task_arg.get("N_pixels", 1024))
@@ -88,8 +93,39 @@ class Trainer:
             return self.ep_iter
         return max(1, bank_size // self.n_rays)
 
+    def _uses_tp(self) -> bool:
+        from ..parallel.mesh import MODEL_AXIS
+
+        return self.mesh is not None and self.mesh.shape[MODEL_AXIS] > 1
+
+    def _build_sharded_step(self, k_steps: int = 1, with_pool: bool = False):
+        """One routing ladder for every mesh variant: model_axis > 1 goes
+        through the GSPMD builder (the shard_map DP body would replicate
+        the model axis), pure DP through the explicit-collective builder."""
+        if self._uses_tp():
+            from ..parallel.step import build_gspmd_step
+
+            if with_pool:
+                raise NotImplementedError(
+                    "precrop warm-up is not supported with "
+                    "parallel.model_axis > 1 — set task_arg.precrop_iters 0 "
+                    "or train pure-DP"
+                )
+            return build_gspmd_step(
+                self.mesh, self.loss, self.n_rays, self.near, self.far,
+                k_steps=k_steps,
+            )
+        from ..parallel.step import build_dp_step
+
+        return build_dp_step(
+            self.mesh, self.loss, self.n_rays, self.near, self.far,
+            k_steps=k_steps, with_pool=with_pool,
+        )
+
     # -- jitted step construction ------------------------------------------
     def _build_step(self, with_pool: bool):
+        if self.mesh is not None:
+            return self._build_sharded_step(with_pool=with_pool)
         n_rays = self.n_rays
         process_index = self.process_index
         near, far, loss = self.near, self.far, self.loss
@@ -111,13 +147,15 @@ class Trainer:
         return step_fn
 
     def _build_multi_step(self, k_steps: int):
+        if self.mesh is not None:
+            return self._build_sharded_step(k_steps=k_steps)
         n_rays = self.n_rays
         process_index = self.process_index
         near, far, loss = self.near, self.far, self.loss
 
         @partial(jax.jit, donate_argnums=(0,))
         def multi_step_fn(state, bank_rays, bank_rgbs, base_key):
-            def body(st, _):
+            def body(st):
                 key = sample_step_key(base_key, st.step, process_index)
                 k_sample, k_render = jax.random.split(key)
                 grads, stats = sampled_grad_step(
@@ -126,10 +164,7 @@ class Trainer:
                 )
                 return st.apply_gradients(grads=grads), stats
 
-            state, stats_seq = jax.lax.scan(body, state, None, length=k_steps)
-            # the caller sees the LAST step's stats, same as k sequential
-            # calls; per-step traces inside a burst are not observable
-            return state, jax.tree_util.tree_map(lambda x: x[-1], stats_seq)
+            return scan_k_steps(body, state, k_steps)
 
         return multi_step_fn
 
@@ -315,7 +350,31 @@ def fit(cfg, network=None, log=print):
     loss_factory = load_attr(cfg.loss_module, "make_loss", "NetworkWrapper")
     loss = loss_factory(cfg, network)
     evaluator = None if cfg.get("skip_eval", False) else make_evaluator(cfg)
-    trainer = Trainer(cfg, network, loss, evaluator)
+
+    # distribution is ON by default when more than one chip is visible —
+    # the reference's entry point behaves the same way (its launcher wraps
+    # every train.py run in DDP, train.py:116-120). Opting out of the mesh
+    # entirely takes parallel.data_axis: 1 AND model_axis: 1 (the default);
+    # a TP-only topology (data_axis 1, model_axis > 1) still builds one.
+    par = cfg.get("parallel", {})
+    data_axis = int(par.get("data_axis", -1))
+    model_axis = int(par.get("model_axis", 1))
+    mesh = None
+    if jax.device_count() > 1 and (data_axis != 1 or model_axis > 1):
+        from ..parallel.mesh import make_mesh_from_cfg
+
+        mesh = make_mesh_from_cfg(cfg)
+        log(f"training over mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+        if model_axis > 1 and int(cfg.task_arg.get("precrop_iters", 0)) > 0:
+            # fail BEFORE datasets load and the bank/state get sharded —
+            # the same contradiction would otherwise only surface at step 1
+            raise NotImplementedError(
+                "precrop warm-up is not supported with "
+                "parallel.model_axis > 1 — set task_arg.precrop_iters 0 "
+                "or train pure-DP"
+            )
+
+    trainer = Trainer(cfg, network, loss, evaluator, mesh=mesh)
     recorder = make_recorder(cfg)
 
     seed = int(cfg.get("seed", 0))
@@ -338,11 +397,36 @@ def fit(cfg, network=None, log=print):
 
     train_ds = make_dataset(cfg, "train")
     test_ds = make_dataset(cfg, "test")
-    bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
     pool = None
-    if trainer.precrop_iters > 0:
-        frac = float(cfg.task_arg.get("precrop_frac", 0.5))
-        pool = jax.device_put(train_ds.precrop_index_pool(frac))
+    frac = float(cfg.task_arg.get("precrop_frac", 0.5))
+    if mesh is not None:
+        from ..parallel.sharding import shard_bank, shard_index_pool
+
+        # globally permute the bank before sharding: contiguous slices
+        # would give each shard only a few images' rows (and could starve
+        # a shard of precrop rays entirely); a fixed host-side shuffle
+        # makes every shard a uniform sample of the whole scene
+        bank_rays, bank_rgbs = train_ds.ray_bank()
+        perm = np.random.default_rng(seed).permutation(bank_rays.shape[0])
+        bank = shard_bank(bank_rays[perm], bank_rgbs[perm], mesh)
+        if trainer.precrop_iters > 0:
+            inv = np.empty_like(perm)
+            inv[perm] = np.arange(perm.size)
+            pool_perm = inv[np.asarray(train_ds.precrop_index_pool(frac))]
+            # shard_bank truncates to a divisible size; drop pool members
+            # whose permuted position fell past the truncation
+            n_bank = int(bank[0].shape[0])
+            pool = shard_index_pool(
+                pool_perm[pool_perm < n_bank], n_bank, mesh
+            )
+        if trainer._uses_tp():
+            from ..parallel.step import shard_train_state
+
+            state = shard_train_state(state, mesh)
+    else:
+        bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+        if trainer.precrop_iters > 0:
+            pool = jax.device_put(train_ds.precrop_index_pool(frac))
 
     epochs = int(cfg.train.epoch)
     save_ep = int(cfg.get("save_ep", 40))

@@ -29,11 +29,17 @@ def configure_runtime(cfg) -> None:
     # JAX_PLATFORMS is beaten by this machine's sitecustomize (see
     # utils/platform.py), which would silently send a CPU-intended run to a
     # possibly-wedged TPU tunnel
+    # "cpu:8" pins the platform AND a virtual device count (the CLI route
+    # to the multi-device emulation the tests/dryrun use)
     platform = os.environ.get("NERF_PLATFORM", "")
     if platform:
         from .platform import force_platform
 
-        force_platform(platform)
+        if ":" in platform:
+            name, _, count = platform.partition(":")
+            force_platform(name, device_count=int(count))
+        else:
+            force_platform(platform)
     # persistent executable cache: battery stages / sweep points are fresh
     # processes that would otherwise re-pay identical compiles (no-op if a
     # caller — e.g. the test harness — already configured a cache dir)

@@ -69,8 +69,9 @@ def parse_quality_jsonl(path: str):
                 train.append(
                     {"step": int(r["step"]), "loss": float(r["loss"])}
                 )
-            val.append({"step": int(r["step"]), "psnr": r.get("psnr"),
-                        "ssim": r.get("ssim")})
+            if r.get("psnr") is not None or r.get("ssim") is not None:
+                val.append({"step": int(r["step"]), "psnr": r.get("psnr"),
+                            "ssim": r.get("ssim")})
     return train, val
 
 

@@ -1,0 +1,242 @@
+"""Elision-immune primitive rate bench for the hash-grad redesign.
+
+Round 3 proved this machine's axon tunnel produces physically impossible
+timings for host-side loops that re-dispatch an executable — even with
+perturbed arguments (BENCH_HASH_STEP.jsonl: a 99 MB-gradient fwd+bwd "in
+30 us"). Every measurement here therefore runs K dependent iterations
+INSIDE one jitted ``lax.fori_loop`` whose carry feeds each iteration from
+the last: one dispatch, no host loop, nothing to elide. Rates are
+lower bounds (the carry chain serializes iterations — exactly like a real
+training loop).
+
+These ten numbers decide the table-gradient mechanism (VERDICT r3 #1):
+the reference's CUDA backward is one atomicAdd pass
+(hashencoder.cu:254-267); the TPU-native replacement must be built from
+whichever of scatter / sort+segment / gather-diff / one-hot-matmul the
+hardware actually runs fast.
+
+    python scripts/bench_primitives.py [--rows 2097152] [--iters 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=2 * 1024 * 1024)
+    p.add_argument("--table", type=int, default=524288)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--force_platform", default=os.environ.get(
+        "BENCH_FORCE_PLATFORM", ""))
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+
+    from nerf_replication_tpu.utils.platform import (
+        enable_compilation_cache,
+        setup_backend,
+    )
+
+    setup_backend(args.force_platform)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    R, T, K = args.rows, args.table, args.iters
+    C = 2
+
+    sink = open(args.out, "a") if args.out else None
+
+    def emit(name, seconds, unit_count, unit):
+        rec = {"stage": name, "s_per_iter": round(seconds, 6),
+               "rate_per_s": round(unit_count / seconds, 1), "unit": unit,
+               "rows": R, "table": T, "iters": K,
+               "ts": int(time.time())}
+        line = json.dumps(rec)
+        print(line, flush=True)
+        if sink:
+            sink.write(line + "\n")
+            sink.flush()
+
+    def _sync(x):
+        """Force completion: device_get of a scalar CANNOT return before the
+        producing computation finishes. On the axon tunnel,
+        ``block_until_ready`` returns early for sub-ms programs, so timing
+        must end on a host copy, not on a ready-event."""
+        leaf = jax.tree_util.tree_leaves(x)[0]
+        return float(jnp.ravel(leaf)[0])
+
+    def run(name, body, carry, unit_count, unit):
+        """body(i, carry) -> carry; K iterations inside one executable."""
+
+        @jax.jit
+        def prog(c):
+            return lax.fori_loop(0, K, body, c)
+
+        try:
+            out = prog(carry)  # compile + warm
+            _sync(out)
+            t0 = time.perf_counter()
+            out = prog(out)
+            _sync(out)
+            dt = (time.perf_counter() - t0) / K
+            emit(name, dt, unit_count, unit)
+        except Exception as exc:  # keep later stages alive
+            msg = str(exc).splitlines()[0][:160]
+            rec = {"stage": name, "error": msg, "rows": R, "table": T}
+            print(json.dumps(rec), flush=True)
+            if sink:
+                sink.write(json.dumps(rec) + "\n")
+                sink.flush()
+
+    key0 = jax.random.PRNGKey(0)
+
+    # fresh indices each iteration (PRNG inside the loop), accumulation
+    # carries the dependency chain
+    def fresh_idx(i, lo, hi, n):
+        return jax.random.randint(jax.random.fold_in(key0, i), (n,), lo, hi)
+
+    # 1. duplicate-index scatter-add (the convicted current lowering)
+    def scatter_dup(i, acc):
+        idx = fresh_idx(i, 0, T, R)
+        upd = jnp.full((R, C), 1e-6, jnp.float32) + acc[0, :1]
+        return acc.at[idx].add(upd)
+
+    run("scatter_add_dup", scatter_dup, jnp.zeros((T, C)), R, "rows")
+
+    # 2. duplicate scatter-add, bf16 updates (the training dtype)
+    def scatter_dup_bf16(i, acc):
+        idx = fresh_idx(i, 0, T, R)
+        upd = (jnp.full((R, C), 1e-3, jnp.bfloat16)
+               + acc[0, :1].astype(jnp.bfloat16))
+        return acc.at[idx].add(upd.astype(jnp.float32))
+
+    run("scatter_add_dup_bf16src", scatter_dup_bf16, jnp.zeros((T, C)),
+        R, "rows")
+
+    # 3. SORTED duplicate scatter-add (indices_are_sorted hint)
+    def scatter_sorted(i, acc):
+        idx = jnp.sort(fresh_idx(i, 0, T, R))
+        upd = jnp.full((R, C), 1e-6, jnp.float32) + acc[0, :1]
+        dnums = lax.ScatterDimensionNumbers(
+            update_window_dims=(1,), inserted_window_dims=(0,),
+            scatter_dims_to_operand_dims=(0,))
+        return lax.scatter_add(acc, idx[:, None], upd, dnums,
+                               indices_are_sorted=True,
+                               unique_indices=False)
+
+    run("scatter_add_sorted_dup", scatter_sorted, jnp.zeros((T, C)),
+        R, "rows")
+
+    # 4. UNIQUE-index scatter (a permutation write — the radix keystone)
+    def scatter_unique(i, acc):
+        perm = jax.random.permutation(jax.random.fold_in(key0, i), R)
+        upd = jnp.full((R, C), 1e-6, jnp.float32) + acc[0, :1]
+        dnums = lax.ScatterDimensionNumbers(
+            update_window_dims=(1,), inserted_window_dims=(0,),
+            scatter_dims_to_operand_dims=(0,))
+        return lax.scatter(acc, perm[:, None], upd, dnums,
+                           indices_are_sorted=False, unique_indices=True)
+
+    run("scatter_unique_perm", scatter_unique, jnp.zeros((R, C)), R, "rows")
+
+    # 5. gather R rows from a [T, C] table
+    def gather_rows(i, acc):
+        idx = fresh_idx(i, 0, T, R)
+        vals = jnp.take(acc, idx, axis=0)
+        return acc.at[0].add(jnp.sum(vals, axis=0) * 1e-9)
+
+    run("gather_rows", gather_rows, jnp.ones((T, C)), R, "rows")
+
+    # 6. global sort of R int32 keys with int32 payload (argsort-equiv)
+    def sort_global(i, acc):
+        keys = fresh_idx(i, 0, T, R) + acc[0]
+        sk, sv = lax.sort((keys, jnp.arange(R, dtype=jnp.int32)),
+                          num_keys=1)
+        return acc.at[0].set(sk[0] % 7 + sv[0] % 3)
+
+    run("sort_global_i32_pair", sort_global, jnp.zeros((1,), jnp.int32),
+        R, "rows")
+
+    # 7. batched minor-axis sort: [R/4096, 4096] rows sorted independently
+    B_rows = R // 4096
+
+    def sort_batched(i, acc):
+        keys = jax.random.randint(jax.random.fold_in(key0, i),
+                                  (B_rows, 4096), 0, T) + acc[0, 0]
+        s = jnp.sort(keys, axis=-1)
+        return acc.at[0, 0].set(s[0, 0] % 11)
+
+    run("sort_batched_minor4096", sort_batched,
+        jnp.zeros((1, 1), jnp.int32), R, "rows")
+
+    # 8. cumsum over [R, C] f32
+    def cumsum_rows(i, acc):
+        x = jnp.full((R, C), 1e-7, jnp.float32) + acc[0, :1]
+        cs = jnp.cumsum(x, axis=0)
+        return acc.at[0].add(cs[-1] * 1e-9)
+
+    run("cumsum_rows", cumsum_rows, jnp.zeros((1, C)), R, "rows")
+
+    # 9. searchsorted: T queries into a sorted R-array
+    def searchsorted_t(i, acc):
+        hay = jnp.sort(fresh_idx(i, 0, jnp.int32(1 << 30), R))
+        pos = jnp.searchsorted(hay, jnp.arange(T, dtype=jnp.int32) + acc[0])
+        return acc.at[0].set(pos[0] % 5)
+
+    run("sort_plus_searchsorted", searchsorted_t,
+        jnp.zeros((1,), jnp.int32), R, "rows(sort)+T queries")
+
+    # 10. one-hot matmul histogram, level-0 scale (T0=4920): does XLA fuse
+    # the iota-compare producer into the matmul, and at what FLOPs?
+    T0 = 4920
+    R0 = R // 4
+
+    def onehot_hist(i, acc):
+        idx = fresh_idx(i, 0, T0, R0)
+        upd = jnp.full((R0, C), 1e-6, jnp.float32) + acc[0, :1]
+        oh = (idx[:, None] == jnp.arange(T0)[None, :]).astype(jnp.float32)
+        return acc + jnp.einsum("nt,nc->tc", oh, upd,
+                                preferred_element_type=jnp.float32)
+
+    run("onehot_matmul_hist_T4920", onehot_hist, jnp.zeros((T0, C)),
+        R0 * T0 * C * 2, "flops")
+
+    # 11. same but bf16 one-hot (MXU-native dtype)
+    def onehot_hist_bf16(i, acc):
+        idx = fresh_idx(i, 0, T0, R0)
+        upd = (jnp.full((R0, C), 1e-3, jnp.bfloat16)
+               + acc[0, :1].astype(jnp.bfloat16))
+        oh = (idx[:, None] == jnp.arange(T0)[None, :]).astype(jnp.bfloat16)
+        return acc + jnp.einsum("nt,nc->tc", oh, upd,
+                                preferred_element_type=jnp.float32)
+
+    run("onehot_matmul_hist_bf16_T4920", onehot_hist_bf16,
+        jnp.zeros((T0, C)), R0 * T0 * C * 2, "flops")
+
+    # 12. segment_sum on PRE-SORTED ids (sorted-scatter lowering, no sort
+    # in the loop — isolates the segment reduction itself)
+    def segsum_sorted(i, acc):
+        idx = jnp.sort(fresh_idx(i, 0, T, R))
+        upd = jnp.full((R, C), 1e-6, jnp.float32) + acc[0, :1]
+        return acc + jax.ops.segment_sum(upd, idx, num_segments=T,
+                                         indices_are_sorted=True)
+
+    run("segment_sum_sorted", segsum_sorted, jnp.zeros((T, C)), R, "rows")
+
+    if sink:
+        sink.close()
+
+
+if __name__ == "__main__":
+    main()

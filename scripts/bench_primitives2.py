@@ -1,0 +1,226 @@
+"""Primitive bench round 2: gather-width scaling + sort scaling.
+
+Round 1 (BENCH_PRIMITIVES.jsonl) convicted BOTH sides of the hash step:
+scatter-add is ~22M rows/s regardless of sorted/unique hints, and narrow
+[T,2] row gather is 119M rows/s (~1 GB/s effective). The redesign rests on
+two open questions this round answers:
+
+1. Does gather throughput scale with ROW WIDTH? (decides the cell-packed
+   wide-row table layout: 1 gather x 64 B beats 8 gathers x 8 B only if
+   per-row cost is ~flat in width)
+2. Does lax.gather with a (2,2,2,C) WINDOW over a dense 3-D grid lower
+   well? (decides the dense-level trilinear formulation)
+3. Does the variadic sort stay fast at 8-16M rows with f32 payloads?
+   (decides the sort-based scatter-free backward)
+4. How fast is the full merge-extraction composite (sort + cumsum +
+   position-merge, zero scatters) at real scale?
+
+    python scripts/bench_primitives2.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--force_platform", default=os.environ.get(
+        "BENCH_FORCE_PLATFORM", ""))
+    p.add_argument("--out", default="")
+    p.add_argument("--small", action="store_true",
+                   help="tiny shapes for CPU smoke")
+    args = p.parse_args(argv)
+
+    from nerf_replication_tpu.utils.platform import (
+        enable_compilation_cache,
+        setup_backend,
+    )
+
+    setup_backend(args.force_platform)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    K = args.iters
+    SMALL = args.small
+    sink = open(args.out, "a") if args.out else None
+
+    def emit(rec):
+        line = json.dumps(rec)
+        print(line, flush=True)
+        if sink:
+            sink.write(line + "\n")
+            sink.flush()
+
+    def _sync(x):
+        """Force completion: device_get of a scalar CANNOT return before the
+        producing computation finishes. On the axon tunnel,
+        ``block_until_ready`` returns early for sub-ms programs (measured:
+        a 67 MB gather "completing" in 24 us), so timing must end on a
+        host copy, not on a ready-event."""
+        leaf = jax.tree_util.tree_leaves(x)[0]
+        return float(jnp.ravel(leaf)[0])
+
+    def run(name, body, carry, unit_count, unit, extra=None):
+        @jax.jit
+        def prog(c):
+            return lax.fori_loop(0, K, body, c)
+
+        try:
+            out = prog(carry)
+            _sync(out)
+            t0 = time.perf_counter()
+            out = prog(out)
+            _sync(out)
+            dt = (time.perf_counter() - t0) / K
+            rec = {"stage": name, "s_per_iter": round(dt, 6),
+                   "rate_per_s": round(unit_count / dt, 1), "unit": unit,
+                   "iters": K, "ts": int(time.time())}
+            if extra:
+                rec.update(extra)
+            emit(rec)
+        except Exception as exc:
+            emit({"stage": name, "error": str(exc).splitlines()[0][:160]})
+
+    key0 = jax.random.PRNGKey(0)
+
+    def fresh_idx(i, hi, n):
+        return jax.random.randint(jax.random.fold_in(key0, i), (n,), 0, hi)
+
+    # ---- 1. gather rate vs row width (same total rows) -------------------
+    T = 4096 if SMALL else 524288
+    R = 65536 if SMALL else 2 * 1024 * 1024
+    for W in (2, 8, 16, 32, 128):
+        def gather_w(i, acc, W=W):
+            idx = fresh_idx(i, T, R)
+            vals = jnp.take(acc, idx, axis=0)
+            return acc.at[0].add(jnp.sum(vals, axis=0) * 1e-9)
+
+        run(f"gather_rows_w{W}", gather_w, jnp.ones((T, W)), R, "rows",
+            {"rows": R, "table": T, "width": W,
+             "gbps": None})
+
+    # ---- 2. windowed gather: (2,2,2,C) trilinear neighborhoods -----------
+    G = 16 if SMALL else 128  # dense grid resolution
+    C = 2
+    NPTS = 16384 if SMALL else 1024 * 1024
+
+    def window_gather(i, acc):
+        k = jax.random.fold_in(key0, i)
+        pos = jax.random.randint(k, (NPTS, 3), 0, G - 1)
+        dnums = lax.GatherDimensionNumbers(
+            offset_dims=(1, 2, 3, 4),
+            collapsed_slice_dims=(),
+            start_index_map=(0, 1, 2),
+        )
+        win = lax.gather(
+            acc, pos, dnums, slice_sizes=(2, 2, 2, C),
+            mode=lax.GatherScatterMode.CLIP,
+        )  # [NPTS, 2,2,2,C]
+        return acc.at[0, 0, 0].add(
+            jnp.sum(win, axis=(0, 1, 2, 3)) * 1e-9
+        )
+
+    run("gather_window_2x2x2", window_gather,
+        jnp.ones((G, G, G, C)), NPTS, "windows",
+        {"points": NPTS, "grid": G, "window_floats": 8 * C})
+
+    # ---- 2b. same neighborhood via 8 separate narrow gathers (control) ---
+    def corner_gathers(i, acc):
+        k = jax.random.fold_in(key0, i)
+        pos = jax.random.randint(k, (NPTS, 3), 0, G - 1)
+        flat = acc.reshape(-1, C)
+        total = jnp.zeros((C,))
+        for bits in range(8):
+            sel = jnp.asarray([(bits >> d) & 1 for d in range(3)])
+            cp = pos + sel
+            fi = (cp[:, 0] * G + cp[:, 1]) * G + cp[:, 2]
+            total = total + jnp.sum(jnp.take(flat, fi, axis=0), axis=0)
+        return acc.at[0, 0, 0].add(total * 1e-9)
+
+    run("gather_8corners_narrow", corner_gathers,
+        jnp.ones((G, G, G, C)), NPTS * 8, "rows",
+        {"points": NPTS, "grid": G})
+
+    # ---- 3. variadic sort scaling with f32 payloads ----------------------
+    for RS in ((1 << 17,) if SMALL else (1 << 23, 1 << 24)):
+        def sort_payload(i, acc, RS=RS):
+            keys = fresh_idx(i, 1 << 19, RS) + acc[0].astype(jnp.int32)
+            u0 = jnp.full((RS,), 1e-6, jnp.float32)
+            u1 = jnp.full((RS,), 2e-6, jnp.float32)
+            sk, s0, s1 = lax.sort((keys, u0, u1), num_keys=1)
+            return acc.at[0].set((sk[0] % 7).astype(jnp.float32)
+                                 + s0[0] + s1[0])
+
+        run(f"sort_i32_2f32_R{RS}", sort_payload, jnp.zeros((1,)),
+            RS, "rows", {"rows": RS})
+
+    # ---- 4. full merge-extraction composite at real scale ----------------
+    # rows -> sorted -> cumsum -> dense [T_total, C] grad, ZERO scatters:
+    #   positions of arange(T) inside the sorted idx stream come from a
+    #   second sort over (idx rows) ++ (entry sentinels), and the dense
+    #   grad is csum[hi] - csum[lo] gathered per entry.
+    RT = 1 << 17 if SMALL else 1 << 23          # update rows
+    TT = 1 << 14 if SMALL else 12 * 1024 * 1024  # total table entries
+
+    def merge_extract(i, acc):
+        idx = fresh_idx(i, TT, RT)
+        u0 = jnp.full((RT,), 1e-6, jnp.float32) + acc[0, 0] * 1e-12
+        u1 = jnp.full((RT,), 2e-6, jnp.float32)
+        # sort rows by entry id, payloads ride the sort
+        sk, s0, s1 = lax.sort((idx, u0, u1), num_keys=1)
+        cs0 = jnp.cumsum(s0)
+        cs1 = jnp.cumsum(s1)
+        # merge positions: entries (key=e, tag=1) vs rows (key=idx, tag=0);
+        # after the sort, entry e sits at position hi(e) + e
+        keys2 = jnp.concatenate([sk, jnp.arange(TT, dtype=jnp.int32)])
+        tags = jnp.concatenate([
+            jnp.zeros((RT,), jnp.int8), jnp.ones((TT,), jnp.int8)
+        ])
+        mk, mt = lax.sort((keys2, tags), num_keys=2)  # rows before entries
+        # positions of the entry sentinels, in entry order, via compaction
+        # sort: flagged first, stable by position
+        pos = jnp.arange(RT + TT, dtype=jnp.int32)
+        ck, cpos = lax.sort(((1 - mt).astype(jnp.int32), pos), num_keys=2)
+        hi = cpos[:TT] - jnp.arange(TT, dtype=jnp.int32)  # rows <= e
+        csp0 = jnp.concatenate([jnp.zeros((1,), cs0.dtype), cs0])
+        csp1 = jnp.concatenate([jnp.zeros((1,), cs1.dtype), cs1])
+        hi_prev = jnp.concatenate([jnp.zeros((1,), hi.dtype), hi[:-1]])
+        g0 = jnp.take(csp0, hi) - jnp.take(csp0, hi_prev)
+        g1 = jnp.take(csp1, hi) - jnp.take(csp1, hi_prev)
+        return acc.at[:, 0].add(g0 * 1e-9).at[:, 1].add(g1 * 1e-9)
+
+    run("merge_extract_full", merge_extract, jnp.zeros((TT, C)),
+        RT, "rows", {"rows": RT, "table": TT})
+
+    # ---- 5. one-hot gather via MXU for a SMALL dense level ---------------
+    T0 = 512 if SMALL else 4920
+    R0 = 16384 if SMALL else 1 << 21
+
+    def mxu_gather(i, acc):
+        idx = fresh_idx(i, T0, R0)
+        oh = (idx[:, None] == jnp.arange(T0)[None, :]).astype(jnp.bfloat16)
+        vals = oh @ acc.astype(jnp.bfloat16)  # [R0, C]
+        return acc.at[0].add(jnp.sum(vals, axis=0).astype(jnp.float32)
+                             * 1e-9)
+
+    run("mxu_onehot_gather_T4920", mxu_gather, jnp.ones((T0, C)),
+        R0, "rows", {"rows": R0, "table": T0})
+
+    if sink:
+        sink.close()
+
+
+if __name__ == "__main__":
+    main()

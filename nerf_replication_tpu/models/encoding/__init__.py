@@ -14,8 +14,11 @@ from __future__ import annotations
 from .freq import frequency_encoder
 
 
-def get_encoder(enc_cfg):
-    """``enc_cfg`` is a config node with at least ``type`` and ``input_dim``."""
+def get_encoder(enc_cfg, precision=None):
+    """``enc_cfg`` is a config node with at least ``type`` and ``input_dim``.
+    ``precision`` (cfg.precision) lets dtype-aware encoders follow the
+    compute dtype (the packed hash grid gathers half-width rows under
+    bf16)."""
     enc_type = enc_cfg.type
 
     if enc_type == "frequency":
@@ -30,6 +33,14 @@ def get_encoder(enc_cfg):
         from .hashgrid import HashGridEncoder
 
         module = HashGridEncoder.from_cfg(enc_cfg)
+        return module, module.out_dim
+
+    if enc_type == "hashgrid_packed":
+        # TPU-native cell-packed layout: one wide gather per (point, level)
+        # and a scatter-free sorted backward (see packed_hash.py)
+        from .packed_hash import PackedHashGridEncoder
+
+        module = PackedHashGridEncoder.from_cfg(enc_cfg, precision)
         return module, module.out_dim
 
     if enc_type in ("triplane", "cuda_triplane"):

@@ -170,15 +170,16 @@ def _encode_with_per_level_bwd(
     scatter-adds against the ONE concatenated [total_entries, C] operand;
     XLA's TPU lowering of those turns the train step into a modeled
     24.7 TB/step memory-traffic program (PERF.md round 3, f2 cost
-    analysis) — ~400-650 rays/s where the encoder microbench runs at
-    1.4 G points/s. This VJP recomputes the (cheap, vectorized) index and
-    weight math in the backward and accumulates each level's gradient into
-    its own ≤(2^log2_T)·C slice before one concatenate, so every scatter
-    touches a small operand. The x cotangent is taken through autodiff of
-    the table-frozen forward — that path is gathers only, no scatters.
+    analysis) — ~400-650 rays/s where the chip's scatter-add tops out at
+    ~23M rows/s however it is hinted (BENCH_PRIMITIVES.jsonl). This VJP
+    recomputes the (cheap, vectorized) index and weight math in the
+    backward and reduces each level's rows with the scatter-free sorted
+    histogram (ops.indexed_row_sum: sort + cumsum + merge-extraction).
+    The x cotangent is taken through autodiff of the table-frozen
+    forward — that path is gathers only, no scatters.
 
     Replaces the atomic-add backward of the reference's CUDA kernel
-    (hashencoder.cu:254-267) with small-operand scatter-adds — the same
+    (hashencoder.cu:254-267) with sort-rate segment sums — the same
     capability, lowered TPU-idiomatically.
     """
     static = (input_dim, num_levels, per_level_scale, base_resolution,
@@ -206,11 +207,12 @@ def _encode_with_per_level_bwd(
         _, vjp_x = jax.vjp(lambda x_: hash_encode(x_, table, *static), x)
         (dx,) = vjp_x(g)
 
-        # dtable per level: recompute idx/w (cheap vector math), then SORT
-        # the (index, weighted-cotangent) rows and segment_sum with
-        # indices_are_sorted=True — plain scatter-add lowers to ~25M rows/s
-        # on this TPU (PERF.md round 3: per-level AND whole-table scatters
-        # both measured seconds per step at the 134M rows/step scale)
+        # dtable per level: recompute idx/w (cheap vector math), then the
+        # scatter-FREE sorted histogram (ops.indexed_row_sum): measured on
+        # this chip, EVERY scatter-add variant — duplicate, sorted, unique —
+        # lowers to ~23M rows/s (BENCH_PRIMITIVES.jsonl), while sort /
+        # cumsum / gather run at 120-420M rows/s; the histogram is built
+        # from only the fast three
         grad_slices = []
         for lvl in range(num_levels):
             pos = x_flat * scales[lvl] + 0.5
@@ -232,12 +234,12 @@ def _encode_with_per_level_bwd(
                 upd_cols.append(w[:, None] * g_lvl)
             idx_lvl = jnp.concatenate(idx_cols, axis=0)
             upd_lvl = jnp.concatenate(upd_cols, axis=0)
-            order = jnp.argsort(idx_lvl)
-            grad_slices.append(jax.ops.segment_sum(
-                jnp.take(upd_lvl, order, axis=0),
-                jnp.take(idx_lvl, order),
-                num_segments=int(n_entries), indices_are_sorted=True,
-            ).astype(table.dtype))
+            from ...ops import indexed_row_sum
+
+            grad_slices.append(
+                indexed_row_sum(idx_lvl, upd_lvl, int(n_entries))
+                .astype(table.dtype)
+            )
         return dx, jnp.concatenate(grad_slices, axis=0)
 
     encode.defvjp(fwd, bwd)
@@ -257,7 +259,9 @@ class HashGridEncoder(nn.Module):
     log2_hashmap_size: int = 19
     desired_resolution: int = -1
     bbox: tuple | None = None  # ((lo,)*D, (hi,)*D) world bounds
-    custom_bwd: bool = False  # per-level scatter VJP (see PERF.md round 3)
+    # scatter-free sorted VJP by default: the autodiff backward's scatter
+    # lowering is ~23M rows/s on this chip (PERF.md round 4)
+    custom_bwd: bool = True
 
     @property
     def scale_factor(self) -> float:
@@ -330,5 +334,5 @@ class HashGridEncoder(nn.Module):
             log2_hashmap_size=int(enc_cfg.get("log2_hashmap_size", 19)),
             desired_resolution=int(enc_cfg.get("desired_resolution", -1)),
             bbox=tuple(map(tuple, bbox)) if bbox is not None else None,
-            custom_bwd=bool(enc_cfg.get("custom_bwd", False)),
+            custom_bwd=bool(enc_cfg.get("custom_bwd", True)),
         )

@@ -1,0 +1,348 @@
+"""Cell-packed multiresolution hash grid — the TPU-native redesign of the
+instant-ngp encoder.
+
+Same capability seat as the reference's CUDA hash encoder
+(src/models/encoding/hashencoder/src/hashencoder.cu:99-196 forward,
+254-267 atomicAdd backward), but re-laid-out for what this chip actually
+runs fast (BENCH_PRIMITIVES.jsonl): row gathers cost ~6-9 ns per ROW
+almost independent of row width, and scatter-add is ~23M rows/s. The
+classic corner-shared layout needs 2^D narrow gathers per (point, level)
+forward and 2^D scatter rows backward — measured 6-10 s/step at 4096 rays
+(PERF.md round 3). This layout changes both:
+
+* **One wide gather per (point, level).** Each table row packs ALL 2^D
+  corner values of one CELL (row width 2^D*C floats, 64 B at C=2). The
+  trilinear blend then happens in registers. Forward cost drops 8x by
+  construction: 2^D-fewer gather rows at near-constant per-row cost.
+* **Scatter-free backward.** The table cotangent is per-level
+  ``ops.indexed_row_sum`` (sort + cumsum + merge-extraction) over [N, 8C]
+  update rows — built only from sort/cumsum/gather, the primitives the
+  chip runs at 300-400M rows/s.
+
+The trade (documented, deliberate): cells do NOT share corner entries
+with their neighbours, so the interpolated field is piecewise-trilinear
+with discontinuities at cell faces — the same *kind* of artifact as
+instant-ngp's hash collisions, which its MLP demonstrably learns around;
+tests/test_packed_hash.py pins a procedural-scene PSNR within tolerance
+of the corner-shared encoder, and QUALITY trails carry the measured
+quality side by side. Param budget per level matches the reference rule
+(min(2^log2_hashmap_size, full grid) entries of C floats): a bucket holds
+2^D entries, so levels get min(2^log2/2^D, n_cells^D) buckets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...ops import indexed_row_sum
+from .hashgrid import _PRIMES, normalize_bbox
+
+
+def packed_level_geometry(
+    input_dim: int,
+    num_levels: int,
+    per_level_scale: float,
+    base_resolution: int,
+    log2_hashmap_size: int,
+):
+    """Static per-level constants for the cell-packed layout.
+
+    Returns (offsets [L+1], scales [L], n_cells [L], use_hash [L]):
+    bucket offsets into the flat table, the float grid scale, cells per
+    dim, and whether the level hashes its cell id (static per level, as in
+    hashgrid.level_geometry / hashencoder.cu:56-74).
+    """
+    corners = 1 << input_dim
+    bucket_cap = max(2**log2_hashmap_size // corners, 1)
+    offsets, scales, n_cells_l, use_hash = [0], [], [], []
+    s = float(per_level_scale)
+    for lvl in range(num_levels):
+        scale = 2.0 ** (lvl * np.log2(s)) * base_resolution - 1.0
+        # pos = x*scale + 0.5 in [0.5, scale+0.5] => cell ids 0..ceil(scale)
+        n_cells = int(np.ceil(scale)) + 1
+        dense_buckets = n_cells**input_dim
+        hashed = dense_buckets > bucket_cap
+        if hashed:
+            buckets = max(int(bucket_cap / 8) * 8, 8)
+        else:
+            # round UP: rounding down would alias the top row-major cells
+            # onto buckets 0..k via the modulo — a silent collision on a
+            # level the layout promises is collision-free
+            buckets = max(-(-dense_buckets // 8) * 8, 8)
+        offsets.append(offsets[-1] + buckets)
+        scales.append(float(scale))
+        n_cells_l.append(n_cells)
+        use_hash.append(hashed)
+    return offsets, scales, n_cells_l, use_hash
+
+
+def _cell_index(cell, n_cells: int, buckets: int, hashed: bool):
+    """Bucket id of a cell (pre-offset); dense row-major or XOR-prime hash
+    (hashencoder.cu:56-74 semantics on cells instead of corners)."""
+    d = cell.shape[-1]
+    if not hashed:
+        stride = 1
+        index = jnp.zeros(cell.shape[:-1], jnp.uint32)
+        for dd in range(d):
+            index = index + cell[..., dd].astype(jnp.uint32) * jnp.uint32(stride)
+            stride *= n_cells
+    else:
+        index = jnp.zeros(cell.shape[:-1], jnp.uint32)
+        for dd in range(d):
+            index = index ^ (
+                cell[..., dd].astype(jnp.uint32) * jnp.uint32(_PRIMES[dd])
+            )
+    return (index % jnp.uint32(buckets)).astype(jnp.int32)
+
+
+def _cells_and_weights(x, scale: float, input_dim: int):
+    """cell ids [N, D] int32, corner weights [N, 2^D], frac [N, D]."""
+    pos = x * scale + 0.5
+    cell = jnp.floor(pos)
+    frac = pos - cell
+    cell = cell.astype(jnp.int32)
+    w_cols = []
+    for bits in range(1 << input_dim):
+        w = jnp.ones(x.shape[:-1], x.dtype)
+        for dd in range(input_dim):
+            w = w * (frac[..., dd] if (bits >> dd) & 1 else 1.0 - frac[..., dd])
+        w_cols.append(w)
+    return cell, jnp.stack(w_cols, axis=-1), frac
+
+
+def packed_hash_encode(
+    x: jax.Array,  # [..., D] in [0, 1]
+    table: jax.Array,  # [total_buckets, 2^D * C]
+    input_dim: int,
+    num_levels: int,
+    per_level_scale: float,
+    base_resolution: int,
+    log2_hashmap_size: int,
+    gather_dtype: str = "float32",
+) -> jax.Array:
+    """[..., D] -> [..., L*C]; pure function of (x, table). Plain-autodiff
+    variant (its backward scatters) — production goes through
+    :func:`packed_hash_encode_vjp`.
+
+    ``gather_dtype="bfloat16"`` casts the table ONCE per call and gathers
+    half-width rows (measured +45% row rate at width 16 — the gather cost
+    is per-row but drops when rows pack into fewer tiles). Positions,
+    weights, and the blend stay f32; the f32 master param and its f32
+    cotangent are untouched (the cast is inside the traced fn).
+    """
+    offsets, scales, n_cells_l, use_hash = packed_level_geometry(
+        input_dim, num_levels, per_level_scale, base_resolution,
+        log2_hashmap_size,
+    )
+    corners = 1 << input_dim
+    c = table.shape[-1] // corners
+    tab_g = table.astype(jnp.dtype(gather_dtype))
+    batch_shape = x.shape[:-1]
+    if len(batch_shape) != 1:
+        x = x.reshape(-1, input_dim)
+    n = x.shape[0]
+    outs = []
+    for lvl in range(num_levels):
+        cell, w, _ = _cells_and_weights(x, scales[lvl], input_dim)
+        idx = _cell_index(
+            cell, n_cells_l[lvl], offsets[lvl + 1] - offsets[lvl],
+            use_hash[lvl],
+        )
+        row = jnp.take(tab_g, idx + offsets[lvl], axis=0)  # [N, 2^D*C]
+        vals = row.reshape(n, corners, c)
+        outs.append(jnp.sum(w[..., None] * vals.astype(w.dtype), axis=1))
+    out = jnp.concatenate(outs, axis=-1)
+    if len(batch_shape) != 1:
+        out = out.reshape(*batch_shape, out.shape[-1])
+    return out
+
+
+def packed_hash_encode_vjp(
+    x: jax.Array,
+    table: jax.Array,
+    input_dim: int,
+    num_levels: int,
+    per_level_scale: float,
+    base_resolution: int,
+    log2_hashmap_size: int,
+    gather_dtype: str = "float32",
+) -> jax.Array:
+    """packed_hash_encode with the scatter-free custom backward.
+
+    dtable: per-level ``indexed_row_sum`` over [N, 2^D*C] update rows
+    (outer(corner weights, level cotangent)) — sorts and gathers only,
+    accumulated in f32 regardless of ``gather_dtype``.
+    dx: exact, via the corner-weight derivative against re-gathered rows;
+    when x carries no gradient path (rays are data), XLA DCE prunes it.
+    """
+    static = (input_dim, num_levels, per_level_scale, base_resolution,
+              log2_hashmap_size, gather_dtype)
+
+    @jax.custom_vjp
+    def encode(x, table):
+        return packed_hash_encode(x, table, *static)
+
+    def fwd(x, table):
+        return encode(x, table), (x, table)
+
+    def bwd(res, g):
+        x, table = res
+        offsets, scales, n_cells_l, use_hash = packed_level_geometry(
+            *static[:5]
+        )
+        corners = 1 << input_dim
+        c = table.shape[-1] // corners
+        batch_shape = x.shape[:-1]
+        if len(batch_shape) != 1:
+            x_flat = x.reshape(-1, input_dim)
+            g_flat = g.reshape(-1, g.shape[-1])
+        else:
+            x_flat, g_flat = x, g
+        n = x_flat.shape[0]
+        g_flat = g_flat.astype(jnp.float32)
+
+        grad_slices = []
+        # per-dim accumulators, stacked at the end (an .at[:, d].add here
+        # would lower to the very scatter this backward exists to avoid)
+        dx_cols = [jnp.zeros((n,), jnp.float32) for _ in range(input_dim)]
+        for lvl in range(num_levels):
+            buckets = offsets[lvl + 1] - offsets[lvl]
+            cell, w, frac = _cells_and_weights(
+                x_flat.astype(jnp.float32), scales[lvl], input_dim
+            )
+            idx = _cell_index(cell, n_cells_l[lvl], buckets, use_hash[lvl])
+            g_lvl = g_flat[:, lvl * c:(lvl + 1) * c]  # [N, C]
+            # dtable rows: outer(w, g_lvl) -> [N, 2^D * C]
+            upd = (w[:, :, None] * g_lvl[:, None, :]).reshape(n, corners * c)
+            grad_slices.append(indexed_row_sum(idx, upd, int(buckets)))
+
+            # dx: d(out_l)/dx_d = scale * sum_b sign_b(d) *
+            #     prod_{d' != d} wfac_b(d') * <row_b, g_lvl>
+            row = jnp.take(
+                table.astype(jnp.dtype(gather_dtype)),
+                idx + offsets[lvl], axis=0,
+            )
+            vals = row.reshape(n, corners, c).astype(jnp.float32)
+            rowg = jnp.sum(vals * g_lvl[:, None, :], axis=-1)  # [N, 2^D]
+            for dd in range(input_dim):
+                acc = jnp.zeros((n,), jnp.float32)
+                for bits in range(corners):
+                    f = jnp.ones((n,), jnp.float32)
+                    for d2 in range(input_dim):
+                        if d2 == dd:
+                            continue
+                        f = f * (frac[..., d2] if (bits >> d2) & 1
+                                 else 1.0 - frac[..., d2])
+                    sign = 1.0 if (bits >> dd) & 1 else -1.0
+                    acc = acc + sign * f * rowg[:, bits]
+                dx_cols[dd] = dx_cols[dd] + scales[lvl] * acc
+
+        dx = jnp.stack(dx_cols, axis=-1)
+        dtable = jnp.concatenate(grad_slices, axis=0).astype(table.dtype)
+        dx = dx.astype(x.dtype)
+        if len(batch_shape) != 1:
+            dx = dx.reshape(*batch_shape, input_dim)
+        return dx, dtable
+
+    encode.defvjp(fwd, bwd)
+    return encode(x, table)
+
+
+class PackedHashGridEncoder(nn.Module):
+    """Flax module owning the cell-packed embedding table (uniform +-1e-4
+    init, matching hashgrid.py:184-186's convention), world-bounds
+    normalization to [0, 1]. Drop-in for HashGridEncoder: same config
+    knobs, same out_dim."""
+
+    input_dim: int = 3
+    num_levels: int = 16
+    level_dim: int = 2
+    per_level_scale: float = 2.0
+    base_resolution: int = 16
+    log2_hashmap_size: int = 19
+    desired_resolution: int = -1
+    bbox: tuple | None = None
+    custom_bwd: bool = True  # scatter-free VJP (the point of this layout)
+    gather_dtype: str = "float32"  # "bfloat16": half-width gather rows
+
+    @property
+    def scale_factor(self) -> float:
+        if self.desired_resolution != -1:
+            return float(
+                2.0
+                ** (
+                    np.log2(self.desired_resolution / self.base_resolution)
+                    / (self.num_levels - 1)
+                )
+            )
+        return float(self.per_level_scale)
+
+    @property
+    def out_dim(self) -> int:
+        return self.num_levels * self.level_dim
+
+    @property
+    def n_buckets(self) -> int:
+        offsets, _, _, _ = packed_level_geometry(
+            self.input_dim, self.num_levels, self.scale_factor,
+            self.base_resolution, self.log2_hashmap_size,
+        )
+        return offsets[-1]
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        table = self.param(
+            "embeddings",
+            lambda key, shape: jax.random.uniform(
+                key, shape, jnp.float32, -1e-4, 1e-4
+            ),
+            (self.n_buckets, (1 << self.input_dim) * self.level_dim),
+        )
+        if self.bbox is not None:
+            x = normalize_bbox(x, self.bbox)
+        else:
+            x = jnp.clip(x, 0.0, 1.0)
+        encode = (packed_hash_encode_vjp if self.custom_bwd
+                  else packed_hash_encode)
+        return encode(
+            x,
+            table,
+            self.input_dim,
+            self.num_levels,
+            self.scale_factor,
+            self.base_resolution,
+            self.log2_hashmap_size,
+            self.gather_dtype,
+        )
+
+    @classmethod
+    def from_cfg(cls, enc_cfg, precision=None) -> "PackedHashGridEncoder":
+        bbox = enc_cfg.get("bbox", None)
+        if bbox is None:
+            raise ValueError(
+                "hashgrid_packed encoder config needs "
+                "'bbox: [[lo...],[hi...]]' world bounds for [0,1] "
+                "normalization"
+            )
+        # gather rows follow the compute dtype unless pinned explicitly:
+        # a bf16 training step should not pay f32-width gather tiles
+        gather_dtype = str(enc_cfg.get(
+            "gather_dtype",
+            (precision or {}).get("compute_dtype", "float32"),
+        ))
+        return cls(
+            input_dim=int(enc_cfg.get("input_dim", 3)),
+            num_levels=int(enc_cfg.get("num_levels", 16)),
+            level_dim=int(enc_cfg.get("level_dim", 2)),
+            per_level_scale=float(enc_cfg.get("per_level_scale", 2.0)),
+            base_resolution=int(enc_cfg.get("base_resolution", 16)),
+            log2_hashmap_size=int(enc_cfg.get("log2_hashmap_size", 19)),
+            desired_resolution=int(enc_cfg.get("desired_resolution", -1)),
+            bbox=tuple(map(tuple, bbox)),
+            custom_bwd=bool(enc_cfg.get("custom_bwd", True)),
+            gather_dtype=gather_dtype,
+        )

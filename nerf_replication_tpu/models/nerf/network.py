@@ -28,6 +28,41 @@ import jax.numpy as jnp
 from ..encoding import get_encoder
 
 
+class SplitDense(nn.Module):
+    """``Dense(features)`` over ``concat([x1, x2], -1)`` WITHOUT the concat.
+
+    Param name/shape/init are identical to ``nn.Dense`` on the concatenated
+    input (kernel ``[c1+c2, features]``, lecun_normal; zero bias), so
+    checkpoints and the Keras import are byte-compatible — but the forward
+    is ``x1 @ k[:c1] + x2 @ k[c1:] + b``: the ``[N, S, c1+c2]`` concat
+    buffer (and its cotangent in the backward) never exists. PERF.md f3:
+    the flagship step is HBM-bound and the 262k-ray OOM dump showed XLA
+    materializing exactly these buffers at 9 GB scale.
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x1: jax.Array, x2: jax.Array) -> jax.Array:
+        c1, c2 = x1.shape[-1], x2.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (c1 + c2, self.features), self.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(),
+            (self.features,), self.param_dtype,
+        )
+        k = kernel.astype(self.dtype)
+        return (
+            x1.astype(self.dtype) @ k[:c1]
+            + x2.astype(self.dtype) @ k[c1:]
+            + bias.astype(self.dtype)
+        )
+
+
 class NeRFMLP(nn.Module):
     """The original-paper NeRF MLP over pre-embedded inputs."""
 
@@ -49,17 +84,30 @@ class NeRFMLP(nn.Module):
             param_dtype=self.param_dtype,
             name=name,
         )
+        split_dense = lambda feats, name: SplitDense(  # noqa: E731
+            feats,
+            dtype=self.compute_dtype,
+            param_dtype=self.param_dtype,
+            name=name,
+        )
         input_pts = embedded[..., : self.input_ch]
         input_views = embedded[..., self.input_ch :]
 
         h = input_pts.astype(self.compute_dtype)
+        pending_skip = None  # re-injected input feeding the NEXT layer
         for i in range(self.D):
-            h = dense(self.W, f"pts_linear_{i}")(h)
+            if pending_skip is not None:
+                h = split_dense(self.W, f"pts_linear_{i}")(pending_skip, h)
+                pending_skip = None
+            else:
+                h = dense(self.W, f"pts_linear_{i}")(h)
             h = nn.relu(h)
             if i in self.skips:
-                h = jnp.concatenate(
-                    [input_pts.astype(self.compute_dtype), h], axis=-1
-                )
+                pending_skip = input_pts.astype(self.compute_dtype)
+        if pending_skip is not None:
+            # skip at the last trunk layer: the heads genuinely consume the
+            # concatenated width — materialize only in that config
+            h = jnp.concatenate([pending_skip, h], axis=-1)
 
         if self.use_viewdirs:
             # density head reads the trunk directly (network.py:60);
@@ -68,10 +116,11 @@ class NeRFMLP(nn.Module):
                 h.astype(jnp.float32)
             )
             feature = dense(self.W, "feature_linear")(h)
-            h = jnp.concatenate(
-                [feature, input_views.astype(self.compute_dtype)], axis=-1
+            h = nn.relu(
+                split_dense(self.W // 2, "views_linear_0")(
+                    feature, input_views.astype(self.compute_dtype)
+                )
             )
-            h = nn.relu(dense(self.W // 2, "views_linear_0")(h))
             rgb = nn.Dense(3, param_dtype=self.param_dtype, name="rgb_linear")(
                 h.astype(jnp.float32)
             )
@@ -130,7 +179,9 @@ class Network(nn.Module):
 
 def make_network(cfg) -> Network:
     """Build the Network module from the reference-schema config."""
-    xyz_enc, input_ch = get_encoder(cfg.network.xyz_encoder)
+    xyz_enc, input_ch = get_encoder(
+        cfg.network.xyz_encoder, cfg.get("precision", {})
+    )
     use_viewdirs = bool(cfg.task_arg.use_viewdirs)
     if use_viewdirs:
         dir_enc, input_ch_views = get_encoder(cfg.network.dir_encoder)

@@ -1,0 +1,8 @@
+"""TPU-native data-movement ops built from the primitives this chip runs
+fast (measured, BENCH_PRIMITIVES.jsonl): sort ~330M rows/s, cumsum ~420M
+rows/s, row gather ~120-170M rows/s — versus scatter-add at ~23M rows/s
+regardless of sorted/unique hints. Everything here is scatter-free."""
+
+from .histogram import indexed_row_sum
+
+__all__ = ["indexed_row_sum"]

@@ -46,7 +46,14 @@ def register_alias(name: str, target: str) -> None:
 
 
 def resolve_module(name: str) -> ModuleType:
-    """Resolve a ``*_module`` config string to an imported module."""
+    """Resolve a ``*_module`` config string to an imported module.
+
+    A value ending in ``.py`` is loaded from that FILE PATH — the seat of
+    the reference's ``imp.load_source`` (make_dataset.py:16-29), which lets
+    a task plugin live OUTSIDE the package tree and still be selected from
+    YAML. Loaded path-modules are cached by absolute path."""
+    if name.endswith(".py"):
+        return _load_from_path(name)
     target = _ALIASES.get(name, name)
     try:
         return importlib.import_module(target)
@@ -61,6 +68,32 @@ def resolve_module(name: str) -> ModuleType:
         raise ImportError(
             f"Cannot resolve plugin module {name!r} (tried {target!r})"
         ) from e
+
+
+_PATH_MODULES: dict[str, ModuleType] = {}
+
+
+def _load_from_path(path: str) -> ModuleType:
+    import importlib.util
+    import os
+
+    key = os.path.abspath(path)
+    mod = _PATH_MODULES.get(key)
+    if mod is not None:
+        return mod
+    if not os.path.isfile(key):
+        raise ImportError(f"Plugin file {path!r} does not exist")
+    modname = "_nerf_plugin_" + os.path.splitext(os.path.basename(key))[0]
+    spec = importlib.util.spec_from_file_location(modname, key)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec so plugin-defined classes are re-importable by
+    # name (pickle, dataclass machinery) — the standard importlib recipe
+    import sys
+
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    _PATH_MODULES[key] = mod
+    return mod
 
 
 def load_attr(module_name: str, attr: str, *fallbacks: str) -> Any:

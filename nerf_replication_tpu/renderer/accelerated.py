@@ -67,8 +67,14 @@ def march_rays_accelerated(
     grid: jax.Array,
     bbox: jax.Array,
     options: MarchOptions,
+    return_samples: bool = False,
 ) -> dict:
-    """Render a [N, 6] ray chunk with ESS + ERT. near/far/options are static."""
+    """Render a [N, 6] ray chunk with ESS + ERT. near/far/options are static.
+
+    ``return_samples`` adds the per-sample march internals the NGP trainer's
+    live grid maintenance feeds on (train/ngp.py): ``sample_flat`` [N, K]
+    int32 flat voxel ids, ``sample_sigma`` [N, K], ``sample_valid`` [N, K]
+    bool — gradients stopped (grid maintenance must not backprop)."""
     import math
 
     if rays.shape[-1] > 6:
@@ -136,9 +142,16 @@ def march_rays_accelerated(
     # "still alive over an occupied voxel" and would inflate a scalar count).
     n_occ = jnp.sum(occupied, axis=-1)
     still_alive = trans[:, -1] >= options.transmittance_threshold
-    return {
+    out = {
         "rgb_map_f": rgb_map,
         "depth_map_f": depth_map,
         "acc_map_f": acc_map,
         "truncated": (n_occ > k) & still_alive,
     }
+    if return_samples:
+        out["sample_flat"] = jax.lax.stop_gradient(
+            jnp.take_along_axis(flat, order, axis=-1).astype(jnp.int32)
+        )
+        out["sample_sigma"] = jax.lax.stop_gradient(sigma)
+        out["sample_valid"] = valid
+    return out

@@ -25,6 +25,58 @@ def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
+# -- param-tree key surgery (net_utils.py:382-415) ---------------------------
+# The reference ships flat state-dict key remappers so checkpoints trained
+# under a different module nesting (a wrapper prefix, a renamed branch) can
+# still be loaded. Same capability on pytrees: operate on "/"-joined paths.
+
+def _flatten(params):
+    from flax.traverse_util import flatten_dict
+
+    return flatten_dict(params, sep="/")
+
+
+def _unflatten(flat):
+    from flax.traverse_util import unflatten_dict
+
+    return unflatten_dict(flat, sep="/")
+
+
+def remove_param_prefix(params, prefix: str):
+    """Strip ``prefix`` from every matching "/"-joined param path
+    (net_utils.py:382-389)."""
+    flat = _flatten(params)
+    return _unflatten({
+        (k[len(prefix):] if k.startswith(prefix) else k): v
+        for k, v in flat.items()
+    })
+
+
+def add_param_prefix(params, prefix: str):
+    """Prepend ``prefix`` to every param path (net_utils.py:392-396)."""
+    return _unflatten({prefix + k: v for k, v in _flatten(params).items()})
+
+
+def replace_param_prefix(params, orig_prefix: str, prefix: str):
+    """Rewrite ``orig_prefix`` → ``prefix`` on matching param paths
+    (net_utils.py:399-406)."""
+    flat = _flatten(params)
+    return _unflatten({
+        (prefix + k[len(orig_prefix):] if k.startswith(orig_prefix) else k): v
+        for k, v in flat.items()
+    })
+
+
+def remove_param_layers(params, layers):
+    """Drop every param whose path starts with one of ``layers``
+    (net_utils.py:409-415) — e.g. heads excluded from a warm start."""
+    flat = _flatten(params)
+    return _unflatten({
+        k: v for k, v in flat.items()
+        if not any(k.startswith(layer) for layer in layers)
+    })
+
+
 def _bundle(state, epoch: int, recorder_state: dict | None):
     rs = recorder_state or {}
     return {

@@ -29,12 +29,24 @@ designed for XLA rather than translated from the CUDA original
   coarse→fine sampling is what the grid replaces); eval goes through the
   accelerated march with the live grid.
 
+Round 4 (VERDICT r3 #5): the grid now carves from the densities the march
+ACTUALLY SAMPLES on training rays (scatter-max of the compacted [N, K]
+sigmas into their cells, subsampled to ``ngp_sample_update_cap`` rows) in
+addition to the random-cell refresh — visible matter is refreshed every
+step it is trained on, so the warm start can sit just above threshold
+(``ngp_grid_warm_factor``, default 2.0) and empty space decays below
+threshold within ~half an update-decay half-life instead of round 3's
+~27 windows. ``fit_ngp`` is the production epoch-loop entry (train.py
+routes ``task_arg.ngp_training: true`` here), with scan-burst support.
+
 Config keys (all under ``task_arg``): ``ngp_training: true`` switches
-scripts/quality_run.py onto this trainer; ``ngp_grid_res`` (64),
-``ngp_grid_decay`` (0.95 per ``ngp_grid_update_every``-step window, applied
-continuously), ``ngp_grid_update_every`` (16), ``ngp_density_threshold``
-(0.01), plus the shared march knobs ``render_step_size`` /
-``max_march_samples`` / ``transmittance_threshold``.
+train.py / scripts/quality_run.py onto this trainer; ``ngp_grid_res``
+(64), ``ngp_grid_decay`` (0.95 per ``ngp_grid_update_every``-step window,
+applied continuously), ``ngp_grid_update_every`` (16),
+``ngp_density_threshold`` (0.01), ``ngp_grid_warm_factor`` (2.0),
+``ngp_sample_update_cap`` (65536), ``scan_steps``, plus the shared march
+knobs ``render_step_size`` / ``max_march_samples`` /
+``transmittance_threshold``.
 """
 
 from __future__ import annotations
@@ -78,8 +90,15 @@ class NGPTrainer:
         self.decay_step = float(decay_window ** (1.0 / update_every))
         # cells refreshed per step: full-grid coverage every update window
         self.cells_per_step = max(self.grid_res**3 // update_every, 1)
+        # warm start just above threshold: ray-sampled refreshes keep
+        # visible matter alive, so empty space only needs
+        # log(warm)/log(1/decay) windows to fall through the threshold
+        self.warm_factor = float(ta.get("ngp_grid_warm_factor", 2.0))
+        self.sample_update_cap = int(ta.get("ngp_sample_update_cap", 65536))
+        self.scan_steps = max(1, int(ta.get("scan_steps", 1)))
         self.process_index = jax.process_index()
         self._step_fn = None
+        self._multi_step_fns: dict = {}
         self._render_fns: dict = {}
 
     # -- state ---------------------------------------------------------------
@@ -94,9 +113,12 @@ class NGPTrainer:
     def init_state(self, params, tx) -> NGPTrainState:
         """Grid starts fully occupied (ema above threshold ⇒ dense march)
         so the first steps have gradients everywhere; decay + live updates
-        then carve out the empty space."""
+        then carve out the empty space. The warm factor sits deliberately
+        LOW (just above threshold): training-ray sample refreshes keep real
+        matter occupied while empty cells fall through quickly."""
         ema0 = jnp.full(
-            (self.grid_res,) * 3, 4.0 * self.threshold, jnp.float32
+            (self.grid_res,) * 3, self.warm_factor * self.threshold,
+            jnp.float32,
         )
         return NGPTrainState.create(
             apply_fn=self.network.apply, params=params, tx=tx,
@@ -120,8 +142,9 @@ class NGPTrainer:
             )
             return jax.checkpoint(fn, static_argnums=(2,)) if remat else fn
 
-        @partial(jax.jit, donate_argnums=(0,))
-        def step_fn(state, bank_rays, bank_rgbs, base_key):
+        sample_cap = self.sample_update_cap
+
+        def one_step(state, bank_rays, bank_rgbs, base_key):
             key = sample_step_key(base_key, state.step, process_index)
             k_sample, k_cells, k_jitter = jax.random.split(key, 3)
             rays, rgbs = sample_rays(k_sample, bank_rays, bank_rgbs, n_rays)
@@ -130,10 +153,11 @@ class NGPTrainer:
 
             def loss_fn(p):
                 out = march_rays_accelerated(
-                    apply_fn_for(p), rays, near, far, grid, bbox, options
+                    apply_fn_for(p), rays, near, far, grid, bbox, options,
+                    return_samples=True,
                 )
                 l = mse(out["rgb_map_f"], rgbs)
-                return l, {
+                return l, (out, {
                     "loss": l,
                     "psnr": mse_to_psnr(l),
                     "occupancy": jnp.mean(grid.astype(jnp.float32)),
@@ -142,16 +166,33 @@ class NGPTrainer:
                     "truncated_frac": jnp.mean(
                         out["truncated"].astype(jnp.float32)
                     ),
-                }
+                })
 
-            (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
-            )
+            (_, (out, stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
             new_state = state.apply_gradients(grads=grads)
 
-            # grid maintenance: decay everywhere, scatter-max a random cell
-            # subsample with the LIVE network's density at a jittered point
-            # inside each cell (stop_gradient: maintenance must not backprop)
+            ema = state.grid_ema.reshape(-1) * decay
+
+            # carve from what training actually SAMPLED: scatter-max the
+            # march's compacted sigmas into their cells (stop_gradient'd by
+            # the march; subsampled by a static stride to bound the
+            # ~23M rows/s scatter cost). Cells with visible matter refresh
+            # every step they are trained on — this is what lets the warm
+            # start sit just above threshold and empty space carve fast.
+            s_flat = out["sample_flat"].reshape(-1)
+            s_sigma = (out["sample_sigma"]
+                       * out["sample_valid"]).reshape(-1)
+            stride = max(1, int(np.ceil(s_flat.shape[0] / sample_cap)))
+            if stride > 1:
+                s_flat = s_flat[::stride]
+                s_sigma = s_sigma[::stride]
+            ema = ema.at[s_flat].max(s_sigma)
+
+            # exploration refresh: random cells probed with the LIVE
+            # network at a jittered point (matter occluded on training rays
+            # must still be discoverable)
             idx = jax.random.randint(
                 k_cells, (n_cells,), 0, res * res * res
             )
@@ -168,17 +209,40 @@ class NGPTrainer:
                 pts[:, None, :], dirs, model="fine",
             )
             sigma = jax.nn.relu(raw[..., 0, 3])
-            ema = state.grid_ema.reshape(-1) * decay
             ema = ema.at[idx].max(sigma)
             new_state = new_state.replace(grid_ema=ema.reshape(res, res, res))
             return new_state, stats
+
+        return one_step
+
+    def _jit_step(self, k_steps: int):
+        from .step_core import scan_k_steps
+
+        one_step = self._build_step()
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step_fn(state, bank_rays, bank_rgbs, base_key):
+            return scan_k_steps(
+                lambda st: one_step(st, bank_rays, bank_rgbs, base_key),
+                state, k_steps,
+            )
 
         return step_fn
 
     def step(self, state, bank_rays, bank_rgbs, base_key):
         if self._step_fn is None:
-            self._step_fn = self._build_step()
+            self._step_fn = self._jit_step(1)
         return self._step_fn(state, bank_rays, bank_rgbs, base_key)
+
+    def multi_step(self, state, bank_rays, bank_rgbs, base_key, k_steps=None):
+        """K optimizer steps (incl. grid maintenance) in one dispatch."""
+        k = int(k_steps if k_steps is not None else self.scan_steps)
+        if k <= 1:
+            return self.step(state, bank_rays, bank_rgbs, base_key)
+        fn = self._multi_step_fns.get(k)
+        if fn is None:
+            fn = self._multi_step_fns[k] = self._jit_step(k)
+        return fn(state, bank_rays, bank_rgbs, base_key)
 
     # -- eval ----------------------------------------------------------------
     def val(self, state, test_dataset, evaluator, max_images=None, log=print):
@@ -252,3 +316,128 @@ class NGPTrainer:
 
 def make_ngp_trainer(cfg, network) -> NGPTrainer:
     return NGPTrainer(cfg, network)
+
+
+def fit_ngp(cfg, network=None, log=print):
+    """Epoch-loop training entry for ``task_arg.ngp_training: true`` —
+    the occupancy-accelerated counterpart of trainer.fit (train.py routes
+    here), with the same resume/save/eval cadence contract.
+
+    Multi-device NGP is not wired yet: the live grid EMA needs a pmax
+    merge across data shards; refused loudly rather than silently training
+    one chip of a pod (set parallel.data_axis: 1 to opt out)."""
+    import time
+
+    import jax
+
+    from ..datasets import make_dataset
+    from ..evaluators import make_evaluator
+    from ..parallel.collectives import barrier
+    from ..parallel.mesh import is_chief, multihost_init
+    from ..utils.setup import configure_runtime
+    from .checkpoint import load_model, save_model, save_trained_config
+    from .recorder import make_recorder
+
+    multihost_init(cfg)
+    configure_runtime(cfg)
+    par = cfg.get("parallel", {})
+    if jax.device_count() > 1 and (
+        int(par.get("data_axis", -1)) != 1
+        or int(par.get("model_axis", 1)) > 1
+    ):
+        raise NotImplementedError(
+            "ngp_training over a device mesh is not wired yet (the live "
+            "grid EMA needs a cross-shard pmax); set parallel.data_axis 1 "
+            "(and model_axis 1) to train single-device, or use the "
+            "hierarchical trainer"
+        )
+
+    if network is None:
+        from ..models import make_network
+
+        network = make_network(cfg)
+
+    trainer = NGPTrainer(cfg, network)
+    evaluator = None if cfg.get("skip_eval", False) else make_evaluator(cfg)
+    recorder = make_recorder(cfg)
+
+    seed = int(cfg.get("seed", 0))
+    key = jax.random.PRNGKey(seed)
+    k_init, base_key = jax.random.split(key)
+    state, schedule = trainer.make_state(k_init)
+
+    begin_epoch = 0
+    if cfg.get("resume", True):
+        state, begin_epoch, rec_state = load_model(
+            cfg.trained_model_dir, state
+        )
+        if rec_state:
+            recorder.load_state_dict(rec_state)
+    if begin_epoch == 0 and cfg.get("pretrain", ""):
+        from .checkpoint import load_pretrain
+
+        params, ok = load_pretrain(cfg.pretrain, {"params": state.params})
+        if ok:
+            state = state.replace(params=params["params"])
+    if is_chief():
+        save_trained_config(cfg)
+
+    train_ds = make_dataset(cfg, "train")
+    test_ds = make_dataset(cfg, "test")
+    bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+
+    epochs = int(cfg.train.epoch)
+    ep_iter = int(cfg.get("ep_iter", 500))
+    if ep_iter <= 0:
+        ep_iter = max(1, int(bank[0].shape[0]) // trainer.n_rays)
+    save_ep = int(cfg.get("save_ep", 40))
+    save_latest_ep = int(cfg.get("save_latest_ep", 10))
+    eval_ep = int(cfg.get("eval_ep", 10))
+    log_interval = int(cfg.get("log_interval", 20))
+
+    for epoch in range(begin_epoch, epochs):
+        recorder.epoch = epoch
+        host_step = int(state.step)
+        it = 0
+        end = time.time()
+        while it < ep_iter:
+            k = min(trainer.scan_steps, ep_iter - it)
+            state, stats = trainer.multi_step(
+                state, bank[0], bank[1], base_key, k
+            )
+            host_step += k
+            should_log = (
+                it == 0
+                or (it + k - 1) // log_interval > (it - 1) // log_interval
+                or it + k >= ep_iter
+            )
+            recorder.step = host_step
+            recorder.batch_time.update((time.time() - end) / k)
+            recorder.data_time.update(0.0)
+            end = time.time()
+            if should_log:
+                recorder.update_loss_stats(
+                    {kk: float(v) for kk, v in stats.items()}
+                )
+                lr = float(schedule(host_step))
+                log(recorder.console_line(
+                    epoch, min(it + k - 1, ep_iter - 1), ep_iter, lr, None
+                ))
+                recorder.record("train")
+            it += k
+        chief = is_chief()
+        saving = (epoch + 1) % save_ep == 0 or (epoch + 1) % save_latest_ep == 0
+        if saving:
+            barrier("pre_save")
+            if chief and (epoch + 1) % save_ep == 0:
+                save_model(cfg.trained_model_dir, state, epoch,
+                           recorder.state_dict(), latest=False)
+            if chief and (epoch + 1) % save_latest_ep == 0:
+                save_model(cfg.trained_model_dir, state, epoch,
+                           recorder.state_dict(), latest=True)
+            barrier("post_save")
+        if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
+            result = trainer.val(state, test_ds, evaluator, log=log)
+            if result:
+                recorder.record("val", step=epoch, stats=result)
+    return state

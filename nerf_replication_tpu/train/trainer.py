@@ -324,18 +324,11 @@ def fit(cfg, network=None, log=print):
     from .recorder import make_recorder
 
     if bool(cfg.task_arg.get("ngp_training", False)):
-        # the epoch-loop entry drives the hierarchical Trainer; silently
-        # training the wrong path under an NGP config would be worse than
-        # refusing. Config-only check, so it fires BEFORE multihost_init
-        # joins the (possibly blocking) pod barrier. NGP training currently
-        # runs through its own drivers.
-        raise NotImplementedError(
-            "task_arg.ngp_training is not wired into the epoch-loop entry "
-            "yet — run occupancy-accelerated training via "
-            "scripts/quality_run.py ... task_arg.ngp_training true, or "
-            "drive train.ngp.NGPTrainer directly (scripts/bench_ngp.py "
-            "shows the loop)"
-        )
+        # occupancy-accelerated training has its own state (live grid EMA)
+        # and march; same entry contract, separate epoch loop (ngp.py)
+        from .ngp import fit_ngp
+
+        return fit_ngp(cfg, network=network, log=log)
 
     # multi-host runtime first (parity: NCCL process-group init,
     # reference train.py:116-120)

@@ -1,0 +1,115 @@
+"""Equal-steps quality A/B: corner-shared hashgrid vs cell-packed layout.
+
+The packed layout (models/encoding/packed_hash.py) trades corner sharing
+for TPU-fast gathers — the field is piecewise-trilinear per cell. This
+script measures what that trade costs in dB: both arms train the SAME
+scene, seed, step count, and MLP; only the encoder type differs. Appends
+one JSON line per arm to --out.
+
+    python scripts/ab_encoder_quality.py --steps 400 [--H 100]
+        [--force_platform cpu] [--out AB_ENCODER.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--H", type=int, default=100)
+    p.add_argument("--views", type=int, default=30)
+    p.add_argument("--test_views", type=int, default=2)
+    p.add_argument("--n_rays", type=int, default=1024)
+    p.add_argument("--scene_root", default="data/ab_encoder_scene")
+    p.add_argument("--arms", nargs="+",
+                   default=["lego_hash.yaml", "lego_hash_packed.yaml"])
+    p.add_argument("--out", default="AB_ENCODER.jsonl")
+    p.add_argument("--force_platform", default=os.environ.get(
+        "BENCH_FORCE_PLATFORM", ""))
+    p.add_argument("opts", nargs="*", default=[])
+    args = p.parse_args(argv)
+
+    from nerf_replication_tpu.utils.platform import (
+        enable_compilation_cache,
+        setup_backend,
+    )
+
+    setup_backend(args.force_platform)
+    enable_compilation_cache()
+
+    import jax
+
+    from nerf_replication_tpu.config import make_cfg
+    from nerf_replication_tpu.datasets import make_dataset
+    from nerf_replication_tpu.datasets.procedural import ensure_scene
+    from nerf_replication_tpu.evaluators import make_evaluator
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.train import make_loss, make_train_state
+    from nerf_replication_tpu.train.trainer import Trainer
+
+    ensure_scene(args.scene_root, scene="procedural", H=args.H, W=args.H,
+                 n_train=args.views, n_test=args.test_views)
+
+    out_f = open(args.out, "a")
+    for arm in args.arms:
+        cfg = make_cfg(
+            os.path.join(_REPO, "configs", "nerf", arm),
+            [
+                "scene", "procedural",
+                "train_dataset.data_root", args.scene_root,
+                "test_dataset.data_root", args.scene_root,
+                "train_dataset.H", str(args.H), "train_dataset.W", str(args.H),
+                "test_dataset.H", str(args.H), "test_dataset.W", str(args.H),
+                "test_dataset.cams", "[0, -1, 1]",
+                "task_arg.N_rays", str(args.n_rays),
+                "task_arg.precrop_iters", "0",
+                *args.opts,
+            ],
+        )
+        network = make_network(cfg)
+        loss = make_loss(cfg, network)
+        evaluator = make_evaluator(cfg)
+        trainer = Trainer(cfg, network, loss, evaluator)
+        state, _ = make_train_state(cfg, network, jax.random.PRNGKey(0))
+        train_ds = make_dataset(cfg, "train")
+        test_ds = make_dataset(cfg, "test")
+        bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+        key = jax.random.PRNGKey(1)
+
+        t0 = time.time()
+        for _ in range(args.steps):
+            state, stats = trainer.step(state, bank[0], bank[1], key)
+        jax.block_until_ready(stats)
+        dt = time.time() - t0
+
+        result = trainer.val(
+            state, epoch=args.steps, test_dataset=test_ds,
+            max_images=args.test_views,
+        )
+        rec = {
+            "arm": arm,
+            "steps": args.steps,
+            "n_rays": args.n_rays,
+            "H": args.H,
+            "psnr": round(float(result.get("psnr", 0.0)), 3),
+            "ssim": round(float(result.get("ssim", 0.0)), 4),
+            "train_s": round(dt, 1),
+            "ts": round(time.time(), 1),
+        }
+        print(json.dumps(rec), flush=True)
+        out_f.write(json.dumps(rec) + "\n")
+        out_f.flush()
+    out_f.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,8 @@ def main(argv=None):
     p.add_argument("--n_rays", type=int, default=4096)
     p.add_argument("--scene_root", default="data/bench_ngp_scene")
     p.add_argument("--arms", nargs="+", default=["std", "ngp"])
+    p.add_argument("--config", default="lego_hash.yaml",
+                   help="config under configs/nerf/ for both arms")
     p.add_argument("--out", default="BENCH_NGP.jsonl")
     p.add_argument("--force_platform", default=os.environ.get(
         "BENCH_FORCE_PLATFORM", ""))
@@ -66,7 +68,7 @@ def main(argv=None):
 
     def build_cfg(extra):
         return make_cfg(
-            os.path.join(_REPO, "configs", "nerf", "lego_hash.yaml"),
+            os.path.join(_REPO, "configs", "nerf", args.config),
             [
                 "scene", scene,
                 "train_dataset.data_root", args.scene_root,
@@ -141,7 +143,7 @@ def main(argv=None):
             "t_s": round(dt, 1),
             "psnr": round(float(result.get("psnr", 0.0)), 3),
             "ssim": round(float(result.get("ssim", 0.0)), 4),
-            "config": "lego_hash.yaml",
+            "config": args.config,
             "n_rays": args.n_rays,
             "ts": round(time.time(), 1),
         }

@@ -355,8 +355,11 @@ def test_per_level_bwd_matches_autodiff():
         gx_new, gt_new = jax.grad(
             loss(_encode_with_per_level_bwd), argnums=(0, 1)
         )(x, table)
+        # the sorted histogram computes each entry as a difference of two
+        # f32 prefix sums: worst-case absolute error ~eps * |prefix|
+        # (ops/histogram.py), so tolerance is absolute-dominated here
         np.testing.assert_allclose(
-            np.asarray(gt_ref), np.asarray(gt_new), rtol=1e-5, atol=1e-6
+            np.asarray(gt_ref), np.asarray(gt_new), rtol=1e-4, atol=5e-6
         )
         np.testing.assert_allclose(
             np.asarray(gx_ref), np.asarray(gx_new), rtol=1e-5, atol=1e-6

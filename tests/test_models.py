@@ -111,3 +111,63 @@ def test_network_bfloat16_compute(tmp_path):
     raw = net.apply(params, jnp.ones((2, 4, 3)), jnp.ones((2, 3)), model="coarse")
     assert raw.dtype == jnp.float32  # heads cast back to f32
     assert np.all(np.isfinite(raw))
+
+
+def test_split_dense_equals_concat_dense():
+    """The skip/view concat-split (SplitDense) must be numerically
+    identical to Dense-over-concat with the SAME param tree (names,
+    shapes, init values) — the [N, S, 319]/[N, S, 283] buffers it removes
+    are the f3 roofline's top byte producers (PERF.md round 4)."""
+    import flax.linen as nn
+    from flax.traverse_util import flatten_dict
+
+    from nerf_replication_tpu.models.nerf.network import NeRFMLP
+
+    class ConcatMLP(nn.Module):
+        D: int = 8
+        W: int = 64
+        input_ch: int = 63
+        input_ch_views: int = 27
+        skips: tuple = (4,)
+
+        @nn.compact
+        def __call__(self, embedded):
+            dense = lambda f, n: nn.Dense(f, name=n)  # noqa: E731
+            input_pts = embedded[..., : self.input_ch]
+            input_views = embedded[..., self.input_ch:]
+            h = input_pts
+            for i in range(self.D):
+                h = nn.relu(dense(self.W, f"pts_linear_{i}")(h))
+                if i in self.skips:
+                    h = jnp.concatenate([input_pts, h], -1)
+            alpha = nn.Dense(1, name="alpha_linear")(h)
+            feature = dense(self.W, "feature_linear")(h)
+            h = jnp.concatenate([feature, input_views], -1)
+            h = nn.relu(dense(self.W // 2, "views_linear_0")(h))
+            rgb = nn.Dense(3, name="rgb_linear")(h)
+            return jnp.concatenate([rgb, alpha], -1)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(128, 90)), jnp.float32)
+    new = NeRFMLP(D=8, W=64, input_ch=63, input_ch_views=27, skips=(4,))
+    old = ConcatMLP()
+    p_new = new.init(jax.random.PRNGKey(0), x)
+    p_old = old.init(jax.random.PRNGKey(0), x)
+    fa = {"/".join(k): v for k, v in flatten_dict(p_new["params"]).items()}
+    fb = {"/".join(k): v for k, v in flatten_dict(p_old["params"]).items()}
+    assert set(fa) == set(fb)
+    for k in fa:
+        np.testing.assert_array_equal(
+            np.asarray(fa[k]), np.asarray(fb[k]), err_msg=k
+        )
+    np.testing.assert_allclose(
+        np.asarray(new.apply(p_old, x)), np.asarray(old.apply(p_old, x)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    # no concatenate op with the skip width survives in the compiled fwd+bwd
+    def loss(p):
+        return jnp.sum(new.apply(p, x) ** 2)
+
+    hlo = jax.jit(jax.grad(loss)).lower(p_old).compile().as_text()
+    assert "f32[128,127]" not in hlo, "skip concat buffer still materializes"

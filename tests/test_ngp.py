@@ -103,11 +103,94 @@ def test_ngp_grid_update_is_densitydriven(setup):
     assert grid[c - 1 : c + 1, c - 1 : c + 1, c - 1 : c + 1].any()
 
 
-def test_fit_refuses_ngp_config(setup):
-    """The epoch-loop entry must refuse an ngp_training config loudly
-    instead of silently training the hierarchical path under it."""
+def test_ngp_carves_fast_from_sampled_densities(setup):
+    """VERDICT r3 #5: the round-4 warmup (ray-sampled scatter-max + low
+    warm factor) must carve occupancy while PSNR rises and the K-budget
+    truncation diagnostic falls — round 3's random-cell-only EMA sat at
+    occupancy 1.0 forever. At this test's scale (256 rays/step, 16x less
+    signal than the chip's 4096) the measured trajectory crosses 0.478 at
+    step 1000 (probe, round 4); the chip A/B pins the <0.5-in-500 form."""
+    root, cfg, net = setup
+    trainer = make_ngp_trainer(cfg, net)
+    assert trainer.warm_factor <= 2.0
+    state, _ = trainer.make_state(jax.random.PRNGKey(0))
+    ds = Dataset(data_root=root, scene="procedural", split="train", H=32, W=32)
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(1)
+    psnr0 = trunc0 = None
+    for i in range(1000):
+        state, stats = trainer.step(state, bank[0], bank[1], key)
+        if i == 0:
+            psnr0 = float(stats["psnr"])
+            trunc0 = float(stats["truncated_frac"])
+    assert float(stats["occupancy"]) < 0.55, float(stats["occupancy"])
+    assert float(stats["psnr"]) > psnr0 + 3.0
+    assert float(stats["truncated_frac"]) < trunc0
+
+
+def test_ngp_multi_step_burst_matches_single_steps(setup):
+    """A K-step scan burst must land on the same state as K single calls
+    (same key threading via state.step inside the scan)."""
+    root, cfg, net = setup
+    trainer_a = make_ngp_trainer(cfg, net)
+    trainer_b = make_ngp_trainer(cfg, net)
+    ds = Dataset(data_root=root, scene="procedural", split="train", H=32, W=32)
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(1)
+
+    sa, _ = trainer_a.make_state(jax.random.PRNGKey(0))
+    for _ in range(4):
+        sa, stats_a = trainer_a.step(sa, bank[0], bank[1], key)
+
+    sb, _ = trainer_b.make_state(jax.random.PRNGKey(0))
+    sb, stats_b = trainer_b.multi_step(sb, bank[0], bank[1], key, k_steps=4)
+
+    assert int(sa.step) == int(sb.step) == 4
+    np.testing.assert_allclose(
+        np.asarray(sa.grid_ema), np.asarray(sb.grid_ema), rtol=1e-5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        float(stats_a["loss"]), float(stats_b["loss"]), rtol=1e-4
+    )
+
+
+def test_fit_trains_ngp_config_end_to_end(setup, tmp_path):
+    """train.py's entry now routes ngp_training through fit_ngp: epoch
+    loop, checkpoint, live-grid validation (VERDICT r3 #5 wiring)."""
     from nerf_replication_tpu.train.trainer import fit
 
-    _, cfg, net = setup
-    with pytest.raises(NotImplementedError, match="ngp_training"):
-        fit(cfg, network=net, log=lambda *a, **k: None)
+    root, _, _ = setup
+    # multi-device NGP is refused loudly (grid EMA needs a cross-shard
+    # pmax) — the documented opt-out trains single-device
+    with pytest.raises(NotImplementedError, match="pmax"):
+        from nerf_replication_tpu.train.ngp import fit_ngp
+
+        fit_ngp(tiny_cfg(root, NGP_EXTRA), log=lambda *a, **k: None)
+
+    cfg = tiny_cfg(
+        root,
+        NGP_EXTRA + (
+            "parallel.data_axis", "1",
+            "ep_iter", "30",
+            "train.epoch", "2",
+            "eval_ep", "2",
+            "save_ep", "100",
+            "save_latest_ep", "2",
+            "log_interval", "10",
+            "task_arg.scan_steps", "5",
+            "result_dir", str(tmp_path / "result"),
+            "trained_model_dir", str(tmp_path / "model"),
+            "trained_config_dir", str(tmp_path / "config"),
+            "record_dir", str(tmp_path / "record"),
+        ),
+    )
+    logs = []
+    state = fit(cfg, log=logs.append)
+    assert isinstance(state, NGPTrainState)
+    assert int(state.step) == 60
+    assert any(str(l).startswith("ngp val") for l in logs)
+    assert any("latest" in n for n in os.listdir(cfg.trained_model_dir))
+    # resume restores the grid alongside params
+    state2 = fit(cfg, log=lambda *a, **k: None)
+    assert int(state2.step) == 60  # epochs exhausted; nothing retrains

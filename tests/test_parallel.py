@@ -384,6 +384,10 @@ def test_tp_hash_table_stays_sharded_and_matches(scene_root):
 
     (loss_a, emb_a), (loss_b, emb_b) = results
     np.testing.assert_allclose(loss_a, loss_b, rtol=1e-5)
-    # atol dominates: the table inits in [-1e-4, 1e-4], and the sharded
-    # scatter-add backward reassociates float sums (observed max |Δ| ≈ 7e-7)
-    np.testing.assert_allclose(emb_a, emb_b, rtol=1e-3, atol=2e-6)
+    # atol dominates, scaled to the OPTIMIZER step: the sorted-histogram
+    # backward (ops/histogram.py, round 4) reassociates float sums per
+    # topology (~1e-7 grad noise), and adam's g/(sqrt(g^2)+eps) amplifies
+    # that to O(lr) on near-zero-grad rows — observed max |Δ| ≈ 4e-5 with
+    # lr=5e-4-scale updates on ~3% of rows. Gradient-level agreement is
+    # covered by the parity tests in test_hashgrid.py.
+    np.testing.assert_allclose(emb_a, emb_b, rtol=1e-3, atol=1e-4)

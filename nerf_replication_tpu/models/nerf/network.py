@@ -74,6 +74,30 @@ class NeRFMLP(nn.Module):
     use_viewdirs: bool = True
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    # scan the uniform W->W trunk runs with stacked params instead of
+    # unrolling them: the fully-unrolled coarse+fine fwd+bwd graph is what
+    # made 65k-ray remote compiles exceed 15 min (PERF.md round 3).
+    # OPT-IN because it changes the param tree (runs store one stacked
+    # "trunk_scan_<start>" param instead of per-layer pts_linear_i; use
+    # checkpoint.replace_param_prefix-style surgery to convert bundles).
+    scan_trunk: bool = False
+
+    def _uniform_runs(self):
+        """Maximal consecutive runs of W->W trunk layers (layer i is
+        uniform iff i>0 and its input is the previous layer's W-wide
+        output, i.e. (i-1) is not a skip layer)."""
+        runs, start = [], None
+        for i in range(1, self.D):
+            if (i - 1) not in self.skips:
+                if start is None:
+                    start = i
+            else:
+                if start is not None:
+                    runs.append((start, i - start))
+                start = None
+        if start is not None:
+            runs.append((start, self.D - start))
+        return runs
 
     @nn.compact
     def __call__(self, embedded: jax.Array) -> jax.Array:
@@ -93,9 +117,44 @@ class NeRFMLP(nn.Module):
         input_pts = embedded[..., : self.input_ch]
         input_views = embedded[..., self.input_ch :]
 
+        scanned = {}  # layer index -> (run_start, run_len) for run heads
+        if self.scan_trunk:
+            scanned = {start: (start, length)
+                       for start, length in self._uniform_runs()}
+
         h = input_pts.astype(self.compute_dtype)
         pending_skip = None  # re-injected input feeding the NEXT layer
-        for i in range(self.D):
+        i = 0
+        while i < self.D:
+            if i in scanned and pending_skip is None:
+                start, length = scanned[i]
+                kernel = self.param(
+                    f"trunk_scan_{start}",
+                    # batch_axis=0: per-LAYER lecun fan (fan_in = W), same
+                    # distribution each slice as an unrolled nn.Dense
+                    nn.initializers.variance_scaling(
+                        1.0, "fan_in", "truncated_normal", batch_axis=(0,)
+                    ),
+                    (length, self.W, self.W), self.param_dtype,
+                )
+                bias = self.param(
+                    f"trunk_scan_{start}_bias", nn.initializers.zeros_init(),
+                    (length, self.W), self.param_dtype,
+                )
+                cd = self.compute_dtype
+
+                def body(carry, kb):
+                    k, b = kb
+                    return nn.relu(
+                        carry @ k.astype(cd) + b.astype(cd)
+                    ), None
+
+                h, _ = jax.lax.scan(body, h, (kernel, bias))
+                last = start + length - 1
+                if last in self.skips:
+                    pending_skip = input_pts.astype(self.compute_dtype)
+                i = start + length
+                continue
             if pending_skip is not None:
                 h = split_dense(self.W, f"pts_linear_{i}")(pending_skip, h)
                 pending_skip = None
@@ -104,6 +163,7 @@ class NeRFMLP(nn.Module):
             h = nn.relu(h)
             if i in self.skips:
                 pending_skip = input_pts.astype(self.compute_dtype)
+            i += 1
         if pending_skip is not None:
             # skip at the last trunk layer: the heads genuinely consume the
             # concatenated width — materialize only in that config
@@ -146,6 +206,7 @@ class Network(nn.Module):
     input_ch_views: int = 27
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    scan_trunk: bool = False
 
     def setup(self):
         kwargs = dict(
@@ -157,6 +218,7 @@ class Network(nn.Module):
             use_viewdirs=self.use_viewdirs,
             compute_dtype=self.compute_dtype,
             param_dtype=self.param_dtype,
+            scan_trunk=self.scan_trunk,
         )
         self.coarse = NeRFMLP(**kwargs, name="coarse")
         self.fine = NeRFMLP(**kwargs, name="fine")
@@ -199,6 +261,7 @@ def make_network(cfg) -> Network:
         input_ch_views=input_ch_views,
         compute_dtype=jnp.dtype(prec.get("compute_dtype", "float32")),
         param_dtype=jnp.dtype(prec.get("param_dtype", "float32")),
+        scan_trunk=bool(cfg.network.nerf.get("scan_trunk", False)),
     )
 
 

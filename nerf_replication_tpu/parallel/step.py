@@ -41,6 +41,7 @@ def build_dp_step(
     far: float,
     k_steps: int = 1,
     with_pool: bool = False,
+    grad_accum: int = 1,
 ):
     """shard_map DP step: ``(state, bank_rays, bank_rgbs, base_key[, pool])
     -> (state, stats)`` with the bank sharded over the data axis.
@@ -69,7 +70,7 @@ def build_dp_step(
         k_sample, k_render = jax.random.split(key)
         grads, stats = sampled_grad_step(
             loss, st.params, bank_rays, bank_rgbs, n_local, near, far,
-            k_sample, k_render, index_pool=pool,
+            k_sample, k_render, index_pool=pool, grad_accum=grad_accum,
         )
         grads = tree_pmean(grads, DATA_AXIS)
         stats = tree_pmean(stats, DATA_AXIS)
@@ -102,6 +103,7 @@ def build_gspmd_step(
     near: float,
     far: float,
     k_steps: int = 1,
+    grad_accum: int = 1,
 ):
     """GSPMD dp×tp step: sharding constraints on the batch (data axis) and on
     params (model axis, via sharding rules); XLA derives the collectives.
@@ -134,12 +136,27 @@ def build_gspmd_step(
         check_vma=False,
     )
 
-    def one_step(st, bank_rays, bank_rgbs, base_key):
-        key = sample_step_key(base_key, st.step)
-        k_sample, k_render = jax.random.split(key)
+    if grad_accum > 1 and n_local % grad_accum != 0:
+        raise ValueError(
+            f"per-shard batch {n_local} must be divisible by "
+            f"task_arg.grad_accum={grad_accum}"
+        )
+    n_micro = max(n_local // grad_accum, 1)
 
-        # data-sharded batch, sampled shard-locally
-        rays, rgbs = sample_sharded(k_sample, bank_rays, bank_rgbs)
+    def _sample_local_micro(k, bank_rays, bank_rgbs):
+        k = jax.random.fold_in(k, jax.lax.axis_index(DATA_AXIS))
+        return sample_rays(k, bank_rays, bank_rgbs, n_micro)
+
+    sample_sharded_micro = shard_map(
+        _sample_local_micro,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+
+    def _grads_for(p_ref, sampler, bank_rays, bank_rgbs, ks, kr):
+        rays, rgbs = sampler(ks, bank_rays, bank_rgbs)
         rays = jax.lax.with_sharding_constraint(rays, batch_sh)
         rgbs = jax.lax.with_sharding_constraint(rgbs, batch_sh)
 
@@ -147,14 +164,48 @@ def build_gspmd_step(
             _, l, stats = loss(
                 {"params": p},
                 {"rays": rays, "rgbs": rgbs, "near": near, "far": far},
-                key=k_render,
+                key=kr,
                 train=True,
             )
             return l, stats
 
-        (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            st.params
-        )
+        (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(p_ref)
+        return grads, stats
+
+    def one_step(st, bank_rays, bank_rgbs, base_key):
+        key = sample_step_key(base_key, st.step)
+        k_sample, k_render = jax.random.split(key)
+
+        if grad_accum > 1:
+            # microbatch accumulation: activation memory bounded by one
+            # microbatch (same contract as step_core.sampled_grad_step)
+            import jax.numpy as jnp
+
+            def body(carry, keys):
+                ks, kr = keys
+                grads, stats = _grads_for(
+                    st.params, sample_sharded_micro, bank_rays, bank_rgbs,
+                    ks, kr,
+                )
+                return jax.tree_util.tree_map(
+                    lambda a, b: a + b, carry, grads
+                ), stats
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, st.params)
+            gsum, stats_seq = jax.lax.scan(
+                body, zeros,
+                (jax.random.split(k_sample, grad_accum),
+                 jax.random.split(k_render, grad_accum)),
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / grad_accum, gsum)
+            stats = jax.tree_util.tree_map(
+                lambda x: x.mean(axis=0), stats_seq
+            )
+        else:
+            grads, stats = _grads_for(
+                st.params, sample_sharded, bank_rays, bank_rgbs,
+                k_sample, k_render,
+            )
         new_state = st.apply_gradients(grads=grads)
         return new_state, stats
 

@@ -16,15 +16,16 @@ designed for XLA rather than translated from the CUDA original
   EMA on a random subsample of cells with a scatter-max. No ``lax.cond``,
   no host round-trips, no retrace: grid maintenance is amortized
   continuously instead of instant-ngp's every-16-steps host-driven update.
-* **Warm start = march everything.** ``grid_ema`` initializes above the
-  density threshold, so early steps march densely (every cell "occupied")
-  and the EMA decay + updates carve out the empty space as the network
-  learns — the static-shape equivalent of instant-ngp's warmup. Caveat:
-  while the grid is still dense, rays whose S march positions exceed the
-  K = ``max_march_samples`` budget truncate their far content — per-step
-  stats report ``truncated_frac`` so the warm-up blind spot is visible in
-  the trace (it falls toward zero as the grid carves; size K or raise
-  ``ngp_density_threshold`` if it persists).
+* **Two-phase warmup, occupancy-gated.** The first phase trains with
+  plain stratified volume rendering (no march, no possible truncation)
+  while the grid carves from the sampled densities; the step switches to
+  the carved-K march executable only once occupancy has actually fallen
+  below ``ngp_warmup_exit_occ``. Round 4 measured why both halves are
+  load-bearing: marching densely during warmup costs 4× the samples
+  (2.3 s/step), and leaving warmup on a step count alone hands training
+  to a truncating march whose supervision corrupts the field (28 dB →
+  9.5 dB) while the corrupted density keeps the grid dense — a deadlock.
+  The march loss also masks truncated rays outright.
 * **One network.** NGP training drives the ``fine`` MLP only (hierarchical
   coarse→fine sampling is what the grid replaces); eval goes through the
   accelerated march with the live grid.
@@ -73,10 +74,13 @@ class NGPTrainState(TrainState):
 class NGPTrainer:
     """Occupancy-accelerated trainer (one fused jitted step)."""
 
-    def __init__(self, cfg, network):
+    def __init__(self, cfg, network, mesh=None):
         ta = cfg.task_arg
         self.cfg = cfg
         self.network = network
+        # a live mesh routes the step through shard_map DP (grads pmean'd,
+        # grid EMA pmax-merged) — same Trainer-level mode as trainer.fit
+        self.mesh = mesh
         self.n_rays = int(ta.get("N_rays", 1024))
         self.near = float(ta.near)
         self.far = float(ta.far)
@@ -96,9 +100,25 @@ class NGPTrainer:
         self.warm_factor = float(ta.get("ngp_grid_warm_factor", 2.0))
         self.sample_update_cap = int(ta.get("ngp_sample_update_cap", 65536))
         self.scan_steps = max(1, int(ta.get("scan_steps", 1)))
+        # two-phase training: the first N steps march with the FULL
+        # position budget (K = n_steps, truncation impossible), so the
+        # network learns the whole ray while the grid carves from real
+        # training samples; then the step switches to the carved-K
+        # executable. Without this, a dense warm grid + static K truncates
+        # most rays' far content and learning stalls (round-4 A/B: 1,580
+        # steps at truncated_frac 0.92 ended at 12 dB).
+        self.warmup_steps = int(ta.get("ngp_warmup_steps", 500))
+        # the phase switch is OCCUPANCY-gated, not just step-gated: handing
+        # training to the carved march while the grid is still dense feeds
+        # it truncated supervision (see loss_fn_march). warmup ends at the
+        # LATER of warmup_steps and occupancy < warmup_exit_occ, with a
+        # hard cap so a pathological scene cannot warm forever.
+        self.warmup_exit_occ = float(ta.get("ngp_warmup_exit_occ", 0.6))
+        self.warmup_max = int(ta.get("ngp_warmup_max", 8 * self.warmup_steps))
         self.process_index = jax.process_index()
-        self._step_fn = None
-        self._multi_step_fns: dict = {}
+        self._host_step: int | None = None
+        self._last_occ: float = 1.0
+        self._step_fns: dict = {}
         self._render_fns: dict = {}
 
     # -- state ---------------------------------------------------------------
@@ -126,8 +146,16 @@ class NGPTrainer:
         )
 
     # -- jitted step ---------------------------------------------------------
-    def _build_step(self):
+    def _build_step(self, axis_name: str | None = None, warm: bool = False):
+        """One-step body. ``axis_name`` set (shard_map DP): per-shard ray
+        sampling with a decorrelated key, grads/stats pmean'd, and the
+        live grid merged with a cross-shard pmax — a max-merge of EMA
+        candidates over a replicated base equals a single chip consuming
+        the union of the shards' samples, so the grid stays replicated
+        and step-equivalent."""
         n_rays = self.n_rays
+        if axis_name is not None:
+            n_rays = self.n_rays // self.mesh.shape[axis_name]
         near, far = self.near, self.far
         bbox, options = self.bbox, self.march
         network = self.network
@@ -143,34 +171,102 @@ class NGPTrainer:
             return jax.checkpoint(fn, static_argnums=(2,)) if remat else fn
 
         sample_cap = self.sample_update_cap
+        s_warm = int(self.cfg.task_arg.get("ngp_warmup_samples", 128))
+        white_bkgd = options.white_bkgd
 
         def one_step(state, bank_rays, bank_rgbs, base_key):
-            key = sample_step_key(base_key, state.step, process_index)
-            k_sample, k_cells, k_jitter = jax.random.split(key, 3)
+            if axis_name is not None:
+                # multi-controller SPMD: the traced program must be
+                # identical on every process — decorrelate by the GLOBAL
+                # axis_index, never by host-side process_index
+                key = sample_step_key(base_key, state.step)
+                key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+            else:
+                key = sample_step_key(base_key, state.step, process_index)
+            k_sample, k_cells, k_jitter, k_z = jax.random.split(key, 4)
             rays, rgbs = sample_rays(k_sample, bank_rays, bank_rgbs, n_rays)
 
             grid = state.grid_ema > thr  # bool [R,R,R], jit-static shape
 
-            def loss_fn(p):
+            def loss_fn_march(p):
                 out = march_rays_accelerated(
                     apply_fn_for(p), rays, near, far, grid, bbox, options,
                     return_samples=True,
                 )
-                l = mse(out["rgb_map_f"], rgbs)
+                # EXCLUDE truncated rays from the loss: a ray that ran out
+                # of K budget rendered only its near content — supervising
+                # that against the full ground truth actively corrupts the
+                # field (round-4 A/B: training THROUGH truncation erased
+                # the warmup's progress, 28 dB -> 9.5 dB)
+                w = 1.0 - out["truncated"].astype(jnp.float32)
+                per_ray = jnp.mean(
+                    (out["rgb_map_f"] - rgbs) ** 2, axis=-1
+                )
+                l = jnp.sum(per_ray * w) / jnp.maximum(jnp.sum(w), 1.0)
                 return l, (out, {
                     "loss": l,
                     "psnr": mse_to_psnr(l),
                     "occupancy": jnp.mean(grid.astype(jnp.float32)),
-                    # rays losing far content to the K budget (dense-grid
-                    # warm-up makes this nonzero; must fall as cells carve)
+                    # rays losing far content to the K budget (must stay
+                    # near zero once the grid has carved)
                     "truncated_frac": jnp.mean(
                         out["truncated"].astype(jnp.float32)
                     ),
                 })
 
+            def loss_fn_warm(p):
+                # warmup: NO occupancy march — plain stratified volume
+                # rendering of the fine network (the K=n_steps dense march
+                # costs 4x the samples and all the compaction overhead for
+                # the same supervision; measured 2.3 s/step, round 4). The
+                # grid still carves from these samples' densities.
+                from ..renderer.accelerated import world_to_voxel
+                from ..renderer.volume import raw2outputs, stratified_z_vals
+
+                rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
+                z = stratified_z_vals(k_z, near, far, n_rays, s_warm, 1.0)
+                pts = rays_o[:, None, :] + rays_d[:, None, :] * z[..., None]
+                viewdirs = rays_d / jnp.linalg.norm(
+                    rays_d, axis=-1, keepdims=True
+                )
+                raw = apply_fn_for(p)(pts, viewdirs, "fine")
+                rgb_map, _, _, _ = raw2outputs(
+                    raw, z, rays_d, white_bkgd=white_bkgd
+                )
+                l = mse(rgb_map, rgbs)
+                pts_sg = jax.lax.stop_gradient(pts)
+                vox = world_to_voxel(pts_sg, bbox, res)
+                flat = (vox[..., 0] * res + vox[..., 1]) * res + vox[..., 2]
+                # out-of-bbox samples would be clamp-scattered into the
+                # boundary shell with the young net's spurious density —
+                # mask them out (the march path masks via valid=occupied)
+                in_bbox = jnp.all(
+                    (pts_sg >= bbox[0]) & (pts_sg <= bbox[1]), axis=-1
+                ).astype(jnp.float32)
+                out = {
+                    "sample_flat": flat.astype(jnp.int32),
+                    "sample_sigma": jax.lax.stop_gradient(
+                        jax.nn.relu(raw[..., 3])
+                    ),
+                    "sample_valid": in_bbox,
+                }
+                return l, (out, {
+                    "loss": l,
+                    "psnr": mse_to_psnr(l),
+                    "occupancy": jnp.mean(grid.astype(jnp.float32)),
+                    "truncated_frac": jnp.zeros(()),
+                })
+
+            loss_fn = loss_fn_warm if warm else loss_fn_march
+
             (_, (out, stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(state.params)
+            if axis_name is not None:
+                from ..parallel.collectives import tree_pmean
+
+                grads = tree_pmean(grads, axis_name)
+                stats = tree_pmean(stats, axis_name)
             new_state = state.apply_gradients(grads=grads)
 
             ema = state.grid_ema.reshape(-1) * decay
@@ -210,15 +306,50 @@ class NGPTrainer:
             )
             sigma = jax.nn.relu(raw[..., 0, 3])
             ema = ema.at[idx].max(sigma)
+            if axis_name is not None:
+                # max-merge the shards' EMA candidates (all start from the
+                # same replicated decayed base, so this is exactly the
+                # union of every shard's scatter-max updates)
+                ema = jax.lax.pmax(ema, axis_name)
             new_state = new_state.replace(grid_ema=ema.reshape(res, res, res))
             return new_state, stats
 
         return one_step
 
-    def _jit_step(self, k_steps: int):
+    def _jit_step(self, k_steps: int, warm: bool = False):
         from .step_core import scan_k_steps
 
-        one_step = self._build_step()
+        if self.mesh is not None:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import DATA_AXIS
+
+            n_data = self.mesh.shape[DATA_AXIS]
+            if self.n_rays % n_data != 0:
+                raise ValueError(
+                    f"N_rays={self.n_rays} must be divisible by the data "
+                    f"axis ({n_data}) — a silent round-down would train a "
+                    "different effective batch than configured"
+                )
+            one_step = self._build_step(axis_name=DATA_AXIS, warm=warm)
+
+            def body(state, bank_rays, bank_rgbs, base_key):
+                return scan_k_steps(
+                    lambda st: one_step(st, bank_rays, bank_rgbs, base_key),
+                    state, k_steps,
+                )
+
+            smap = shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+            return jax.jit(smap, donate_argnums=(0,))
+
+        one_step = self._build_step(warm=warm)
 
         @partial(jax.jit, donate_argnums=(0,))
         def step_fn(state, bank_rays, bank_rgbs, base_key):
@@ -230,19 +361,37 @@ class NGPTrainer:
         return step_fn
 
     def step(self, state, bank_rays, bank_rgbs, base_key):
-        if self._step_fn is None:
-            self._step_fn = self._jit_step(1)
-        return self._step_fn(state, bank_rays, bank_rgbs, base_key)
+        return self.multi_step(state, bank_rays, bank_rgbs, base_key, 1)
 
     def multi_step(self, state, bank_rays, bank_rgbs, base_key, k_steps=None):
-        """K optimizer steps (incl. grid maintenance) in one dispatch."""
+        """K optimizer steps (incl. grid maintenance) in one dispatch,
+        routed through the warmup (full-budget) executable until
+        ``ngp_warmup_steps`` optimizer steps have run; a burst never
+        straddles the phase switch."""
         k = int(k_steps if k_steps is not None else self.scan_steps)
-        if k <= 1:
-            return self.step(state, bank_rays, bank_rgbs, base_key)
-        fn = self._multi_step_fns.get(k)
+        k = max(k, 1)
+        if self._host_step is None:
+            # one host sync at (re)start; resume-safe
+            self._host_step = int(state.step)
+        warm = self._host_step < self.warmup_steps or (
+            self._last_occ > self.warmup_exit_occ
+            and self._host_step < self.warmup_max
+        )
+        if warm and self._host_step < self.warmup_steps:
+            k = min(k, self.warmup_steps - self._host_step)
+        fn = self._step_fns.get((k, warm))
         if fn is None:
-            fn = self._multi_step_fns[k] = self._jit_step(k)
-        return fn(state, bank_rays, bank_rgbs, base_key)
+            fn = self._step_fns[(k, warm)] = self._jit_step(k, warm=warm)
+        self._host_step += k
+        self.last_burst_steps = k  # callers account actual steps run
+        state, stats = fn(state, bank_rays, bank_rgbs, base_key)
+        if warm or self._host_step < self.warmup_max:
+            # the occupancy gate is live: one scalar sync per burst. Once
+            # warmup is over the sync is skipped so step loops pipeline
+            # dispatches again (it costs a ~0.3-0.4 s tunnel round trip).
+            if warm:
+                self._last_occ = float(stats["occupancy"])
+        return state, stats
 
     # -- eval ----------------------------------------------------------------
     def val(self, state, test_dataset, evaluator, max_images=None, log=print):
@@ -341,23 +490,26 @@ def fit_ngp(cfg, network=None, log=print):
     multihost_init(cfg)
     configure_runtime(cfg)
     par = cfg.get("parallel", {})
-    if jax.device_count() > 1 and (
-        int(par.get("data_axis", -1)) != 1
-        or int(par.get("model_axis", 1)) > 1
-    ):
+    if int(par.get("model_axis", 1)) > 1:
         raise NotImplementedError(
-            "ngp_training over a device mesh is not wired yet (the live "
-            "grid EMA needs a cross-shard pmax); set parallel.data_axis 1 "
-            "(and model_axis 1) to train single-device, or use the "
-            "hierarchical trainer"
+            "ngp_training supports data parallelism only (the occupancy "
+            "march has no tensor-parallel seam yet) — set "
+            "parallel.model_axis 1"
         )
+    mesh = None
+    if jax.device_count() > 1 and int(par.get("data_axis", -1)) != 1:
+        from ..parallel.mesh import make_mesh_from_cfg
+
+        mesh = make_mesh_from_cfg(cfg)
+        log(f"ngp training over mesh "
+            f"{dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
     if network is None:
         from ..models import make_network
 
         network = make_network(cfg)
 
-    trainer = NGPTrainer(cfg, network)
+    trainer = NGPTrainer(cfg, network, mesh=mesh)
     evaluator = None if cfg.get("skip_eval", False) else make_evaluator(cfg)
     recorder = make_recorder(cfg)
 
@@ -384,7 +536,16 @@ def fit_ngp(cfg, network=None, log=print):
 
     train_ds = make_dataset(cfg, "train")
     test_ds = make_dataset(cfg, "test")
-    bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+    if mesh is not None:
+        from ..parallel.sharding import shard_bank
+
+        # globally permute before sharding so every shard is a uniform
+        # sample of the whole scene (same rationale as trainer.fit)
+        bank_rays, bank_rgbs = train_ds.ray_bank()
+        perm = np.random.default_rng(seed).permutation(bank_rays.shape[0])
+        bank = shard_bank(bank_rays[perm], bank_rgbs[perm], mesh)
+    else:
+        bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
 
     epochs = int(cfg.train.epoch)
     ep_iter = int(cfg.get("ep_iter", 500))
@@ -405,6 +566,9 @@ def fit_ngp(cfg, network=None, log=print):
             state, stats = trainer.multi_step(
                 state, bank[0], bank[1], base_key, k
             )
+            # multi_step may clamp a burst at the warmup boundary — account
+            # the steps that actually ran, or epochs undertrain silently
+            k = trainer.last_burst_steps
             host_step += k
             should_log = (
                 it == 0

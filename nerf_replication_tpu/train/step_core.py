@@ -39,8 +39,51 @@ def sampled_grad_step(
     k_sample,
     k_render,
     index_pool=None,
+    grad_accum: int = 1,
 ):
-    """Draw ``n_rays`` from the bank and compute (grads, stats) of the loss."""
+    """Draw ``n_rays`` from the bank and compute (grads, stats) of the loss.
+
+    ``grad_accum > 1`` splits the draw into A microbatches evaluated
+    sequentially inside one ``lax.scan`` and averages their gradients —
+    numerically the mean-loss gradient of the full batch, with activation
+    memory bounded by one microbatch. This is how batches past the HBM
+    roofline run on one chip: the 65,536-ray flagship step needs a 24 GB
+    activation stack as a single batch (PERF.md round 4) but fits as
+    4 x 16,384.
+    """
+    if grad_accum <= 1:
+        return _one_grad(loss, params, bank_rays, bank_rgbs, n_rays, near,
+                         far, k_sample, k_render, index_pool)
+    if n_rays % grad_accum != 0:
+        raise ValueError(
+            f"n_rays={n_rays} must be divisible by "
+            f"task_arg.grad_accum={grad_accum}"
+        )
+    import jax.numpy as jnp
+
+    n_micro = n_rays // grad_accum
+
+    def body(carry, keys):
+        ks, kr = keys
+        grads, stats = _one_grad(
+            loss, params, bank_rays, bank_rgbs, n_micro, near, far, ks, kr,
+            index_pool,
+        )
+        carry = jax.tree_util.tree_map(lambda a, b: a + b, carry, grads)
+        return carry, stats
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    ks = jax.random.split(k_sample, grad_accum)
+    kr = jax.random.split(k_render, grad_accum)
+    gsum, stats_seq = jax.lax.scan(body, zeros, (ks, kr))
+    grads = jax.tree_util.tree_map(lambda g: g / grad_accum, gsum)
+    # mean stats over microbatches (they are per-microbatch means already)
+    stats = jax.tree_util.tree_map(lambda x: x.mean(axis=0), stats_seq)
+    return grads, stats
+
+
+def _one_grad(loss, params, bank_rays, bank_rgbs, n_rays, near, far,
+              k_sample, k_render, index_pool):
     rays, rgbs = sample_rays(
         k_sample, bank_rays, bank_rgbs, n_rays, index_pool=index_pool
     )

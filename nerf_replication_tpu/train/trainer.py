@@ -102,6 +102,7 @@ class Trainer:
         """One routing ladder for every mesh variant: model_axis > 1 goes
         through the GSPMD builder (the shard_map DP body would replicate
         the model axis), pure DP through the explicit-collective builder."""
+        grad_accum = max(1, int(self.cfg.task_arg.get("grad_accum", 1)))
         if self._uses_tp():
             from ..parallel.step import build_gspmd_step
 
@@ -113,13 +114,13 @@ class Trainer:
                 )
             return build_gspmd_step(
                 self.mesh, self.loss, self.n_rays, self.near, self.far,
-                k_steps=k_steps,
+                k_steps=k_steps, grad_accum=grad_accum,
             )
         from ..parallel.step import build_dp_step
 
         return build_dp_step(
             self.mesh, self.loss, self.n_rays, self.near, self.far,
-            k_steps=k_steps, with_pool=with_pool,
+            k_steps=k_steps, with_pool=with_pool, grad_accum=grad_accum,
         )
 
     # -- jitted step construction ------------------------------------------
@@ -129,6 +130,7 @@ class Trainer:
         n_rays = self.n_rays
         process_index = self.process_index
         near, far, loss = self.near, self.far, self.loss
+        grad_accum = max(1, int(self.cfg.task_arg.get("grad_accum", 1)))
 
         # donate the state: params + adam moments update in place instead of
         # allocating fresh buffers every step (the sharded builders already
@@ -140,6 +142,7 @@ class Trainer:
             grads, stats = sampled_grad_step(
                 loss, state.params, bank_rays, bank_rgbs, n_rays, near, far,
                 k_sample, k_render, index_pool=pool[0] if pool else None,
+                grad_accum=grad_accum,
             )
             new_state = state.apply_gradients(grads=grads)
             return new_state, stats
@@ -152,6 +155,7 @@ class Trainer:
         n_rays = self.n_rays
         process_index = self.process_index
         near, far, loss = self.near, self.far, self.loss
+        grad_accum = max(1, int(self.cfg.task_arg.get("grad_accum", 1)))
 
         @partial(jax.jit, donate_argnums=(0,))
         def multi_step_fn(state, bank_rays, bank_rgbs, base_key):
@@ -160,7 +164,7 @@ class Trainer:
                 k_sample, k_render = jax.random.split(key)
                 grads, stats = sampled_grad_step(
                     loss, st.params, bank_rays, bank_rgbs, n_rays, near,
-                    far, k_sample, k_render,
+                    far, k_sample, k_render, grad_accum=grad_accum,
                 )
                 return st.apply_gradients(grads=grads), stats
 

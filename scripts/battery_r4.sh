@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 chip battery (run with the host core IDLE — concurrent CPU load
+# inflates dispatch-thread timings 3x, PERF.md round 4):
+#   1. flagship headline (lego.yaml, BENCH_DEFAULTS scan-burst shape) with
+#      the SplitDense concat-split in — vs round 3's 48.5k rays/s.
+#   2. the 65,536-ray compile frontier with scan_trunk (remat off/on) —
+#      VERDICT r3 #4's missing BENCH_SWEEP.jsonl rows.
+#   3. flagship profile (bytes/step) after the concat-split — vs f3's
+#      48.2 GiB/step.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[batteryR4 $(date +%H:%M:%S)] $*"; }
+
+log "stage 1: flagship headline"
+timeout 1800 python bench.py 2>&1 | grep -vE "WARNING|^E[0-9]" | tail -3
+
+log "stage 2: 65k compile frontier (scan_trunk)"
+BENCH_OPTS="network.nerf.scan_trunk true" \
+timeout 3600 python scripts/bench_sweep.py \
+  --rays 65536 --dtypes bfloat16 --remat false true --scan_steps 1 \
+  --steps 10 --point_timeout 2400 --out BENCH_SWEEP.jsonl \
+  2>&1 | grep -vE "WARNING|^E[0-9]" | tail -6
+
+log "stage 3: flagship profile (bytes/step after concat-split)"
+timeout 1800 python scripts/profile_step.py --n_rays 4096 --remat false \
+  2>&1 | grep -vE "WARNING|^E[0-9]" | tail -6
+
+log "battery r4 done"

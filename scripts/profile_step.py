@@ -53,6 +53,7 @@ def main(argv=None):
             "task_arg.precrop_iters", "0",
             "precision.compute_dtype", args.dtype,
             "task_arg.remat", args.remat,
+            *os.environ.get("BENCH_OPTS", "").split(),
         ],
     )
     network = make_network(cfg)

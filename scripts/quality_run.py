@@ -168,21 +168,35 @@ def main(argv=None):
         }) + "\n")
         tf.flush()
         while time.time() - t0 < budget_s:
-            # one burst of steps between host syncs
-            for _ in range(100):
+            # ~100 optimizer steps between host syncs, dispatched as
+            # scan-bursts (task_arg.scan_steps) — per-dispatch latency on
+            # the tunnel is ~0.3-0.4 s, which halved a non-burst packed
+            # trail's step rate (PERF.md round 4)
+            it = 0
+            while it < 100:
                 if ngp:
-                    state, stats = trainer.step(
+                    state, stats = trainer.multi_step(
                         state, bank[0], bank[1], base_key
                     )
+                    it += trainer._host_step - host_step
+                    host_step = trainer._host_step
                 else:
                     use_pool = (
                         pool is not None and host_step < trainer.precrop_iters
                     )
-                    state, stats = trainer.step(
-                        state, bank[0], bank[1], base_key,
-                        index_pool=pool if use_pool else None,
-                    )
-                host_step += 1
+                    if use_pool:
+                        state, stats = trainer.step(
+                            state, bank[0], bank[1], base_key,
+                            index_pool=pool,
+                        )
+                        k = 1
+                    else:
+                        state, stats = trainer.multi_step(
+                            state, bank[0], bank[1], base_key
+                        )
+                        k = trainer.scan_steps
+                    host_step += k
+                    it += k
             jax.block_until_ready(stats)
             elapsed = time.time() - t0
             if elapsed >= next_eval or elapsed >= budget_s:

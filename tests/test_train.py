@@ -333,3 +333,55 @@ def test_train_epoch_with_scan_steps_bursts(scene_root):
     assert int(state.step) == 10  # 4 + 4 + clamped 2
     assert float(stats["loss"]) == float(stats["loss"])  # finite, present
     assert lines  # console cadence still produces output
+
+
+def test_grad_accum_matches_full_batch_memory_shape(scene_root):
+    """grad_accum=A must produce the mean-of-microbatch gradients: equal to
+    a full batch over the union of the A microbatch draws. (The HBM lever
+    for past-roofline batches — PERF.md round 4: 65,536 rays OOM as one
+    batch, fit as 4 x 16,384.)"""
+    import jax.numpy as jnp
+
+    from nerf_replication_tpu.datasets.blender import Dataset
+    from nerf_replication_tpu.train.step_core import sampled_grad_step
+
+    cfg = tiny_cfg(scene_root)
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.train import make_loss, make_train_state
+
+    network = make_network(cfg)
+    loss = make_loss(cfg, network)
+    state, _ = make_train_state(cfg, network, jax.random.PRNGKey(0))
+    ds = Dataset(
+        data_root=scene_root, scene="procedural", split="train", H=16, W=16
+    )
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    near, far = float(cfg.task_arg.near), float(cfg.task_arg.far)
+    ks, kr = jax.random.split(jax.random.PRNGKey(3))
+
+    g_acc, stats_acc = jax.jit(
+        lambda p: sampled_grad_step(
+            loss, p, bank[0], bank[1], 128, near, far, ks, kr, grad_accum=4
+        )
+    )(state.params)
+
+    # reference: mean of the 4 microbatch grads computed independently
+    kss = jax.random.split(ks, 4)
+    krs = jax.random.split(kr, 4)
+    gs = []
+    for i in range(4):
+        g_i, _ = sampled_grad_step(
+            loss, state.params, bank[0], bank[1], 32, near, far,
+            kss[i], krs[i],
+        )
+        gs.append(g_i)
+    g_ref = jax.tree_util.tree_map(
+        lambda *a: sum(a) / 4.0, *gs
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_acc), jax.tree_util.tree_leaves(g_ref)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+    assert np.isfinite(float(stats_acc["loss"]))

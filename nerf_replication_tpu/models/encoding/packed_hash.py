@@ -328,12 +328,14 @@ class PackedHashGridEncoder(nn.Module):
                 "'bbox: [[lo...],[hi...]]' world bounds for [0,1] "
                 "normalization"
             )
-        # gather rows follow the compute dtype unless pinned explicitly:
-        # a bf16 training step should not pay f32-width gather tiles
-        gather_dtype = str(enc_cfg.get(
-            "gather_dtype",
-            (precision or {}).get("compute_dtype", "float32"),
-        ))
+        # gather rows stay f32 unless pinned explicitly: the chip's gather
+        # cost is per-ROW, nearly width-independent (BENCH_PRIMITIVES), so
+        # half-width bf16 rows buy nothing and the per-step table cast
+        # measurably costs ~10% (BENCH_SWEEP_HASH round 4: 10.2k vs 11.3k
+        # rays/s at 4096). ``precision`` is accepted for future dtype-aware
+        # layouts but deliberately not consulted here.
+        del precision
+        gather_dtype = str(enc_cfg.get("gather_dtype", "float32"))
         return cls(
             input_dim=int(enc_cfg.get("input_dim", 3)),
             num_levels=int(enc_cfg.get("num_levels", 16)),

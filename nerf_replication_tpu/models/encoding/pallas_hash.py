@@ -31,11 +31,33 @@ Correctness: validated against ``hash_encode`` (the pure-XLA oracle) in
 **Measured verdict (round 3, TPU v5 lite — PERF.md):** Mosaic rejects the
 in-kernel row gather at lowering time ("Shape mismatch in input, indices
 and output"; eval_shape tracing is clean, so it is the backend, not the
-wrapper), while the pure-XLA formulation measures 11.1 G points/s forward
-and 1.4 G points/s fwd+bwd at the full lego_hash shapes — far beyond what
-any training step consumes. ``hash_encode`` is therefore the production
-path; this kernel is retained as the interpret-tested reference design and
-the recorded negative result for in-kernel gathers on this Mosaic version.
+wrapper). ``hash_encode`` is therefore the production path; this kernel is
+retained as the interpret-tested reference design and the recorded
+negative result for in-kernel gathers on this Mosaic version.
+
+**Round-4 closure (FINAL — VERDICT r3 #7).** Pinned negative:
+
+* Stack: jax/jaxlib 0.9.0, libtpu via the axon terminal (v5e target).
+  Rejected op: the in-kernel vector gather ``table_slice[idx_vec]``
+  (rows from a VMEM-resident [R, 128·C] block addressed by a computed
+  uint32 vector) — Mosaic lowering fails with
+  ``ValueError: Shape mismatch in input, indices and output`` while
+  interpret mode and trace-time eval_shape both pass (BENCH_HASH.jsonl
+  pallas rows).
+* The remaining in-Mosaic alternatives are serialized by construction
+  (per-row ``dynamic_slice`` in a ``fori_loop``, or per-row async-copy
+  DMA): both issue one row per loop iteration, which cannot beat the
+  ~6-9 ns/row the XLA gather path already achieves
+  (BENCH_PRIMITIVES.jsonl, forced-sync harness) — the wall is the
+  hardware's dynamic-address issue rate, not XLA's lowering.
+* Round 4 made the question moot for production: the cell-packed layout
+  (packed_hash.py) reduced the encoder to ONE wide gather per (point,
+  level) with a sort-based scatter-free backward; the encoder is no
+  longer the step's bottleneck at any measured shape. NOTE: the round-3
+  "xla" timing rows in BENCH_HASH.jsonl predate the forced-sync timing
+  harness and are elision-corrupted (a 99 MB-gradient fwd+bwd "in
+  25 us"); the trustworthy encoder rates live in BENCH_PRIMITIVES.jsonl
+  and the full-step BENCH_SWEEP_HASH.jsonl rows.
 """
 
 from __future__ import annotations

@@ -124,17 +124,20 @@ def build_gspmd_step(
     # would make XLA materialize cross-chip collectives on the whole bank
     # every step; tests/test_parallel.py asserts the compiled HLO carries no
     # all-gather of the bank.
-    def _sample_local(k, bank_rays, bank_rgbs):
-        k = jax.random.fold_in(k, jax.lax.axis_index(DATA_AXIS))
-        return sample_rays(k, bank_rays, bank_rgbs, n_local)
+    def make_sampler(n):
+        def _sample_local(k, bank_rays, bank_rgbs):
+            k = jax.random.fold_in(k, jax.lax.axis_index(DATA_AXIS))
+            return sample_rays(k, bank_rays, bank_rgbs, n)
 
-    sample_sharded = shard_map(
-        _sample_local,
-        mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-        check_vma=False,
-    )
+        return shard_map(
+            _sample_local,
+            mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False,
+        )
+
+    sample_sharded = make_sampler(n_local)
 
     if grad_accum > 1 and n_local % grad_accum != 0:
         raise ValueError(
@@ -142,18 +145,7 @@ def build_gspmd_step(
             f"task_arg.grad_accum={grad_accum}"
         )
     n_micro = max(n_local // grad_accum, 1)
-
-    def _sample_local_micro(k, bank_rays, bank_rgbs):
-        k = jax.random.fold_in(k, jax.lax.axis_index(DATA_AXIS))
-        return sample_rays(k, bank_rays, bank_rgbs, n_micro)
-
-    sample_sharded_micro = shard_map(
-        _sample_local_micro,
-        mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-        check_vma=False,
-    )
+    sample_sharded_micro = make_sampler(n_micro)
 
     def _grads_for(p_ref, sampler, bank_rays, bank_rgbs, ks, kr):
         rays, rgbs = sampler(ks, bank_rays, bank_rgbs)

@@ -87,7 +87,17 @@ class NGPTrainer:
         self.bbox = jnp.asarray(cfg.train_dataset.scene_bbox, jnp.float32)
         self.march = MarchOptions.from_cfg(cfg)
         self.grid_res = int(ta.get("ngp_grid_res", 64))
-        self.threshold = float(ta.get("ngp_density_threshold", 0.01))
+        # density threshold follows the EVAL bake's convention
+        # (task_arg.occupancy_grid_threshold, σ=1.0 in the lego family)
+        # unless pinned explicitly. Round 4 measured why this matters: at
+        # the old default σ=0.01 (alpha 5e-5 per δ=0.005 step — visually
+        # nothing) a 31-dB network still reads as 98% "occupied" and the
+        # grid never carves; the same network bakes to 5.7% at σ=1.0.
+        thr_cfg = ta.get("ngp_density_threshold", None)
+        if thr_cfg is None:
+            self.threshold = float(ta.get("occupancy_grid_threshold", 1.0))
+        else:
+            self.threshold = float(thr_cfg)
         update_every = int(ta.get("ngp_grid_update_every", 16))
         decay_window = float(ta.get("ngp_grid_decay", 0.95))
         # continuous equivalent of "×decay every `update_every` steps"
@@ -371,8 +381,15 @@ class NGPTrainer:
         k = int(k_steps if k_steps is not None else self.scan_steps)
         k = max(k, 1)
         if self._host_step is None:
-            # one host sync at (re)start; resume-safe
+            # one host sync at (re)start; resume-safe — including the
+            # occupancy gate, which must reflect the RESTORED grid (a
+            # resumed carved run must not replay a warm burst)
             self._host_step = int(state.step)
+            self._last_occ = float(
+                jnp.mean((state.grid_ema > self.threshold).astype(
+                    jnp.float32
+                ))
+            )
         warm = self._host_step < self.warmup_steps or (
             self._last_occ > self.warmup_exit_occ
             and self._host_step < self.warmup_max
@@ -384,13 +401,14 @@ class NGPTrainer:
             fn = self._step_fns[(k, warm)] = self._jit_step(k, warm=warm)
         self._host_step += k
         self.last_burst_steps = k  # callers account actual steps run
+        self.last_burst_warm = warm
         state, stats = fn(state, bank_rays, bank_rgbs, base_key)
         if warm or self._host_step < self.warmup_max:
-            # the occupancy gate is live: one scalar sync per burst. Once
-            # warmup is over the sync is skipped so step loops pipeline
-            # dispatches again (it costs a ~0.3-0.4 s tunnel round trip).
-            if warm:
-                self._last_occ = float(stats["occupancy"])
+            # the occupancy gate is live (it can re-engage warm if the
+            # grid re-densifies before warmup_max): one scalar sync per
+            # burst. Past warmup_max the sync is skipped so step loops
+            # pipeline dispatches again (a ~0.3-0.4 s tunnel round trip).
+            self._last_occ = float(stats["occupancy"])
         return state, stats
 
     # -- eval ----------------------------------------------------------------

@@ -79,6 +79,9 @@ class Trainer:
         # derived from state.step, which apply_gradients advances inside the
         # scan exactly as it does outside (tested).
         self.scan_steps = max(1, int(cfg.task_arg.get("scan_steps", 1)))
+        # microbatch gradient accumulation (HBM lever for past-roofline
+        # batches — step_core.sampled_grad_step)
+        self.grad_accum = max(1, int(cfg.task_arg.get("grad_accum", 1)))
         self.process_index = jax.process_index()
         self._step_fn = None
         self._step_fn_pool = None
@@ -102,7 +105,7 @@ class Trainer:
         """One routing ladder for every mesh variant: model_axis > 1 goes
         through the GSPMD builder (the shard_map DP body would replicate
         the model axis), pure DP through the explicit-collective builder."""
-        grad_accum = max(1, int(self.cfg.task_arg.get("grad_accum", 1)))
+        grad_accum = self.grad_accum
         if self._uses_tp():
             from ..parallel.step import build_gspmd_step
 
@@ -130,7 +133,7 @@ class Trainer:
         n_rays = self.n_rays
         process_index = self.process_index
         near, far, loss = self.near, self.far, self.loss
-        grad_accum = max(1, int(self.cfg.task_arg.get("grad_accum", 1)))
+        grad_accum = self.grad_accum
 
         # donate the state: params + adam moments update in place instead of
         # allocating fresh buffers every step (the sharded builders already
@@ -155,7 +158,7 @@ class Trainer:
         n_rays = self.n_rays
         process_index = self.process_index
         near, far, loss = self.near, self.far, self.loss
-        grad_accum = max(1, int(self.cfg.task_arg.get("grad_accum", 1)))
+        grad_accum = self.grad_accum
 
         @partial(jax.jit, donate_argnums=(0,))
         def multi_step_fn(state, bank_rays, bank_rgbs, base_key):

@@ -119,11 +119,22 @@ def main(argv=None):
 
         steps = 0
         t0 = time.time()
+        phase_switch = None  # (steps, t) when NGP leaves warmup
         while time.time() - t0 < args.seconds:
-            for _ in range(20):
-                state, stats = trainer.step(state, bank[0], bank[1], key)
+            it = 0
+            while it < 20:
+                state, stats = trainer.multi_step(
+                    state, bank[0], bank[1], key
+                )
+                if arm == "ngp":
+                    k = trainer.last_burst_steps
+                    if phase_switch is None and not trainer.last_burst_warm:
+                        phase_switch = (steps + it, time.time() - t0)
+                else:
+                    k = trainer.scan_steps
+                it += k
             jax.block_until_ready(stats)
-            steps += 20
+            steps += it
         dt = time.time() - t0
 
         if arm == "ngp":
@@ -150,6 +161,20 @@ def main(argv=None):
         if arm == "ngp":
             rec["occupancy"] = round(float(stats["occupancy"]), 4)
             rec["truncated_frac"] = round(float(stats["truncated_frac"]), 4)
+            # train-batch psnr: the val render is blind while the grid is
+            # dense (K-budget truncation renders ~background), so this is
+            # the only honest learning signal during warmup
+            rec["train_psnr"] = round(float(stats["psnr"]), 3)
+            if phase_switch is not None:
+                # throughput of the CARVED phase alone — the steady-state
+                # number the warmup amortizes into on longer runs
+                s_sw, t_sw = phase_switch
+                if dt > t_sw:
+                    rec["warmup_steps_run"] = s_sw
+                    rec["warmup_t_s"] = round(t_sw, 1)
+                    rec["carved_rays_per_sec"] = round(
+                        (steps - s_sw) * args.n_rays / (dt - t_sw), 1
+                    )
         print(json.dumps(rec), flush=True)
         out_f.write(json.dumps(rec) + "\n")
         out_f.flush()

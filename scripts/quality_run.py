@@ -178,8 +178,8 @@ def main(argv=None):
                     state, stats = trainer.multi_step(
                         state, bank[0], bank[1], base_key
                     )
-                    it += trainer._host_step - host_step
-                    host_step = trainer._host_step
+                    it += trainer.last_burst_steps
+                    host_step += trainer.last_burst_steps
                 else:
                     use_pool = (
                         pool is not None and host_step < trainer.precrop_iters

@@ -127,10 +127,12 @@ def test_ngp_carves_fast_from_sampled_densities(setup):
         if i == 599:
             occ_mid = float(stats["occupancy"])
     occ = float(stats["occupancy"])
-    # carving is underway and monotone at this scale (256 rays/step —
-    # 16x less signal than chip runs; the chip A/B pins absolute bars)
-    assert occ < occ_mid < 1.0, (occ, occ_mid)
-    assert occ < 0.75, occ
+    # with the bake-aligned threshold (σ from occupancy_grid_threshold,
+    # round 4) the grid carves DEEP by mid-run and then fluctuates at the
+    # floor as the network refines — assert the deep carve, not
+    # monotonicity at the floor (chip A/B: 1.0 → 0.047)
+    assert occ_mid < 0.3, occ_mid
+    assert occ < 0.3, occ
     assert float(stats["psnr"]) > psnr0 + 3.0
 
 

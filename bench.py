@@ -40,11 +40,13 @@ def main():
         force_platform(forced)
     else:
         # after an HBM-OOM storm the axon terminal restarts itself and can
-        # take minutes to answer again — the retry budget is env-tunable so
-        # sweeps can ride out the recovery window. Deliberately NOT
-        # setup_backend(): that helper hard-exits on failure, and bench must
-        # instead catch the error below to emit its JSON failure record
-        # (the driver's one-line contract) before its own os._exit.
+        # take minutes to answer again (docs/operations.md: wedges last
+        # minutes to HOURS) — the default budget is 6 probes with
+        # exponential backoff (~20 min), env-tunable via BENCH_INIT_*.
+        # Deliberately NOT setup_backend(): that helper hard-exits on
+        # failure, and bench must instead catch the error below to emit
+        # its JSON failure record (the driver's one-line contract) before
+        # its own os._exit.
         from nerf_replication_tpu.utils.platform import (
             init_backend_with_retry,
         )
@@ -215,6 +217,10 @@ if __name__ == "__main__":
                     "unit": "rays/s",
                     "vs_baseline": None,
                     "error": f"{type(exc).__name__}: {exc}",
+                    # partial probe history (utils/platform attaches it to
+                    # the init error) — a failed record must still show
+                    # what was tried and when, not just an opaque message
+                    "init_trail": getattr(exc, "trail", None),
                     "best_known_measurement": best_known,
                 }
             )

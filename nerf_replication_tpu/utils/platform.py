@@ -79,25 +79,47 @@ def init_backend_with_retry(
     retries: int | None = None,
     delay_s: float | None = None,
     hang_timeout_s: float | None = None,
+    total_budget_s: float | None = None,
+    delay_cap_s: float | None = None,
+    trail: list | None = None,
 ):
     """Touch the device backend, retrying on transient init failures.
 
     The axon TPU tunnel on this machine is monoclient and can WEDGE (init
     hangs forever) or flap (UNAVAILABLE) — measured behavior: after an
     HBM-OOM compile storm the terminal restarts itself and answers again
-    minutes later. Every chip-facing entry point must bound its first
-    backend touch or a wedged tunnel silently eats its whole time budget
-    (round-3 failure mode: quality_run hung 20 min at 0% CPU on init).
+    minutes later (its port increments on each restart). Every chip-facing
+    entry point must bound its first backend touch or a wedged tunnel
+    silently eats its whole time budget (round-3 failure mode: quality_run
+    hung 20 min at 0% CPU on init).
 
     Two failure modes, two handlings:
 
     * init RAISES (UNAVAILABLE): transient — bounded retry.
     * init HANGS: probe in a SUBPROCESS (killable, doesn't poison this
       process's backend state, releases the monoclient tunnel on exit),
-      then attach in-process under a watchdog thread.
+      then attach in-process under a watchdog thread. Each probe is a
+      fresh interpreter that re-imports the axon sitecustomize, so the
+      tunnel's post-restart port is re-resolved on every attempt — no
+      stale-port state survives in this process until the attach, which
+      only happens after a probe has already succeeded.
 
-    Defaults come from ``BENCH_INIT_RETRIES`` / ``BENCH_INIT_DELAY_S`` /
-    ``BENCH_INIT_TIMEOUT_S`` so sweep drivers can widen the budget.
+    The default budget is shaped to what wedges actually last on this
+    machine (docs/operations.md: "minutes to hours"; the round-4 bench
+    died because 3×120 s was too short): 6 probes with exponential
+    backoff between them (delay_s, 2·delay_s, … capped at 320 s) —
+    worst case ≈ 6×120 s probing + ~10 min sleeping ≈ 20 min, bounded
+    by ``total_budget_s`` (a probe never starts with less than one
+    probe-timeout of budget left, so the bound is hard to within one
+    attach watchdog). Defaults come from ``BENCH_INIT_RETRIES`` /
+    ``BENCH_INIT_DELAY_S`` / ``BENCH_INIT_DELAY_CAP_S`` /
+    ``BENCH_INIT_TIMEOUT_S`` / ``BENCH_INIT_TOTAL_S`` so sweep drivers
+    can narrow or widen it.
+
+    ``trail``: optional list; every attempt appends a dict
+    ``{attempt, t, outcome}`` so callers (bench.py) can emit the partial
+    probe history in their failure record instead of an opaque error.
+
     Returns the device list; raises RuntimeError when the budget is spent.
     """
     import subprocess
@@ -108,11 +130,18 @@ def init_backend_with_retry(
     import jax
 
     if retries is None:
-        retries = int(os.environ.get("BENCH_INIT_RETRIES", 3))
+        retries = int(os.environ.get("BENCH_INIT_RETRIES", 6))
     if delay_s is None:
-        delay_s = float(os.environ.get("BENCH_INIT_DELAY_S", 15))
+        delay_s = float(os.environ.get("BENCH_INIT_DELAY_S", 20))
     if hang_timeout_s is None:
         hang_timeout_s = float(os.environ.get("BENCH_INIT_TIMEOUT_S", 120))
+    if total_budget_s is None:
+        total_budget_s = float(os.environ.get("BENCH_INIT_TOTAL_S", 1500))
+    if delay_cap_s is None:
+        delay_cap_s = float(os.environ.get("BENCH_INIT_DELAY_CAP_S", 320))
+    if trail is None:
+        trail = []
+    t_start = time.monotonic()
 
     def _attach_in_process():
         result: dict = {}
@@ -132,16 +161,34 @@ def init_backend_with_retry(
             )
         return result.get("devices"), result.get("error")
 
+    def _note(outcome: str) -> None:
+        trail.append(
+            {
+                "attempt": attempt,
+                "t": round(time.monotonic() - t_start, 1),
+                "outcome": outcome,
+            }
+        )
+
     last = "unknown"
     attempt = 0
     while attempt < retries:
         attempt += 1
+        # every probe (including the first) is clamped to the remaining
+        # budget: the documented bound must hold even when a caller sets
+        # BENCH_INIT_TOTAL_S below one probe timeout — an overshooting
+        # probe risks the caller's outer timeout killing bench.py before
+        # its JSON failure record is printed.
+        probe_timeout = min(
+            hang_timeout_s,
+            max(0.1, total_budget_s - (time.monotonic() - t_start)),
+        )
         try:
             p = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
                 capture_output=True,
                 text=True,
-                timeout=hang_timeout_s,
+                timeout=probe_timeout,
             )
             if p.returncode == 0:
                 devices, err = _attach_in_process()
@@ -151,24 +198,61 @@ def init_backend_with_retry(
                         f"{len(devices)} device(s): {devices[0].device_kind}",
                         file=sys.stderr,
                     )
+                    _note("ok")
                     return devices
                 if isinstance(err, RuntimeError) and "hung" in str(err):
                     # a thread stuck in backend init holds the init lock:
                     # further in-process attempts block on it — fail fast
+                    _note(f"attach hung: {err}")
+                    err.trail = trail
                     raise err
                 last = str(err)
             else:
                 tail = (p.stderr or p.stdout).strip().splitlines()
                 last = tail[-1] if tail else "probe exited nonzero"
         except subprocess.TimeoutExpired:
-            last = f"backend init hung >{hang_timeout_s:.0f}s (tunnel wedged?)"
+            last = (
+                f"backend init hung >{probe_timeout:.0f}s (tunnel wedged?)"
+            )
+        _note(last)
         print(
             f"backend probe {attempt}/{retries} failed: {last}",
             file=sys.stderr,
         )
-        if attempt < retries:
-            time.sleep(delay_s)
-    raise RuntimeError(f"backend unavailable after {retries} attempts: {last}")
+        elapsed = time.monotonic() - t_start
+        if attempt >= retries:
+            break
+        # exponential backoff: wedges resolve on the tunnel's schedule
+        # (minutes), so later waits should be long, and every probe
+        # re-resolves the post-restart port in its own interpreter.
+        sleep = min(delay_s * (2 ** (attempt - 1)), delay_cap_s)
+        # hard budget: never launch a probe that cannot finish inside it —
+        # an overshooting probe risks the CALLER's outer timeout killing
+        # bench.py before it can emit its JSON failure record.
+        if elapsed + sleep + hang_timeout_s > total_budget_s:
+            break
+        print(
+            f"next probe in {sleep:.0f}s "
+            f"(budget {elapsed:.0f}/{total_budget_s:.0f}s)",
+            file=sys.stderr,
+        )
+        time.sleep(sleep)
+    # name WHICH budget stopped the loop — a retry-count message on a
+    # wall-budget cut sends the operator chasing a phantom retry bug
+    reason = (
+        f"retry budget ({retries}) spent"
+        if attempt >= retries
+        else (
+            f"total budget ({total_budget_s:.0f}s) spent with "
+            f"{retries - attempt} retries remaining"
+        )
+    )
+    exc = RuntimeError(
+        f"backend unavailable after {attempt} attempts / "
+        f"{time.monotonic() - t_start:.0f}s ({reason}): {last}"
+    )
+    exc.trail = trail
+    raise exc
 
 
 def setup_backend(force_platform_name: str | None = None) -> None:

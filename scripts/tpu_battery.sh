@@ -38,6 +38,14 @@ WATCH_PROBES=${WATCH_PROBES:-60}
 PROBE_SLEEP=${PROBE_SLEEP:-300}
 log() { echo "[battery $(date +%H:%M:%S)] $*"; }
 
+# The watch loop below already gates every stage on a CONFIRMED-up tunnel,
+# so stages must not ride out a wedge with the ~20-min default init budget
+# (utils/platform.py): a mid-battery wedge should fail the stage loudly
+# INSIDE its outer `timeout` (smallest stage budget: 1200 s) and let the
+# next stage's init re-probe. With the per-stage BENCH_INIT_DELAY_S=30
+# overrides below, 420 s admits 2 full probes (fail ≈ 270 s in).
+export BENCH_INIT_TOTAL_S=${BENCH_INIT_TOTAL_S:-420}
+
 probe_once() {
   timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1
 }

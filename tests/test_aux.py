@@ -290,6 +290,72 @@ def test_init_backend_with_retry_bounds_a_wedged_tunnel(monkeypatch):
     assert len(calls) == 1
 
 
+def test_init_backend_retry_backoff_trail_and_total_budget(monkeypatch):
+    """Round-4 failure mode: the driver's bench died on a 3×120 s budget
+    while wedges last minutes-to-hours (BENCH_r04.json value null). The
+    hardened init must (a) back off exponentially between probes, (b)
+    record a machine-readable trail of every attempt on the raised error
+    (bench.py emits it in its failure JSON), and (c) stop at the total
+    wall budget even when retries remain."""
+    import subprocess
+
+    import pytest
+
+    from nerf_replication_tpu.utils import platform as plat
+
+    sleeps = []
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd=cmd, timeout=kw.get("timeout"))
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    import time as _time
+
+    real_sleep = _time.sleep
+    monkeypatch.setattr(
+        _time, "sleep", lambda s: (sleeps.append(s), real_sleep(0))[1]
+    )
+
+    for var in ("BENCH_INIT_RETRIES", "BENCH_INIT_DELAY_S",
+                "BENCH_INIT_DELAY_CAP_S", "BENCH_INIT_TIMEOUT_S",
+                "BENCH_INIT_TOTAL_S"):
+        monkeypatch.delenv(var, raising=False)
+
+    trail: list = []
+    with pytest.raises(RuntimeError) as ei:
+        plat.init_backend_with_retry(
+            retries=4, delay_s=1.0, hang_timeout_s=0.01,
+            total_budget_s=1e9, delay_cap_s=320.0, trail=trail,
+        )
+    # exponential: 1, 2, 4 between the 4 attempts
+    assert sleeps == [1.0, 2.0, 4.0]
+    assert len(trail) == 4
+    assert all("wedged" in rec["outcome"] for rec in trail)
+    assert ei.value.trail is trail  # bench.py reads exc.trail
+
+    # total budget cuts the loop even with retries remaining: with
+    # total_budget_s=0 no backoff+probe can ever fit the budget, so the
+    # loop must stop after the mandatory first attempt without sleeping.
+    sleeps.clear()
+    with pytest.raises(RuntimeError, match="unavailable after"):
+        plat.init_backend_with_retry(
+            retries=50, delay_s=100.0, hang_timeout_s=0.01,
+            total_budget_s=0.0, trail=None,
+        )
+    assert sleeps == []  # budget 0: no backoff sleeps at all
+
+    # defaults are wedge-shaped (6 probes, 120 s probe timeout, 25 min
+    # total — docs/operations.md's own numbers), checked BEHAVIORALLY:
+    # with sleeps faked, wall clock barely advances, so the default
+    # budget admits all 6 probes and the full exponential ladder.
+    sleeps.clear()
+    trail2: list = []
+    with pytest.raises(RuntimeError, match="unavailable after 6 attempts"):
+        plat.init_backend_with_retry(trail=trail2)
+    assert len(trail2) == 6
+    assert sleeps == [20.0, 40.0, 80.0, 160.0, 320.0]
+
+
 def test_setup_backend_forced_platform_skips_the_probe(monkeypatch):
     """setup_backend(force) must pin the platform WITHOUT touching the
     guarded init (CI/smoke path: no tunnel probe subprocesses)."""

@@ -190,9 +190,11 @@ def build_gspmd_step(
                  jax.random.split(k_render, grad_accum)),
             )
             grads = jax.tree_util.tree_map(lambda g: g / grad_accum, gsum)
-            stats = jax.tree_util.tree_map(
+            from ..train.step_core import fix_accum_psnr
+
+            stats = fix_accum_psnr(jax.tree_util.tree_map(
                 lambda x: x.mean(axis=0), stats_seq
-            )
+            ))
         else:
             grads, stats = _grads_for(
                 st.params, sample_sharded, bank_rays, bank_rgbs,

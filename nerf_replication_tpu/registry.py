@@ -83,7 +83,19 @@ def _load_from_path(path: str) -> ModuleType:
         return mod
     if not os.path.isfile(key):
         raise ImportError(f"Plugin file {path!r} does not exist")
-    modname = "_nerf_plugin_" + os.path.splitext(os.path.basename(key))[0]
+    # key the module name by the FULL path, not the basename: two plugin
+    # files named e.g. network.py in different directories must not
+    # overwrite each other's sys.modules entry (round-4 advisor finding —
+    # re-import/pickle of the first would silently resolve to the second)
+    import hashlib
+
+    digest = hashlib.sha1(key.encode()).hexdigest()[:12]
+    modname = (
+        "_nerf_plugin_"
+        + os.path.splitext(os.path.basename(key))[0]
+        + "_"
+        + digest
+    )
     spec = importlib.util.spec_from_file_location(modname, key)
     mod = importlib.util.module_from_spec(spec)
     # register BEFORE exec so plugin-defined classes are re-importable by

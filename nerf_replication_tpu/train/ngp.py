@@ -121,13 +121,28 @@ class NGPTrainer:
         # the phase switch is OCCUPANCY-gated, not just step-gated: handing
         # training to the carved march while the grid is still dense feeds
         # it truncated supervision (see loss_fn_march). warmup ends at the
-        # LATER of warmup_steps and occupancy < warmup_exit_occ, with a
-        # hard cap so a pathological scene cannot warm forever.
+        # LATER of warmup_steps and occupancy < warmup_exit_occ; warm mode
+        # can RE-ENGAGE if the grid later re-densifies (a carved march over
+        # a dense grid truncates most rays and the masked loss drops them),
+        # with ngp_warmup_max capping CUMULATIVE warm steps so a
+        # pathological scene cannot warm forever.
         self.warmup_exit_occ = float(ta.get("ngp_warmup_exit_occ", 0.6))
         self.warmup_max = int(ta.get("ngp_warmup_max", 8 * self.warmup_steps))
+        # past warmup_max the per-burst occupancy sync is skipped (it costs
+        # a ~0.3-0.4 s device→host round trip on this tunnel), but a grid
+        # that re-densifies later must still be able to re-engage warm mode
+        # — re-sync every N bursts instead of never (round-4 advisor)
+        self.occ_resync_bursts = int(ta.get("ngp_occ_resync_bursts", 32))
+        # loud diagnostic when the carved march starts dropping rays: the
+        # masked loss silently ignores truncated rays, so a grown grid
+        # shows up only here
+        self.trunc_warn_frac = float(ta.get("ngp_trunc_warn_frac", 0.25))
         self.process_index = jax.process_index()
         self._host_step: int | None = None
         self._last_occ: float = 1.0
+        self._bursts: int = 0
+        self._warm_steps_total: int = 0
+        self._trunc_warned: bool = False
         self._step_fns: dict = {}
         self._render_fns: dict = {}
 
@@ -390,9 +405,29 @@ class NGPTrainer:
                     jnp.float32
                 ))
             )
+            # estimate warm steps already consumed so the cumulative cap
+            # survives restarts (only a host counter otherwise — a
+            # kill/resume loop must not grant a fresh warmup_max each
+            # time). Resumed dense ⇒ every prior step was warm; resumed
+            # carved ⇒ only the mandatory warmup phase was.
+            est = (
+                self._host_step
+                if self._last_occ > self.warmup_exit_occ
+                else min(self._host_step, self.warmup_steps)
+            )
+            self._warm_steps_total = min(est, self.warmup_max)
+        # warm when still inside the mandatory warmup OR the grid is dense
+        # (incl. a LATE re-densification — the carved march over a dense
+        # grid truncates most rays and the masked loss drops them), capped
+        # by cumulative warm steps so a pathological scene cannot warm
+        # forever.
+        # the cumulative cap bounds only the occupancy EXTENSION — the
+        # mandatory step-gated warmup always runs (it is already bounded
+        # by warmup_steps, and a warmup_max configured below warmup_steps
+        # must not cancel it)
         warm = self._host_step < self.warmup_steps or (
             self._last_occ > self.warmup_exit_occ
-            and self._host_step < self.warmup_max
+            and self._warm_steps_total < self.warmup_max
         )
         if warm and self._host_step < self.warmup_steps:
             k = min(k, self.warmup_steps - self._host_step)
@@ -400,15 +435,38 @@ class NGPTrainer:
         if fn is None:
             fn = self._step_fns[(k, warm)] = self._jit_step(k, warm=warm)
         self._host_step += k
+        if warm:
+            self._warm_steps_total += k
         self.last_burst_steps = k  # callers account actual steps run
         self.last_burst_warm = warm
         state, stats = fn(state, bank_rays, bank_rgbs, base_key)
-        if warm or self._host_step < self.warmup_max:
+        self._bursts += 1
+        if (
+            warm
+            or self._host_step < self.warmup_max
+            or (
+                self.occ_resync_bursts > 0
+                and self._bursts % self.occ_resync_bursts == 0
+            )
+        ):
             # the occupancy gate is live (it can re-engage warm if the
-            # grid re-densifies before warmup_max): one scalar sync per
-            # burst. Past warmup_max the sync is skipped so step loops
-            # pipeline dispatches again (a ~0.3-0.4 s tunnel round trip).
+            # grid re-densifies): one scalar sync per burst during warmup,
+            # then every `ngp_occ_resync_bursts` bursts (0 = never) —
+            # skipping most syncs lets step loops pipeline dispatches (a
+            # ~0.3-0.4 s tunnel round trip each), while a late
+            # re-densified grid is still noticed within N bursts.
             self._last_occ = float(stats["occupancy"])
+            if not warm and not self._trunc_warned:
+                tf = float(stats.get("truncated_frac", 0.0))
+                if tf > self.trunc_warn_frac:
+                    self._trunc_warned = True
+                    print(
+                        f"ngp: truncated_frac {tf:.2f} exceeds "
+                        f"{self.trunc_warn_frac} after warmup — the march "
+                        "K budget is dropping far content and those rays "
+                        "are masked out of the loss (raise "
+                        "max_march_samples or check the grid threshold)"
+                    )
         return state, stats
 
     # -- eval ----------------------------------------------------------------
@@ -490,9 +548,11 @@ def fit_ngp(cfg, network=None, log=print):
     the occupancy-accelerated counterpart of trainer.fit (train.py routes
     here), with the same resume/save/eval cadence contract.
 
-    Multi-device NGP is not wired yet: the live grid EMA needs a pmax
-    merge across data shards; refused loudly rather than silently training
-    one chip of a pod (set parallel.data_axis: 1 to opt out)."""
+    Multi-device: data parallelism is wired (shard_map over the data axis;
+    grads/stats pmean'd, the live grid EMA pmax-merged across shards —
+    see ``NGPTrainer._build_step``; tested in test_ngp.py). Model/tensor
+    parallelism is NOT: the occupancy march has no tensor-parallel seam
+    yet, so ``parallel.model_axis > 1`` is refused loudly below."""
     import time
 
     import jax

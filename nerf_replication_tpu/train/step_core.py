@@ -79,7 +79,31 @@ def sampled_grad_step(
     grads = jax.tree_util.tree_map(lambda g: g / grad_accum, gsum)
     # mean stats over microbatches (they are per-microbatch means already)
     stats = jax.tree_util.tree_map(lambda x: x.mean(axis=0), stats_seq)
-    return grads, stats
+    return grads, fix_accum_psnr(stats)
+
+
+def fix_accum_psnr(stats: dict) -> dict:
+    """Recompute psnr from the microbatch-averaged mse.
+
+    psnr is nonlinear in mse: the mean of per-microbatch psnrs is not the
+    psnr of the full-batch mean loss, so logged metrics would shift with
+    grad_accum even though the gradient is exact (round-4 advisor
+    finding). Every accumulating step builder (here and the GSPMD path in
+    parallel/step.py) routes its averaged stats through this. The mse
+    source mirrors each loss module's own psnr choice: the NeRF loss uses
+    loss_f (falling back to loss_c without hierarchical sampling,
+    loss.py), img_fit uses its sole 'loss'."""
+    if "psnr" in stats:
+        from .loss import mse_to_psnr
+
+        base = next(
+            (stats[k] for k in ("loss_f", "loss_c", "loss") if k in stats),
+            None,
+        )
+        if base is not None:
+            stats = dict(stats)
+            stats["psnr"] = mse_to_psnr(base)
+    return stats
 
 
 def _one_grad(loss, params, bank_rays, bank_rgbs, n_rays, near, far,

@@ -37,13 +37,27 @@ def parse_log_file(path: str):
                 continue
             pm, sm = VAL_PSNR_RE.search(line), VAL_SSIM_RE.search(line)
             if pm or sm:
-                val.append(
-                    {
-                        "step": last_step,
-                        "psnr": float(pm.group(1)) if pm else None,
-                        "ssim": float(sm.group(1)) if sm else None,
-                    }
-                )
+                row = {
+                    "step": last_step,
+                    "psnr": float(pm.group(1)) if pm else None,
+                    "ssim": float(sm.group(1)) if sm else None,
+                }
+                # the reference prints PSNR and SSIM of one validation on
+                # SEPARATE lines — merge them into one sample instead of
+                # double-counting the eval (round-3 advisor finding)
+                if (
+                    val
+                    and val[-1]["step"] == last_step
+                    and all(
+                        val[-1][k] is None or row[k] is None
+                        for k in ("psnr", "ssim")
+                    )
+                ):
+                    for k in ("psnr", "ssim"):
+                        if row[k] is not None:
+                            val[-1][k] = row[k]
+                else:
+                    val.append(row)
     return train, val
 
 

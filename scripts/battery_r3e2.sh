@@ -1,9 +1,10 @@
 #!/bin/bash
-# Round-3 battery, stage E: byte-reduction probes for the HBM-bound
-# flagship step (f3: 48.2 GiB = 51.7 GB/step, 597.8 GB/s achieved = 73% of
-# peak, more rays flat). Remat trades saved-activation traffic for recompute FLOPs —
-# exactly the right trade for a bandwidth-bound step with 71 FLOPs/byte —
-# but was only ever measured at 16k rays. Measure it at the headline shape.
+# Round-3 battery, stage E (HISTORICAL): byte-reduction probes for the
+# HBM-bound flagship step. OUTCOME (round 4, PERF.md "f3 closure"): remat
+# LOSES at the headline shape (41.4k vs 47.8k rays/s — the 2x recompute
+# FLOPs cost more than the ~2x byte cut buys at intensity 71), and
+# SplitDense left throughput unchanged (XLA had already fused the
+# concats). Kept for the record; do not re-run expecting a win.
 set -u
 cd "$(dirname "$0")/.."
 log() { echo "[batteryE $(date +%H:%M:%S)] $*"; }

@@ -63,6 +63,15 @@ def main(argv=None):
             BENCH_CONFIG=args.config,
             BENCH_SCAN_STEPS=str(scan_k),
         )
+        # the point's init budget must fail LOUDLY (JSON record with an
+        # init_trail) inside point_timeout — otherwise a wedged tunnel
+        # burns the full point_timeout per point with an opaque kill
+        # (docs/operations.md). Leave ≥300 s of the point budget for the
+        # measurement itself; an explicit env override still wins.
+        env.setdefault(
+            "BENCH_INIT_TOTAL_S",
+            str(max(60, int(args.point_timeout) - 300)),
+        )
         try:
             r = subprocess.run(
                 [sys.executable, os.path.join(_REPO, "bench.py")],

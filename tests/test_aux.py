@@ -439,3 +439,22 @@ def test_registry_loads_plugin_from_file_path(tmp_path):
 
     with pytest.raises(ImportError, match="does not exist"):
         resolve_module(str(tmp_path / "missing_plugin.py"))
+
+    # two plugin files with the SAME basename in different directories must
+    # get distinct sys.modules entries (round-4 advisor: basename-keyed
+    # modules overwrote each other, so re-import/pickle of the first
+    # silently resolved to the second)
+    import sys
+
+    other = tmp_path / "elsewhere" / "my_task_plugin.py"
+    other.parent.mkdir()
+    other.write_text("MAGIC = 100\n")
+    mod2 = resolve_module(str(other))
+    assert mod2.MAGIC == 100 and mod.MAGIC == 41
+    names = [
+        n for n, m in sys.modules.items()
+        if m in (mod, mod2) and n.startswith("_nerf_plugin_")
+    ]
+    assert len(set(names)) == 2, names
+    assert sys.modules[mod.__name__] is mod
+    assert sys.modules[mod2.__name__] is mod2

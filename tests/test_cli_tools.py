@@ -37,6 +37,25 @@ def test_plot_loss_parses_both_formats(tmp_path):
     assert out.exists() and out.stat().st_size > 0
 
 
+def test_plot_loss_merges_split_val_lines(tmp_path):
+    """The reference prints one validation's PSNR and SSIM on SEPARATE
+    console lines — they must merge into ONE val sample, not double-count
+    the eval (round-3 advisor finding)."""
+    log = tmp_path / "train.log"
+    log.write_text(
+        "eta: 0:01:00  epoch: 0  step: 10  loss: 0.5\n"
+        "Average PSNR: 18.5\n"
+        "Average SSIM: 0.75\n"
+        "eta: 0:00:30  epoch: 1  step: 20  loss: 0.25\n"
+        "Average PSNR: 19.5\n"
+        "Average SSIM: 0.81\n"
+    )
+    train, val = plot_loss.parse_log_file(str(log))
+    assert len(val) == 2
+    assert val[0] == {"step": 10, "psnr": 18.5, "ssim": 0.75}
+    assert val[1] == {"step": 20, "psnr": 19.5, "ssim": 0.81}
+
+
 def test_check_grid_cli(tmp_path):
     from nerf_replication_tpu.renderer.occupancy import save_occupancy_grid
 

@@ -163,6 +163,60 @@ def test_ngp_multi_step_burst_matches_single_steps(setup):
     )
 
 
+def test_ngp_occupancy_resyncs_past_warmup_max(setup):
+    """Past ngp_warmup_max the per-burst occupancy sync is throttled to
+    every ``ngp_occ_resync_bursts`` bursts — NOT skipped forever (round-4
+    advisor: a frozen _last_occ could never re-engage warm mode if the
+    grid re-densified)."""
+    root, cfg, net = setup
+    extra = (
+        "task_arg.ngp_warmup_steps", "1",
+        "task_arg.ngp_warmup_max", "2",
+        "task_arg.ngp_warmup_exit_occ", "1.1",  # never blocks the exit
+        "task_arg.ngp_occ_resync_bursts", "2",
+    )
+    cfg2 = tiny_cfg(root, NGP_EXTRA + extra)
+    trainer = make_ngp_trainer(cfg2, net)
+    ds = Dataset(data_root=root, scene="procedural", split="train", H=32, W=32)
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(1)
+
+    state, _ = trainer.make_state(jax.random.PRNGKey(0))
+    # burn past warmup_max
+    for _ in range(3):
+        state, _ = trainer.multi_step(state, bank[0], bank[1], key, 1)
+    assert trainer._host_step >= trainer.warmup_max
+
+    # un-synced burst: _last_occ must NOT move on an off-cadence burst...
+    trainer._bursts = 0  # next burst -> _bursts 1 (odd: no sync)
+    trainer._last_occ = -123.0
+    state, _ = trainer.multi_step(state, bank[0], bank[1], key, 1)
+    assert trainer._last_occ == -123.0
+    # ...and MUST re-sync on the cadence burst (_bursts 2)
+    state, stats = trainer.multi_step(state, bank[0], bank[1], key, 1)
+    assert trainer._last_occ == pytest.approx(float(stats["occupancy"]))
+
+    # a re-densified grid RE-ENGAGES warm mode (the resync exists so this
+    # can happen late in training), bounded by cumulative warm steps
+    assert trainer._warm_steps_total < trainer.warmup_max
+    trainer._last_occ = 2.0  # "grid re-densified"
+    state, _ = trainer.multi_step(state, bank[0], bank[1], key, 1)
+    assert trainer.last_burst_warm
+    # cumulative cap: once warm steps reach warmup_max, warm cannot
+    # re-engage no matter how dense the grid reads
+    trainer._warm_steps_total = trainer.warmup_max
+    trainer._last_occ = 2.0
+    state, _ = trainer.multi_step(state, bank[0], bank[1], key, 1)
+    assert not trainer.last_burst_warm
+
+    # ngp_occ_resync_bursts = 0 disables the resync without crashing
+    trainer.occ_resync_bursts = 0
+    trainer._last_occ = -77.0
+    trainer._bursts = 0
+    state, _ = trainer.multi_step(state, bank[0], bank[1], key, 1)
+    assert trainer._last_occ == -77.0
+
+
 def test_fit_ngp_trains_over_the_mesh(setup, tmp_path):
     """With 8 devices visible, fit_ngp builds the DP mesh: per-shard ray
     sampling, pmean'd grads, pmax-merged live grid — and the epoch loop

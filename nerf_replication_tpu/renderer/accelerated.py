@@ -27,7 +27,7 @@ and the whole renderer is one fused XLA program per chunk shape.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,31 @@ class MarchOptions:
             max_samples=int(ta.get("max_march_samples", 192)),
             white_bkgd=bool(ta.get("white_bkgd", True)),
             chunk_size=int(ta.get("march_chunk_size", 4096)),
+        )
+
+    @classmethod
+    def eval_from_cfg(cls, cfg) -> "MarchOptions":
+        """March options for EVAL renders, decoupled from training's.
+
+        NGP training tunes ``render_step_size`` / ``max_march_samples``
+        for per-step throughput (coarse steps, tight K); rendering
+        held-out images through that budget caps quality (round-4 trail:
+        H=400 topped out at 28.16 dB on the training budget). Eval pays
+        its cost once per image, so ``task_arg.eval_render_step_size`` /
+        ``task_arg.eval_max_march_samples`` override the shared keys for
+        eval executables only (they fall back to the training values when
+        unset — the pre-round-5 behavior). Reference seat: the fps-path
+        march config in volume_renderer.py:249-358."""
+        base = cls.from_cfg(cfg)
+        ta = cfg.task_arg
+        return replace(
+            base,
+            step_size=float(
+                ta.get("eval_render_step_size", base.step_size)
+            ),
+            max_samples=int(
+                ta.get("eval_max_march_samples", base.max_samples)
+            ),
         )
 
 

@@ -306,7 +306,9 @@ class Renderer:
         # occupancy-accelerated state (reference volume_renderer.py:249-259)
         from .accelerated import MarchOptions
 
-        self.march_options = MarchOptions.from_cfg(cfg)
+        # the Renderer's accelerated path only serves EVAL (run.py,
+        # render_video.py) — it takes the eval-specific march budget
+        self.march_options = MarchOptions.eval_from_cfg(cfg)
         self.occupancy_grid = None
         self.grid_bbox = None
         self._march_fns: dict = {}

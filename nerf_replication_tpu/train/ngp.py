@@ -86,6 +86,9 @@ class NGPTrainer:
         self.far = float(ta.far)
         self.bbox = jnp.asarray(cfg.train_dataset.scene_bbox, jnp.float32)
         self.march = MarchOptions.from_cfg(cfg)
+        # eval renders pay their march once per image — they get their own
+        # (finer/deeper) budget instead of training's throughput-tuned one
+        self.eval_march = MarchOptions.eval_from_cfg(cfg)
         self.grid_res = int(ta.get("ngp_grid_res", 64))
         # density threshold follows the EVAL bake's convention
         # (task_arg.occupancy_grid_threshold, σ=1.0 in the lego family)
@@ -502,13 +505,13 @@ class NGPTrainer:
 
         grid = state.grid_ema > self.threshold
         rays_p, n, n_chunks, chunk = _pad_to_chunks(
-            jnp.asarray(batch["rays"]), self.march.chunk_size
+            jnp.asarray(batch["rays"]), self.eval_march.chunk_size
         )
 
         render = self._render_fns.get((n_chunks, chunk))
         if render is None:
             network, near, far = self.network, self.near, self.far
-            bbox, options = self.bbox, self.march
+            bbox, options = self.bbox, self.eval_march
 
             @jax.jit
             def render(params, rays_p, grid):
@@ -533,7 +536,7 @@ class NGPTrainer:
         if n_trunc:
             print(
                 f"ngp render_image: {n_trunc} rays exceeded the "
-                f"max_march_samples={self.march.max_samples} budget while "
+                f"eval march budget K={self.eval_march.max_samples} while "
                 "still transparent (far contributions truncated)"
             )
         return out

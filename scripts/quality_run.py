@@ -241,16 +241,10 @@ def main(argv=None):
     import render_video
 
     renderer = make_renderer(cfg, network)
-    # offline video can afford a double march budget: at the config default
-    # K=192 the chip quality run truncated ~2.3% of spiral rays while still
-    # transparent. Mutate BEFORE the first render only — the march
-    # executable cache does not key on options.
-    from dataclasses import replace as _dc_replace
-
-    renderer.march_options = _dc_replace(
-        renderer.march_options,
-        max_samples=2 * renderer.march_options.max_samples,
-    )
+    # the renderer already takes the eval march budget
+    # (task_arg.eval_max_march_samples / eval_render_step_size —
+    # MarchOptions.eval_from_cfg); the old ad-hoc K-doubling here is
+    # superseded by those config keys.
     renderer.load_occupancy_grid(grid_path)
     frames = render_video.spiral_frames(
         renderer, params, H=min(args.H, 200), W=min(args.H, 200),

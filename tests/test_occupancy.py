@@ -233,6 +233,42 @@ def test_renderer_accelerated_fallback_and_grid_path(tmp_path, setup):
     assert np.isfinite(np.asarray(out_fast["rgb_map_f"])).all()
 
 
+def test_eval_march_budget_decouples_from_training(setup):
+    """``task_arg.eval_render_step_size`` / ``eval_max_march_samples``
+    override the shared march keys for EVAL executables only (VERDICT r4
+    #3: the NGP H=400 trail was quality-capped by rendering through the
+    training budget), falling back to the training values when unset."""
+    from nerf_replication_tpu.renderer.accelerated import MarchOptions
+
+    cfg, network, params = setup
+    base = MarchOptions.from_cfg(cfg)
+    assert MarchOptions.eval_from_cfg(cfg) == base  # unset ⇒ fallback
+
+    cfg2 = cfg.clone()
+    cfg2.defrost()
+    cfg2.task_arg.render_step_size = 0.01
+    cfg2.task_arg.max_march_samples = 64
+    cfg2.task_arg.eval_render_step_size = 0.005
+    cfg2.task_arg.eval_max_march_samples = 256
+    cfg2.freeze()
+    train_opts = MarchOptions.from_cfg(cfg2)
+    eval_opts = MarchOptions.eval_from_cfg(cfg2)
+    assert (train_opts.step_size, train_opts.max_samples) == (0.01, 64)
+    assert (eval_opts.step_size, eval_opts.max_samples) == (0.005, 256)
+
+    # the NGP trainer trains on the former and evals on the latter
+    from nerf_replication_tpu.train.ngp import NGPTrainer
+
+    trainer = NGPTrainer(cfg2, network)
+    assert trainer.march == train_opts
+    assert trainer.eval_march == eval_opts
+
+    # the Renderer's accelerated path only serves eval: it must pick the
+    # eval budget
+    r = make_renderer(cfg2, network)
+    assert r.march_options == eval_opts
+
+
 def test_march_executable_cache_is_bounded(tmp_path, setup):
     """Per-frame-varying (near, far) must not grow the compiled-executable
     cache without bound (VERDICT r1 weak #5): the LRU cap holds and the

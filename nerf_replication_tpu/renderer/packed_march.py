@@ -1,0 +1,185 @@
+"""Globally-packed occupancy march — samples compacted ACROSS rays.
+
+The two-phase march in ``accelerated.py`` compacts each ray's occupied
+positions into a fixed per-ray budget ``[N, K]`` and runs the MLP on every
+slot — including the padding of rays with fewer than K occupied samples.
+At carved occupancy (~5%, mean ~19 occupied samples/ray at S=400) that
+wastes ~70% of the encoder gathers and MLP points, and the per-ray K cap
+truncates exactly the hard rays that need more samples (the round-4 NGP
+trail's quality ceiling).
+
+This module is the TPU-native version of the sample-packing design the
+CUDA originals use (the reference's CUDA marcher compacts alive rays per
+step, volume_renderer.py:298-324; instant-ngp/nerfacc pack samples into a
+flat stream): ONE static-size stream of M = N × cap_avg samples shared by
+the whole batch. Per-ray sample counts become fully dynamic — a hard ray
+may take 200 samples while its neighbors take 3 — with static shapes
+end to end:
+
+1. **Occupancy sweep** (same as accelerated.py): ``occupied [N, S]`` in
+   one bool gather, no MLP.
+2. **Global compaction, one sort**: sort key ``(~occupied)·N·S + idx``
+   over the flattened ``[N·S]`` positions floats every occupied sample to
+   the front IN (ray, t) ORDER (idx = ray·S + s is already lexicographic).
+   Take the first M payload indices — a static-shape alive-list. The sort
+   runs at the chip's 240-330M rows/s (BENCH_PRIMITIVES.jsonl) — ~6 ms at
+   4096×400 — and replaces per-ray argsort + per-ray padding.
+3. **One batched query over [M]** points (gathers of ray rows at
+   98-160M rows/s), then segmented compositing in log space:
+   ``1 − α = exp(−σδ)`` makes the transmittance cumprod EXACTLY
+   ``exp(−cumsum(σδ))``, so per-ray transmittance is an exclusive cumsum
+   minus its value at the ray's segment start — cumsum at 420M rows/s
+   plus one [N]-row gather. No scatter in the forward; the backward of
+   the final per-ray ``segment_sum`` is a gather.
+
+Truncation semantics change from per-ray to GLOBAL: a ray is truncated
+only when the whole stream overflows M (reported per ray, like
+accelerated.py's ``truncated``). With cap_avg ≈ 1.5× the mean occupied
+count the overflow frac is ~0 after the grid carves.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .accelerated import MarchOptions
+from .occupancy import world_to_voxel
+
+
+def march_rays_packed(
+    apply_fn,
+    rays: jax.Array,
+    near: float,
+    far: float,
+    grid: jax.Array,
+    bbox: jax.Array,
+    options: MarchOptions,
+    cap_avg: int = 32,
+    return_samples: bool = False,
+) -> dict:
+    """Render a [N, 6] ray chunk with globally-packed ESS + ERT.
+
+    Output contract matches ``march_rays_accelerated`` (rgb/depth/acc maps,
+    per-ray ``truncated``), plus ``overflow_frac`` — the fraction of
+    occupied samples dropped by the global M = N × cap_avg cap (0.0 once
+    the grid is carved and cap_avg is sized to ~1.5× the occupied mean).
+    """
+    if rays.shape[-1] > 6:
+        raise ValueError(
+            "the occupancy-accelerated march only supports static [N, 6] "
+            f"rays, got {rays.shape[-1]} columns — time-conditioned scenes "
+            "must use the chunked volume renderer (accelerated_renderer: "
+            "false)"
+        )
+    rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
+    n_rays = rays.shape[0]
+    resolution = grid.shape[0]
+    step = options.step_size
+    n_steps = max(math.ceil((far - near) / step - 1e-9), 1)
+    m_cap = min(int(n_rays * cap_avg), n_rays * n_steps)
+
+    # phase 1: occupancy of every march position, one gather, no MLP
+    ts = near + jnp.arange(n_steps, dtype=jnp.float32) * step
+    pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[None, :, None]
+    vox = world_to_voxel(pts, bbox, resolution)  # [N, S, 3]
+    flat_vox = (
+        vox[..., 0] * resolution + vox[..., 1]
+    ) * resolution + vox[..., 2]
+    occupied = jnp.take(grid.reshape(-1), flat_vox)  # [N, S] bool
+
+    # phase 2: ONE global sort compacts every occupied (ray, t) position
+    # to the front of a flat [N·S] stream in (ray, t) order.
+    total = n_rays * n_steps
+    occ_flat = occupied.reshape(-1)
+    idx = jnp.arange(total, dtype=jnp.int32)
+    key = jnp.where(occ_flat, idx, total + idx)
+    _, order = jax.lax.sort_key_val(key, idx)
+    order = order[:m_cap]  # static [M] alive-list
+    valid = occ_flat[order]  # [M] bool (False ⇒ stream tail padding)
+
+    ray_id = order // n_steps  # [M] int32, nondecreasing over valid prefix
+    s_id = order % n_steps
+    t_m = near + s_id.astype(jnp.float32) * step
+
+    o_m = rays_o[ray_id]
+    d_m = rays_d[ray_id]
+    pts_m = o_m + d_m * t_m[..., None]  # [M, 3]
+    viewdirs = rays_d / jnp.linalg.norm(rays_d, axis=-1, keepdims=True)
+
+    # the network contract is [rays, samples, 3] points + [rays, 3] dirs;
+    # the packed stream is "M rays of one sample each"
+    raw = apply_fn(pts_m[:, None, :], viewdirs[ray_id], "fine")[:, 0, :]
+
+    rgb = jax.nn.sigmoid(raw[..., :3])  # [M, 3]
+    sigma = jax.nn.relu(raw[..., 3])  # [M]
+    dists = step * jnp.linalg.norm(d_m, axis=-1)
+    # 1 − α = exp(−σδ): transmittance in log space is EXACT, no clamps
+    tau = sigma * dists * valid.astype(jnp.float32)  # [M]
+    c = jnp.cumsum(tau)
+    e = c - tau  # exclusive prefix: Σ τ of stream-earlier samples
+
+    # per-ray segment starts: samples are (ray, t)-sorted, so ray r's
+    # segment begins at cumsum(n_occ)[r-1], clamped to the stream cap
+    n_occ = jnp.sum(occupied, axis=-1)  # [N]
+    cum_occ = jnp.cumsum(n_occ)
+    seg_start = jnp.minimum(cum_occ - n_occ, m_cap - 1).astype(jnp.int32)
+    e0 = e[seg_start]  # [N]; gather — bwd is an [N]-row scatter-add
+    trans = jnp.exp(-(e - e0[ray_id]))  # T BEFORE each sample
+    alpha = 1.0 - jnp.exp(-tau)
+    # ERT: zero weight once transmittance fell below the threshold —
+    # identical composited output to the reference's dead-ray kill
+    # (volume_renderer.py:340-341), like accelerated.py
+    weights = trans * alpha * (trans >= options.transmittance_threshold)
+
+    seg = jnp.where(valid, ray_id, n_rays)  # route padding to a bin we drop
+    contrib = jnp.concatenate(
+        [weights[:, None] * rgb, weights[:, None], (weights * t_m)[:, None]],
+        axis=-1,
+    )  # [M, 5]
+    sums = jax.ops.segment_sum(
+        contrib, seg, num_segments=n_rays + 1, indices_are_sorted=True
+    )[:n_rays]
+    rgb_map = sums[:, 0:3]
+    acc_map = sums[:, 3]
+    depth_map = sums[:, 4]
+    if options.white_bkgd:
+        rgb_map = rgb_map + (1.0 - acc_map[..., None])
+
+    # truncation is GLOBAL here: ray r loses samples only if the stream
+    # overflowed before r's segment ended, and matters only while the ray
+    # was still transparent at its last kept sample
+    kept_end = jnp.minimum(cum_occ, m_cap)
+    # some of r's samples fell off the stream (n_occ guard: a ray with NO
+    # occupied samples renders pure background correctly and must not be
+    # flagged just because earlier rays filled the cap)
+    lost = (cum_occ > kept_end) & (n_occ > 0)
+    # transmittance after the ray's last KEPT sample = exp(-(c_end - e0))
+    c_end = c[jnp.maximum(kept_end - 1, 0)]
+    t_after = jnp.exp(-(c_end - e0))
+    still_alive = t_after >= options.transmittance_threshold
+    n_total_occ = cum_occ[-1]
+    out = {
+        "rgb_map_f": rgb_map,
+        "depth_map_f": depth_map,
+        "acc_map_f": acc_map,
+        "truncated": lost & still_alive,
+        "overflow_frac": (
+            jnp.maximum(n_total_occ - m_cap, 0).astype(jnp.float32)
+            / jnp.maximum(n_total_occ, 1).astype(jnp.float32)
+        ),
+    }
+    if return_samples:
+        out["sample_flat"] = jax.lax.stop_gradient(
+            occ_to_flat(flat_vox, order)
+        )
+        out["sample_sigma"] = jax.lax.stop_gradient(sigma)
+        out["sample_valid"] = valid.astype(jnp.float32)
+    return out
+
+
+def occ_to_flat(flat_vox: jax.Array, order: jax.Array) -> jax.Array:
+    """Gather the [N, S] flat voxel ids at the packed stream's positions."""
+    return flat_vox.reshape(-1)[order].astype(jnp.int32)

@@ -89,6 +89,17 @@ class NGPTrainer:
         # eval renders pay their march once per image — they get their own
         # (finer/deeper) budget instead of training's throughput-tuned one
         self.eval_march = MarchOptions.eval_from_cfg(cfg)
+        # globally-packed sample stream (renderer/packed_march.py): the
+        # MLP/encoder run only on OCCUPIED samples compacted across rays —
+        # ~2.7x fewer encoder rows at carved occupancy than the per-ray
+        # [N, K] compaction, and per-ray budgets become dynamic (a hard
+        # ray can take 10x the samples of an easy one). cap_avg is the
+        # stream budget in mean samples/ray.
+        self.packed_march = bool(ta.get("ngp_packed_march", False))
+        self.packed_cap_avg = int(ta.get("ngp_packed_cap_avg", 32))
+        self.packed_cap_avg_eval = int(
+            ta.get("ngp_packed_cap_avg_eval", 4 * self.packed_cap_avg)
+        )
         self.grid_res = int(ta.get("ngp_grid_res", 64))
         # density threshold follows the EVAL bake's convention
         # (task_arg.occupancy_grid_threshold, σ=1.0 in the lego family)
@@ -201,6 +212,7 @@ class NGPTrainer:
         sample_cap = self.sample_update_cap
         s_warm = int(self.cfg.task_arg.get("ngp_warmup_samples", 128))
         white_bkgd = options.white_bkgd
+        packed, packed_cap = self.packed_march, self.packed_cap_avg
 
         def one_step(state, bank_rays, bank_rgbs, base_key):
             if axis_name is not None:
@@ -217,10 +229,18 @@ class NGPTrainer:
             grid = state.grid_ema > thr  # bool [R,R,R], jit-static shape
 
             def loss_fn_march(p):
-                out = march_rays_accelerated(
-                    apply_fn_for(p), rays, near, far, grid, bbox, options,
-                    return_samples=True,
-                )
+                if packed:
+                    from ..renderer.packed_march import march_rays_packed
+
+                    out = march_rays_packed(
+                        apply_fn_for(p), rays, near, far, grid, bbox,
+                        options, cap_avg=packed_cap, return_samples=True,
+                    )
+                else:
+                    out = march_rays_accelerated(
+                        apply_fn_for(p), rays, near, far, grid, bbox,
+                        options, return_samples=True,
+                    )
                 # EXCLUDE truncated rays from the loss: a ray that ran out
                 # of K budget rendered only its near content — supervising
                 # that against the full ground truth actively corrupts the
@@ -231,7 +251,7 @@ class NGPTrainer:
                     (out["rgb_map_f"] - rgbs) ** 2, axis=-1
                 )
                 l = jnp.sum(per_ray * w) / jnp.maximum(jnp.sum(w), 1.0)
-                return l, (out, {
+                stats = {
                     "loss": l,
                     "psnr": mse_to_psnr(l),
                     "occupancy": jnp.mean(grid.astype(jnp.float32)),
@@ -240,7 +260,11 @@ class NGPTrainer:
                     "truncated_frac": jnp.mean(
                         out["truncated"].astype(jnp.float32)
                     ),
-                })
+                }
+                if packed:
+                    # occupied samples dropped by the global stream cap
+                    stats["overflow_frac"] = out["overflow_frac"]
+                return l, (out, stats)
 
             def loss_fn_warm(p):
                 # warmup: NO occupancy march — plain stratified volume
@@ -463,12 +487,17 @@ class NGPTrainer:
                 tf = float(stats.get("truncated_frac", 0.0))
                 if tf > self.trunc_warn_frac:
                     self._trunc_warned = True
+                    knob = (
+                        "ngp_packed_cap_avg"
+                        if self.packed_march
+                        else "max_march_samples"
+                    )
                     print(
                         f"ngp: truncated_frac {tf:.2f} exceeds "
                         f"{self.trunc_warn_frac} after warmup — the march "
-                        "K budget is dropping far content and those rays "
-                        "are masked out of the loss (raise "
-                        "max_march_samples or check the grid threshold)"
+                        "budget is dropping far content and those rays "
+                        f"are masked out of the loss (raise {knob} or "
+                        "check the grid threshold)"
                     )
         return state, stats
 
@@ -512,6 +541,7 @@ class NGPTrainer:
         if render is None:
             network, near, far = self.network, self.near, self.far
             bbox, options = self.bbox, self.eval_march
+            packed, cap_eval = self.packed_march, self.packed_cap_avg_eval
 
             @jax.jit
             def render(params, rays_p, grid):
@@ -520,6 +550,14 @@ class NGPTrainer:
                 )
 
                 def body(chunk_rays):
+                    if packed:
+                        from ..renderer.packed_march import march_rays_packed
+
+                        out = march_rays_packed(
+                            apply_fn, chunk_rays, near, far, grid, bbox,
+                            options, cap_avg=cap_eval,
+                        )
+                        return out
                     return march_rays_accelerated(
                         apply_fn, chunk_rays, near, far, grid, bbox, options
                     )
@@ -529,16 +567,33 @@ class NGPTrainer:
             self._render_fns[(n_chunks, chunk)] = render
 
         out = render(state.params, rays_p, grid)
+        # per-chunk scalar, not per-ray: pull it out before unpadding and
+        # surface the stream-cap diagnostic instead of discarding it
+        overflow = out.pop("overflow_frac", None)
         out = _unpad_outputs(out, n)
-        # surface the K-budget diagnostic like Renderer.render_accelerated
-        # does instead of silently dropping far content
+        # surface the budget diagnostics like Renderer.render_accelerated
+        # does instead of silently dropping far content — citing the knob
+        # that actually bounds the active march mode
         n_trunc = int(np.asarray(jnp.sum(out.pop("truncated"))))
         if n_trunc:
-            print(
-                f"ngp render_image: {n_trunc} rays exceeded the "
-                f"eval march budget K={self.eval_march.max_samples} while "
-                "still transparent (far contributions truncated)"
+            budget = (
+                f"ngp_packed_cap_avg_eval={self.packed_cap_avg_eval}"
+                if self.packed_march
+                else f"eval K={self.eval_march.max_samples}"
             )
+            print(
+                f"ngp render_image: {n_trunc} rays exceeded the march "
+                f"budget ({budget}) while still transparent (far "
+                "contributions truncated)"
+            )
+        if overflow is not None:
+            max_of = float(np.asarray(jnp.max(overflow)))
+            if max_of > 0:
+                print(
+                    f"ngp render_image: packed stream overflow up to "
+                    f"{max_of:.1%} of occupied samples per chunk — raise "
+                    "ngp_packed_cap_avg_eval"
+                )
         return out
 
 

@@ -91,6 +91,15 @@ def main(argv=None):
                 "task_arg.ngp_training", "true",
                 "task_arg.ngp_grid_res", "128",
             ))
+        elif arm == "ngp_packed":
+            # globally-packed sample stream (renderer/packed_march.py):
+            # encoder/MLP run only on occupied samples compacted across
+            # rays — the round-5 throughput lever over the [N, K] march
+            cfg = build_cfg((
+                "task_arg.ngp_training", "true",
+                "task_arg.ngp_grid_res", "128",
+                "task_arg.ngp_packed_march", "true",
+            ))
         else:
             cfg = build_cfg(())
         network = make_network(cfg)
@@ -100,7 +109,7 @@ def main(argv=None):
         bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
         key = jax.random.PRNGKey(1)
 
-        if arm == "ngp":
+        if arm.startswith("ngp"):
             from nerf_replication_tpu.train.ngp import make_ngp_trainer
 
             trainer = make_ngp_trainer(cfg, network)
@@ -126,7 +135,7 @@ def main(argv=None):
                 state, stats = trainer.multi_step(
                     state, bank[0], bank[1], key
                 )
-                if arm == "ngp":
+                if arm.startswith("ngp"):
                     k = trainer.last_burst_steps
                     if phase_switch is None and not trainer.last_burst_warm:
                         phase_switch = (steps + it, time.time() - t0)
@@ -137,7 +146,7 @@ def main(argv=None):
             steps += it
         dt = time.time() - t0
 
-        if arm == "ngp":
+        if arm.startswith("ngp"):
             result = trainer.val(
                 state, test_ds, evaluator, max_images=args.test_views
             )
@@ -158,9 +167,13 @@ def main(argv=None):
             "n_rays": args.n_rays,
             "ts": round(time.time(), 1),
         }
-        if arm == "ngp":
+        if arm.startswith("ngp"):
             rec["occupancy"] = round(float(stats["occupancy"]), 4)
             rec["truncated_frac"] = round(float(stats["truncated_frac"]), 4)
+            if "overflow_frac" in stats:
+                rec["overflow_frac"] = round(
+                    float(stats["overflow_frac"]), 4
+                )
             # train-batch psnr: the val render is blind while the grid is
             # dense (K-budget truncation renders ~background), so this is
             # the only honest learning signal during warmup

@@ -241,10 +241,18 @@ def main(argv=None):
     import render_video
 
     renderer = make_renderer(cfg, network)
-    # the renderer already takes the eval march budget
-    # (task_arg.eval_max_march_samples / eval_render_step_size —
-    # MarchOptions.eval_from_cfg); the old ad-hoc K-doubling here is
-    # superseded by those config keys.
+    # the renderer takes the eval march budget when the config defines it
+    # (task_arg.eval_max_march_samples — MarchOptions.eval_from_cfg). For
+    # configs without eval keys, keep the measured video margin: at the
+    # shared K=192 the chip quality run truncated ~2.3% of spiral rays
+    # while still transparent, so offline video doubles the budget.
+    if "eval_max_march_samples" not in cfg.task_arg:
+        from dataclasses import replace as _dc_replace
+
+        renderer.march_options = _dc_replace(
+            renderer.march_options,
+            max_samples=2 * renderer.march_options.max_samples,
+        )
     renderer.load_occupancy_grid(grid_path)
     frames = render_video.spiral_frames(
         renderer, params, H=min(args.H, 200), W=min(args.H, 200),

@@ -1,0 +1,230 @@
+"""Globally-packed occupancy march (renderer/packed_march.py): exact
+compositing parity with the per-ray [N, K] march when neither truncates,
+global-overflow semantics, differentiability, and the NGP trainer's packed
+mode end to end."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.blender import Dataset
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.renderer.accelerated import (
+    MarchOptions,
+    march_rays_accelerated,
+)
+from nerf_replication_tpu.renderer.packed_march import march_rays_packed
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_packed"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4, n_test=1)
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "64"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+
+    def apply_fn(pts, dirs, model):
+        return network.apply(params, pts, dirs, model=model)
+
+    rng = np.random.default_rng(7)
+    n = 64
+    rays = np.concatenate(
+        [
+            np.tile([0.0, 0.0, 4.0], (n, 1)),
+            np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3)),
+        ],
+        -1,
+    ).astype(np.float32)
+
+    bbox = jnp.asarray(cfg.train_dataset.scene_bbox, jnp.float32)
+    # a carved grid with structure: occupied box in the middle
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    return cfg, apply_fn, jnp.asarray(rays), jnp.asarray(grid), bbox
+
+
+def test_packed_matches_per_ray_march_when_neither_truncates(setup):
+    """With generous budgets on both sides the packed stream must composite
+    EXACTLY like the [N, K] march: 1−α = exp(−σδ) makes the log-space
+    segmented transmittance the same number as the cumprod, so rgb/depth/
+    acc agree to float tolerance, per ray."""
+    cfg, apply_fn, rays, grid, bbox = setup
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+    )
+    a = march_rays_accelerated(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options
+    )
+    p = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options, cap_avg=16
+    )
+    assert not bool(a["truncated"].any())
+    assert float(p["overflow_frac"]) == 0.0
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        np.testing.assert_allclose(
+            np.asarray(p[k]), np.asarray(a[k]), rtol=2e-4, atol=2e-5,
+            err_msg=k,
+        )
+    assert not bool(p["truncated"].any())
+
+
+def test_packed_ert_matches_reference_semantics(setup):
+    """A high transmittance threshold exercises early termination: both
+    formulations must zero the same samples' weights."""
+    cfg, apply_fn, rays, grid, bbox = setup
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64,
+        transmittance_threshold=0.5,
+    )
+    a = march_rays_accelerated(apply_fn, rays, 2.0, 6.0, grid, bbox, options)
+    p = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options, cap_avg=16
+    )
+    for k in ("rgb_map_f", "acc_map_f"):
+        np.testing.assert_allclose(
+            np.asarray(p[k]), np.asarray(a[k]), rtol=2e-4, atol=2e-5,
+            err_msg=k,
+        )
+
+
+def test_packed_global_overflow_reports_and_truncates_tail_rays(setup):
+    """A starved global cap must (a) report the dropped-occupied fraction,
+    (b) flag truncated only for rays whose samples fell off the stream
+    while still transparent — rays early in the batch keep full budgets."""
+    cfg, apply_fn, rays, grid, bbox = setup
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+    )
+    full = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options, cap_avg=16
+    )
+    starved = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options, cap_avg=2
+    )
+    assert float(starved["overflow_frac"]) > 0.0
+    trunc = np.asarray(starved["truncated"])
+    assert trunc.any()
+    # rays BEFORE the overflow point are untouched: their maps equal the
+    # generous-cap render
+    first_bad = int(np.argmax(trunc))
+    assert first_bad > 0
+    np.testing.assert_allclose(
+        np.asarray(starved["rgb_map_f"][:first_bad]),
+        np.asarray(full["rgb_map_f"][:first_bad]),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_packed_march_is_differentiable(setup):
+    """Grads must flow through the packed stream (sort indices are
+    constant; gather/cumsum/segment_sum all differentiate) and be finite."""
+    cfg, apply_fn, rays, grid, bbox = setup
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+    )
+    gt = jnp.ones((rays.shape[0], 3)) * 0.5
+
+    def loss_fn(p):
+        out = march_rays_packed(
+            lambda pts, d, m: network.apply(p, pts, d, model=m),
+            rays, 2.0, 6.0, grid, bbox, options, cap_avg=8,
+        )
+        return jnp.mean((out["rgb_map_f"] - gt) ** 2)
+
+    g = jax.grad(loss_fn)(params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert leaves and all(
+        bool(jnp.isfinite(leaf).all()) for leaf in leaves
+    )
+    # the fine trunk actually receives signal
+    total = sum(float(jnp.abs(leaf).sum()) for leaf in leaves)
+    assert total > 0.0
+
+
+def test_packed_return_samples_feed_grid_maintenance(setup):
+    """return_samples must expose [M] flat voxel ids / sigmas / valid mask
+    (the NGP live-grid scatter-max consumes them flattened)."""
+    cfg, apply_fn, rays, grid, bbox = setup
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+    )
+    out = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options, cap_avg=8,
+        return_samples=True,
+    )
+    m = rays.shape[0] * 8
+    assert out["sample_flat"].shape == (m,)
+    assert out["sample_sigma"].shape == (m,)
+    assert out["sample_valid"].shape == (m,)
+    flat = np.asarray(out["sample_flat"])
+    valid = np.asarray(out["sample_valid"]) > 0
+    # every VALID sample's voxel is occupied in the grid
+    assert np.asarray(grid).reshape(-1)[flat[valid]].all()
+
+
+def test_ngp_trainer_packed_mode_trains_and_carves(setup):
+    """ngp_packed_march: true routes the march loss through the packed
+    stream; training must reduce loss and keep the live grid finite, and
+    eval must render finite images through the packed path."""
+    cfg, apply_fn, rays, grid, bbox = setup
+    root = cfg.train_dataset.data_root
+    extra = [
+        "task_arg.N_rays", "128",
+        "task_arg.ngp_training", "true",
+        "task_arg.ngp_grid_res", "16",
+        "task_arg.ngp_packed_march", "true",
+        "task_arg.ngp_packed_cap_avg", "8",
+        "task_arg.ngp_warmup_steps", "4",
+        "task_arg.ngp_warmup_samples", "16",
+        "task_arg.ngp_warmup_exit_occ", "1.1",
+        "task_arg.render_step_size", "0.25",
+        "task_arg.max_march_samples", "16",
+        "task_arg.march_chunk_size", "64",
+    ]
+    cfg2 = tiny_cfg(root, extra)
+    from nerf_replication_tpu.train.ngp import make_ngp_trainer
+
+    net = make_network(cfg2)
+    trainer = make_ngp_trainer(cfg2, net)
+    assert trainer.packed_march
+    ds = Dataset(data_root=root, scene="procedural", split="train", H=16,
+                 W=16)
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(1)
+
+    state, _ = trainer.make_state(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(20):
+        state, stats = trainer.step(state, bank[0], bank[1], key)
+        losses.append(float(stats["loss"]))
+    assert np.isfinite(losses).all()
+    # signal reaches the params through the packed march (per-step noise
+    # from the masked loss over a still-dense grid is expected — demand
+    # improvement, not monotonicity)
+    assert min(losses[5:]) < losses[0]
+    # past warmup the packed stats surface the overflow diagnostic
+    assert not trainer.last_burst_warm
+    assert "overflow_frac" in stats
+    assert bool(jnp.isfinite(state.grid_ema).all())
+
+    out = trainer.render_image(state, {"rays": bank[0][:128]})
+    assert np.isfinite(np.asarray(out["rgb_map_f"])).all()

@@ -84,6 +84,44 @@ class MarchOptions:
         )
 
 
+def occupancy_sweep(rays, near, far, grid, bbox, step_size):
+    """Phase 1 shared by the per-ray and packed marches: classify every
+    fixed-step march position of every ray against the occupancy grid in
+    one vectorized gather (no MLP).
+
+    Returns ``(ts [S], flat_vox [N, S] voxel ids, occupied [N, S] bool,
+    n_steps)``. torch.arange(near, far, Δ) semantics: ceil((far−near)/Δ)
+    positions, far excluded (the epsilon keeps exactly-divisible ranges
+    from gaining one). Zero-direction rays (chunk/shard PADDING) are
+    forced unoccupied: their positions all collapse onto one voxel and
+    would otherwise consume march budget / inflate overflow stats.
+    """
+    import math
+
+    if rays.shape[-1] > 6:
+        # deliberate: an occupancy grid is a STATIC scene-geometry bake —
+        # marching time-conditioned (7-column) rays against it would skip
+        # space that is empty in one frame but occupied in another. Dynamic
+        # scenes render through the chunked volume path (which threads t).
+        raise ValueError(
+            "the occupancy-accelerated march only supports static [N, 6] "
+            f"rays, got {rays.shape[-1]} columns — time-conditioned scenes "
+            "must use the chunked volume renderer (accelerated_renderer: "
+            "false)"
+        )
+    rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
+    resolution = grid.shape[0]
+    n_steps = max(math.ceil((far - near) / step_size - 1e-9), 1)
+    ts = near + jnp.arange(n_steps, dtype=jnp.float32) * step_size
+    pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[None, :, None]
+    vox = world_to_voxel(pts, bbox, resolution)  # [N, S, 3]
+    flat = (vox[..., 0] * resolution + vox[..., 1]) * resolution + vox[..., 2]
+    occupied = jnp.take(grid.reshape(-1), flat)  # [N, S] bool
+    real = jnp.sum(rays_d * rays_d, axis=-1) > 0.0  # [N]
+    occupied = occupied & real[:, None]
+    return ts, flat, occupied, n_steps
+
+
 def march_rays_accelerated(
     apply_fn,
     rays: jax.Array,
@@ -100,34 +138,15 @@ def march_rays_accelerated(
     live grid maintenance feeds on (train/ngp.py): ``sample_flat`` [N, K]
     int32 flat voxel ids, ``sample_sigma`` [N, K], ``sample_valid`` [N, K]
     bool — gradients stopped (grid maintenance must not backprop)."""
-    import math
-
-    if rays.shape[-1] > 6:
-        # deliberate: an occupancy grid is a STATIC scene-geometry bake —
-        # marching time-conditioned (7-column) rays against it would skip
-        # space that is empty in one frame but occupied in another. Dynamic
-        # scenes render through the chunked volume path (which threads t).
-        raise ValueError(
-            "the occupancy-accelerated march only supports static [N, 6] "
-            f"rays, got {rays.shape[-1]} columns — time-conditioned scenes "
-            "must use the chunked volume renderer (accelerated_renderer: "
-            "false)"
-        )
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     n_rays = rays.shape[0]
-    resolution = grid.shape[0]
     step = options.step_size
-    # torch.arange(near, far, Δ) semantics: ceil((far-near)/Δ) positions, far
-    # excluded (the epsilon keeps exactly-divisible ranges from gaining one)
-    n_steps = max(math.ceil((far - near) / step - 1e-9), 1)
     k = options.max_samples
 
     # phase 1: occupancy of every march position, one gather, no MLP
-    ts = near + jnp.arange(n_steps, dtype=jnp.float32) * step
-    pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[None, :, None]
-    vox = world_to_voxel(pts, bbox, resolution)  # [N, S, 3]
-    flat = (vox[..., 0] * resolution + vox[..., 1]) * resolution + vox[..., 2]
-    occupied = jnp.take(grid.reshape(-1), flat)  # [N, S] bool
+    ts, flat, occupied, n_steps = occupancy_sweep(
+        rays, near, far, grid, bbox, step
+    )
 
     # phase 2: compact the first K occupied positions per ray.
     # stable argsort on ~occupied floats the True entries to the front in

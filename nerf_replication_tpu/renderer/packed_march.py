@@ -40,13 +40,10 @@ count the overflow frac is ~0 after the grid carves.
 
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 
-from .accelerated import MarchOptions
-from .occupancy import world_to_voxel
+from .accelerated import MarchOptions, occupancy_sweep
 
 
 def march_rays_packed(
@@ -67,28 +64,18 @@ def march_rays_packed(
     occupied samples dropped by the global M = N × cap_avg cap (0.0 once
     the grid is carved and cap_avg is sized to ~1.5× the occupied mean).
     """
-    if rays.shape[-1] > 6:
-        raise ValueError(
-            "the occupancy-accelerated march only supports static [N, 6] "
-            f"rays, got {rays.shape[-1]} columns — time-conditioned scenes "
-            "must use the chunked volume renderer (accelerated_renderer: "
-            "false)"
-        )
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     n_rays = rays.shape[0]
-    resolution = grid.shape[0]
     step = options.step_size
-    n_steps = max(math.ceil((far - near) / step - 1e-9), 1)
-    m_cap = min(int(n_rays * cap_avg), n_rays * n_steps)
 
-    # phase 1: occupancy of every march position, one gather, no MLP
-    ts = near + jnp.arange(n_steps, dtype=jnp.float32) * step
-    pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[None, :, None]
-    vox = world_to_voxel(pts, bbox, resolution)  # [N, S, 3]
-    flat_vox = (
-        vox[..., 0] * resolution + vox[..., 1]
-    ) * resolution + vox[..., 2]
-    occupied = jnp.take(grid.reshape(-1), flat_vox)  # [N, S] bool
+    # phase 1: occupancy of every march position (shared with the per-ray
+    # march — one implementation, exact-parity contract). Zero-direction
+    # padding rays come back fully unoccupied, so they never consume
+    # stream budget or inflate overflow_frac.
+    ts, flat_vox, occupied, n_steps = occupancy_sweep(
+        rays, near, far, grid, bbox, step
+    )
+    m_cap = min(int(n_rays * cap_avg), n_rays * n_steps)
 
     # phase 2: ONE global sort compacts every occupied (ray, t) position
     # to the front of a flat [N·S] stream in (ray, t) order.
@@ -156,9 +143,13 @@ def march_rays_packed(
     # occupied samples renders pure background correctly and must not be
     # flagged just because earlier rays filled the cap)
     lost = (cum_occ > kept_end) & (n_occ > 0)
-    # transmittance after the ray's last KEPT sample = exp(-(c_end - e0))
+    # transmittance after the ray's last KEPT sample = exp(-(c_end - e0)).
+    # A ray that kept ZERO samples (its whole segment fell past the cap)
+    # is trivially still transparent — computing from the clamped indices
+    # would read ANOTHER ray's tau and could silently unflag it.
+    kept_n = kept_end - jnp.minimum(cum_occ - n_occ, m_cap)
     c_end = c[jnp.maximum(kept_end - 1, 0)]
-    t_after = jnp.exp(-(c_end - e0))
+    t_after = jnp.where(kept_n > 0, jnp.exp(-(c_end - e0)), 1.0)
     still_alive = t_after >= options.transmittance_threshold
     n_total_occ = cum_occ[-1]
     out = {

@@ -28,6 +28,10 @@ def main(argv=None):
     p.add_argument("--remat", nargs="+", default=["false", "true"])
     p.add_argument("--scan_steps", type=int, nargs="+", default=[1],
                    help="K optimizer steps per dispatch (lax.scan burst)")
+    p.add_argument("--grad_accum", type=int, nargs="+", default=[1],
+                   help="microbatch accumulation (kills the 16k+ hash "
+                        "HBM cliff: activation memory bounded by one "
+                        "microbatch — PERF.md round 4)")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--point_timeout", type=float, default=1200.0)
     p.add_argument("--config", default="lego.yaml",
@@ -51,8 +55,9 @@ def main(argv=None):
 
     import itertools
 
-    for n_rays, dtype, remat, scan_k in itertools.product(
-        args.rays, args.dtypes, args.remat, args.scan_steps
+    for n_rays, dtype, remat, scan_k, accum in itertools.product(
+        args.rays, args.dtypes, args.remat, args.scan_steps,
+        args.grad_accum,
     ):
         env = dict(
             os.environ,
@@ -63,6 +68,10 @@ def main(argv=None):
             BENCH_CONFIG=args.config,
             BENCH_SCAN_STEPS=str(scan_k),
         )
+        if accum > 1:
+            extra = f"task_arg.grad_accum {accum}"
+            prev = env.get("BENCH_OPTS", "")
+            env["BENCH_OPTS"] = (prev + " " + extra).strip()
         # the point's init budget must fail LOUDLY (JSON record with an
         # init_trail) inside point_timeout — otherwise a wedged tunnel
         # burns the full point_timeout per point with an opaque kill
@@ -89,8 +98,8 @@ def main(argv=None):
             # sweep and lose every prior record
             rec = {"error": f"point exceeded {args.point_timeout}s"}
         rec.update(n_rays=n_rays, dtype=dtype, remat=remat == "true",
-                   scan_steps=scan_k, config=args.config,
-                   ts=round(time.time(), 1))
+                   scan_steps=scan_k, grad_accum=accum,
+                   config=args.config, ts=round(time.time(), 1))
         print(json.dumps(rec), flush=True)
         _emit(rec)  # written per point: a crash keeps prior records
     if out_f:

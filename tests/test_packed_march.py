@@ -132,6 +132,45 @@ def test_packed_global_overflow_reports_and_truncates_tail_rays(setup):
     )
 
 
+def test_packed_pad_rays_and_fully_dropped_segments(setup):
+    """Zero-direction padding rays must consume no stream budget and not
+    inflate overflow_frac; a ray whose WHOLE segment falls past the cap
+    must still be flagged truncated (its transmittance is trivially 1 —
+    it must not read another ray's tau through the clamped gather)."""
+    cfg, apply_fn, rays, grid, bbox = setup
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+    )
+    pads = jnp.zeros((32, 6), jnp.float32)
+    padded = jnp.concatenate([rays, pads], axis=0)
+    base = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options, cap_avg=16
+    )
+    with_pads = march_rays_packed(
+        apply_fn, padded, 2.0, 6.0, grid, bbox, options, cap_avg=16
+    )
+    # pads add stream capacity (M = N*cap) but zero occupied samples
+    assert float(with_pads["overflow_frac"]) == 0.0
+    np.testing.assert_allclose(
+        np.asarray(with_pads["rgb_map_f"][: rays.shape[0]]),
+        np.asarray(base["rgb_map_f"]),
+        rtol=2e-4, atol=2e-5,
+    )
+    assert not bool(with_pads["truncated"][rays.shape[0]:].any())
+
+    # cap_avg=1 on the unpadded batch: late rays lose their ENTIRE
+    # segment; every sample-losing, still-transparent ray must be flagged
+    starved = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options, cap_avg=1
+    )
+    trunc = np.asarray(starved["truncated"])
+    acc = np.asarray(starved["acc_map_f"])
+    # rays that composited nothing but DO cross occupied space: truncated
+    fully_dropped = (acc < 1e-6) & (np.asarray(base["acc_map_f"]) > 1e-3)
+    assert fully_dropped.any()
+    assert trunc[fully_dropped].all()
+
+
 def test_packed_march_is_differentiable(setup):
     """Grads must flow through the packed stream (sort indices are
     constant; gather/cumsum/segment_sum all differentiate) and be finite."""

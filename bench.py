@@ -57,7 +57,7 @@ def main():
     # sweep point into BENCH_DEFAULTS.json so the driver's plain
     # `python bench.py` (no envs) runs the best known config. Envs still win.
     defaults = {"n_rays": 4096, "steps": 50, "config": "lego.yaml",
-                "dtype": "bfloat16", "remat": "false"}
+                "dtype": "bfloat16", "remat": "false", "grad_accum": 1}
     try:
         with open(os.path.join(_REPO, "BENCH_DEFAULTS.json")) as f:
             defaults.update(json.load(f))
@@ -86,6 +86,12 @@ def main():
             # for the latency-bound small-batch regime (PERF.md)
             "task_arg.scan_steps",
             os.environ.get("BENCH_SCAN_STEPS", str(defaults.get("scan_steps", 1))),
+            # microbatch accumulation — promoted with the winning sweep
+            # point (a promoted accum row must replay WITH accumulation)
+            "task_arg.grad_accum",
+            os.environ.get(
+                "BENCH_GRAD_ACCUM", str(defaults.get("grad_accum", 1))
+            ),
             # space-separated trailing cfg overrides, e.g.
             # BENCH_OPTS="network.xyz_encoder.custom_bwd true"
             *os.environ.get("BENCH_OPTS", "").split(),
@@ -169,6 +175,7 @@ def main():
                 "peak_flops": peak,
                 "n_rays": n_rays,
                 "scan_steps": scan_k,
+                "grad_accum": int(cfg.task_arg.get("grad_accum", 1)),
                 **(
                     {"opts": os.environ["BENCH_OPTS"]}
                     if os.environ.get("BENCH_OPTS")

@@ -50,6 +50,26 @@ def _intersect_box(rays_o, rays_d, lo, hi):
     return np.where(hit & (t > 1e-3), t, np.inf)
 
 
+def _intersect_cylinder_z(rays_o, rays_d, cx, cy, radius, z0, z1):
+    """Smallest positive t of a ray vs a z-aligned finite cylinder."""
+    ox = rays_o[:, 0] - cx
+    oy = rays_o[:, 1] - cy
+    dx, dy = rays_d[:, 0], rays_d[:, 1]
+    a = dx * dx + dy * dy
+    b = ox * dx + oy * dy
+    c = ox * ox + oy * oy - radius * radius
+    disc = b * b - a * c
+    hit = (disc > 0) & (a > 1e-12)
+    sq = np.sqrt(np.maximum(disc, 0.0))
+    a_safe = np.where(a > 1e-12, a, 1.0)
+    best = np.full(len(rays_o), np.inf)
+    for t in ((-b - sq) / a_safe, (-b + sq) / a_safe):
+        z = rays_o[:, 2] + t * rays_d[:, 2]
+        ok = hit & (t > 1e-3) & (z >= z0) & (z <= z1)
+        best = np.where(ok & (t < best), t, best)
+    return best
+
+
 SPHERE_C = np.array([0.35, 0.0, 0.25], dtype=np.float32)
 SPHERE_R = 0.55
 BOX_LO = np.array([-0.9, -0.5, -0.5], dtype=np.float32)
@@ -58,18 +78,46 @@ LIGHT_DIR = np.array([0.4, 0.35, 0.85], dtype=np.float32) / np.linalg.norm(
     [0.4, 0.35, 0.85]
 )
 
+# "hard" variant: a fence of THIN vertical cylinders (diameter 0.03 —
+# the width of ~1.3 cells of a 128³ grid over the ±1.5 bbox, the classic
+# occupancy-carving failure shape) in FRONT of the textured solids, so
+# carving the space between the bars without eating the bars is required
+# to render the scene (cf. the lego grille, the reference's own scene).
+HARD_FENCE_X = np.linspace(-0.9, 0.9, 7, dtype=np.float32)
+HARD_FENCE_Y = 0.8
+HARD_FENCE_R = 0.015
+HARD_FENCE_Z = (-0.6, 0.6)
+HARD_CHECKER_FREQ = 24.0  # albedo cycles/unit — sub-voxel color detail
 
-def render_view(H: int, W: int, focal: float, c2w: np.ndarray) -> np.ndarray:
-    """Analytic RGBA render of the scene from one camera. [H, W, 4] uint8."""
+
+def render_view(
+    H: int, W: int, focal: float, c2w: np.ndarray, variant: str = "plain"
+) -> np.ndarray:
+    """Analytic RGBA render of the scene from one camera. [H, W, 4] uint8.
+
+    ``variant="hard"`` adds the thin-cylinder fence and swaps the solid
+    albedos for a high-frequency 3D checker — deliberately adversarial
+    geometry for grid carving and encoder resolution (VERDICT r4 #6: show
+    the 30 dB crossing is not an artifact of easy geometry).
+    """
     rays_o, rays_d = get_rays_np(H, W, focal, c2w)
     o = rays_o.reshape(-1, 3)
     d = rays_d.reshape(-1, 3)
+    hard = variant == "hard"
 
     t_s = _intersect_sphere(o, d, SPHERE_C, SPHERE_R)
     t_b = _intersect_box(o, d, BOX_LO, BOX_HI)
     t = np.minimum(t_s, t_b)
+    which = np.where(t_s <= t_b, 0, 1)  # 0 sphere, 1 box
+    if hard:
+        for cx in HARD_FENCE_X:
+            t_c = _intersect_cylinder_z(
+                o, d, cx, HARD_FENCE_Y, HARD_FENCE_R, *HARD_FENCE_Z
+            )
+            which = np.where(t_c < t, 2, which)
+            t = np.minimum(t, t_c)
     hit = np.isfinite(t)
-    which_sphere = hit & (t_s <= t_b)
+    which = np.where(hit, which, -1)
 
     p = o + np.where(hit, t, 0.0)[:, None] * d
     # normals
@@ -82,14 +130,50 @@ def render_view(H: int, W: int, focal: float, c2w: np.ndarray) -> np.ndarray:
     n_box[np.arange(len(p)), axis] = np.sign(
         rel[np.arange(len(p)), axis]
     )
-    n = np.where(which_sphere[:, None], n_sphere, n_box)
+    n = np.where((which == 0)[:, None], n_sphere, n_box)
+    if hard:
+        # cylinder normal: radial in xy from the nearest fence bar
+        dx = p[:, 0:1] - HARD_FENCE_X[None, :]
+        nearest = np.argmin(np.abs(dx), axis=-1)
+        cx = HARD_FENCE_X[nearest]
+        n_cyl = np.stack(
+            [p[:, 0] - cx, p[:, 1] - HARD_FENCE_Y, np.zeros(len(p))], -1
+        )
+        n_cyl /= np.maximum(
+            np.linalg.norm(n_cyl, axis=-1, keepdims=True), 1e-9
+        )
+        n = np.where((which == 2)[:, None], n_cyl, n)
 
     lambert = np.clip(np.sum(n * LIGHT_DIR, -1), 0.0, 1.0)[:, None]
     albedo_sphere = 0.5 * (n_sphere + 1.0)
     albedo_box = np.broadcast_to(
         np.array([0.9, 0.35, 0.2], dtype=np.float32), p.shape
     )
-    albedo = np.where(which_sphere[:, None], albedo_sphere, albedo_box)
+    if hard:
+        # 3D checker at sub-voxel frequency: the encoder must resolve
+        # color flips every ~0.04 units (< one 128³ grid cell)
+        checker = (
+            np.floor(p[:, 0] * HARD_CHECKER_FREQ)
+            + np.floor(p[:, 1] * HARD_CHECKER_FREQ)
+            + np.floor(p[:, 2] * HARD_CHECKER_FREQ)
+        ) % 2.0
+        albedo_sphere = np.where(
+            checker[:, None] > 0.5,
+            np.array([0.95, 0.95, 0.1], np.float32),
+            np.array([0.1, 0.2, 0.9], np.float32),
+        )
+        albedo_box = np.where(
+            checker[:, None] > 0.5,
+            np.array([0.9, 0.35, 0.2], np.float32),
+            np.array([0.15, 0.8, 0.5], np.float32),
+        )
+    albedo = np.where((which == 0)[:, None], albedo_sphere, albedo_box)
+    if hard:
+        albedo = np.where(
+            (which == 2)[:, None],
+            np.array([0.85, 0.85, 0.9], np.float32),
+            albedo,
+        )
     rgb = albedo * (0.25 + 0.75 * lambert)
 
     rgba = np.zeros((H * W, 4), dtype=np.float32)
@@ -114,6 +198,9 @@ def generate_scene(
     rng = np.random.default_rng(seed)
     scene_dir = os.path.join(root, scene)
     focal = 0.5 * W / np.tan(0.5 * CAMERA_ANGLE_X)
+    # scene names containing "hard" get the adversarial variant (thin
+    # fence + sub-voxel checker albedo)
+    variant = "hard" if "hard" in scene else "plain"
 
     for split, n in (("train", n_train), ("val", n_test), ("test", n_test)):
         frames = []
@@ -127,7 +214,7 @@ def generate_scene(
                 theta = -180.0 + 360.0 * k / max(n, 1)
                 phi = -30.0
             c2w = pose_spherical(theta, phi, radius)
-            img = render_view(H, W, focal, c2w)
+            img = render_view(H, W, focal, c2w, variant=variant)
             rel = f"./{split}/r_{k}"
             imageio.imwrite(os.path.join(scene_dir, rel + ".png"), img)
             frames.append(

@@ -69,9 +69,7 @@ def main(argv=None):
             BENCH_SCAN_STEPS=str(scan_k),
         )
         if accum > 1:
-            extra = f"task_arg.grad_accum {accum}"
-            prev = env.get("BENCH_OPTS", "")
-            env["BENCH_OPTS"] = (prev + " " + extra).strip()
+            env["BENCH_GRAD_ACCUM"] = str(accum)
         # the point's init budget must fail LOUDLY (JSON record with an
         # init_trail) inside point_timeout — otherwise a wedged tunnel
         # burns the full point_timeout per point with an opaque kill

@@ -48,6 +48,7 @@ def main(argv=None):
         "dtype": best.get("dtype", "bfloat16"),
         "remat": "true" if best.get("remat") else "false",
         "scan_steps": int(best.get("scan_steps", 1)),
+        "grad_accum": int(best.get("grad_accum", 1)),
         "config": args.config,
         "measured_rays_per_sec": round(float(best["value"]), 1),
         "source": "scripts/promote_bench_defaults.py",

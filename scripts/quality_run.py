@@ -55,6 +55,10 @@ def main(argv=None):
                         "non-resume start)")
     p.add_argument("--config", default="lego.yaml",
                    help="config under configs/nerf/ (e.g. lego_hash.yaml)")
+    p.add_argument("--scene", default="procedural",
+                   help="procedural scene variant; 'procedural_hard' adds "
+                        "the thin-cylinder fence + sub-voxel checker "
+                        "(datasets/procedural.py render_view)")
     p.add_argument("--out_prefix", default="QUALITY",
                    help="repo-root prefix for the .jsonl trace and .md report")
     p.add_argument("opts", nargs="*", default=[],
@@ -90,7 +94,7 @@ def main(argv=None):
     from nerf_replication_tpu.train.checkpoint import save_model
     from nerf_replication_tpu.train.trainer import Trainer
 
-    scene = "procedural"
+    scene = args.scene
     from nerf_replication_tpu.datasets.procedural import ensure_scene
 
     ensure_scene(args.scene_root, scene=scene, H=args.H, W=args.H,
@@ -162,8 +166,9 @@ def main(argv=None):
     with open(trace_path, "a") as tf:
         tf.write(json.dumps({
             "run_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
-            "config": args.config, "H": args.H, "views": args.views,
-            "n_rays": args.n_rays, "minutes": args.minutes,
+            "config": args.config, "scene": scene, "H": args.H,
+            "views": args.views, "n_rays": args.n_rays,
+            "minutes": args.minutes,
             "device": jax.devices()[0].device_kind,
         }) + "\n")
         tf.flush()
@@ -271,7 +276,7 @@ def main(argv=None):
     lines = [
         "# QUALITY — trained artifact trace",
         "",
-        f"Scene: procedural {args.H}²×{args.views} views; config {args.config} "
+        f"Scene: {scene} {args.H}²×{args.views} views; config {args.config} "
         f"(N_rays={args.n_rays}, bf16); budget {args.minutes:.0f} min on "
         f"`{jax.devices()[0].device_kind}`.",
         "",

@@ -458,3 +458,47 @@ def test_registry_loads_plugin_from_file_path(tmp_path):
     assert len(set(names)) == 2, names
     assert sys.modules[mod.__name__] is mod
     assert sys.modules[mod2.__name__] is mod2
+
+
+def test_sweep_recency_keys_on_grad_accum_and_promotes_it(tmp_path):
+    """A grad_accum sweep row must NOT supersede the same shape without
+    accumulation (distinct sweep points), and the promoted defaults must
+    carry grad_accum so bench.py replays the winning point WITH
+    accumulation (round-5 advisor finding)."""
+    import json
+
+    from nerf_replication_tpu.utils.sweeps import best_point, latest_points
+
+    rows = [
+        {"metric": "train_rays_per_sec", "value": 100.0, "n_rays": 4096,
+         "dtype": "bfloat16", "remat": False, "scan_steps": 8,
+         "config": "lego.yaml", "ts": 1.0},
+        {"metric": "train_rays_per_sec", "value": 250.0, "n_rays": 4096,
+         "dtype": "bfloat16", "remat": False, "scan_steps": 8,
+         "grad_accum": 4, "config": "lego.yaml", "ts": 2.0},
+    ]
+    p = tmp_path / "BENCH_SWEEP_T.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    pts = latest_points([str(p)])
+    assert len(pts) == 2  # the accum row did not replace the plain row
+
+    best = best_point([str(p)], config="lego.yaml")
+    assert best["value"] == 250.0 and best.get("grad_accum") == 4
+
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "promote_bench_defaults",
+        _os.path.join(_os.path.dirname(__file__), "..", "scripts",
+                      "promote_bench_defaults.py"),
+    )
+    promote = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(promote)
+    out = tmp_path / "BENCH_DEFAULTS_T.json"
+    rc = promote.main([str(p), "--config", "lego.yaml", "--out", str(out)])
+    assert rc == 0
+    promoted = json.loads(out.read_text())
+    assert promoted["grad_accum"] == 4
+    assert promoted["measured_rays_per_sec"] == 250.0

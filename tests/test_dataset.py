@@ -62,6 +62,44 @@ def test_focal_from_fov():
     assert np.isclose(focal_from_fov(800, 0.6911112070083618), 1111.111, atol=0.01)
 
 
+def test_hard_procedural_variant_adds_thin_structures(tmp_path):
+    """Scene names containing 'hard' render the adversarial variant: the
+    thin-cylinder fence adds geometry absent from the plain scene, the
+    sub-voxel checker changes solid albedos, and the written scene dir is
+    a valid Blender-format dataset."""
+    from nerf_replication_tpu.datasets.procedural import CAMERA_ANGLE_X
+
+    H = W = 96
+    focal = 0.5 * W / np.tan(0.5 * CAMERA_ANGLE_X)
+    c2w = pose_spherical(30.0, -30.0, 4.0)
+    plain = render_view(H, W, focal, c2w, variant="plain")
+    hard = render_view(H, W, focal, c2w, variant="hard")
+    fence_only = (hard[..., 3] > 0) & ~(plain[..., 3] > 0)
+    assert fence_only.mean() > 0.005  # thin bars cover a few % of pixels
+    # thin: fence-only columns are narrow runs, not blobs — every such
+    # column's fence pixels are a minority of the column
+    cols = fence_only.any(axis=0)
+    assert cols.sum() >= 5
+    # high-frequency albedo: a large fraction of SOLID pixels recolor,
+    # and the checker flips colors at high spatial frequency (many
+    # transitions per row across the whole image)
+    solid = (plain[..., 3] > 0) & (hard[..., 3] > 0)
+    changed = (
+        np.abs(plain[..., :3].astype(int) - hard[..., :3].astype(int))
+        .sum(-1) > 30
+    )
+    assert (changed & solid).sum() > 0.3 * solid.sum()
+    flips = np.abs(np.diff((changed & solid).astype(int), axis=1)).sum()
+    assert flips > 4 * H  # several transitions per row on average
+
+    root = str(tmp_path)
+    generate_scene(root, scene="procedural_hard", H=16, W=16, n_train=2,
+                   n_test=1)
+    ds = Dataset(data_root=root, scene="procedural_hard", split="train",
+                 H=16, W=16)
+    assert ds.n_images == 2
+
+
 def test_blender_dataset_loads(scene_dir):
     ds = Dataset(data_root=scene_dir, scene="procedural", split="train", H=16, W=16)
     assert ds.rays.shape == (3 * 16 * 16, 6)

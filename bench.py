@@ -176,6 +176,13 @@ def main():
                 "n_rays": n_rays,
                 "scan_steps": scan_k,
                 "grad_accum": int(cfg.task_arg.get("grad_accum", 1)),
+                # free-form label (e.g. BENCH_TAG=steady_state) for sweep
+                # rows that supersede compile-window measurements
+                **(
+                    {"tag": os.environ["BENCH_TAG"]}
+                    if os.environ.get("BENCH_TAG")
+                    else {}
+                ),
                 **(
                     {"opts": os.environ["BENCH_OPTS"]}
                     if os.environ.get("BENCH_OPTS")

@@ -1,0 +1,78 @@
+#!/bin/bash
+# Round-5 chip battery — the performance-and-proof stages VERDICT r4 asks
+# for, value-ordered. Run with the host core IDLE (concurrent CPU load
+# inflates dispatch timings 3x — PERF.md round 4) and the tunnel CONFIRMED
+# up (use tpu_battery.sh's watch loop or /tmp/tunnel_watch_r5.sh).
+#
+#   1. headline bench (driver insurance: the exact `python bench.py` the
+#      driver runs at round end must produce a number NOW)
+#   2. NGP A/B: std vs ngp vs ngp_packed (the round-5 packed sample
+#      stream), r4's march budget (step 0.01, K 64), equal wall budget
+#   3. packed refresh lever: ngp_packed with ngp_grid_update_every 64
+#      (the per-step 131k-cell exploration refresh is the #2 roofline
+#      term)
+#   4. steady-state scale rows (warm executable, steps>=30, tagged) for
+#      flagship + packed hash; grad_accum kills the 16k hash HBM cliff
+#   5. NGP H=400 quality trail with the DECOUPLED eval march budget
+#      (target: >=30 dB where r4 topped at 28.16)
+#   6. std quality trail with the eval-fps shootout (accelerated must
+#      beat chunked at equal PSNR on the trained net + carved grid)
+#   7. hard-scene trail (thin fence + sub-voxel checker)
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[batteryR5 $(date +%H:%M:%S)] $*"; }
+export BENCH_INIT_TOTAL_S=${BENCH_INIT_TOTAL_S:-420}
+
+NGP_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 \
+task_arg.scan_steps 8"
+
+log "stage 1: headline bench (driver replay)"
+timeout 1800 python bench.py 2>data/logs/r5_bench.err \
+  | tee -a BENCH_R5_HEADLINE.jsonl | tail -1
+
+log "stage 2: NGP A/B std vs ngp vs ngp_packed (420 s/arm)"
+timeout 3600 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms std ngp ngp_packed \
+  --out BENCH_NGP.jsonl $NGP_OPTS \
+  2>data/logs/r5_ngp_ab.err | tail -4
+
+log "stage 3: packed refresh lever (update_every 64)"
+timeout 1800 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl $NGP_OPTS task_arg.ngp_grid_update_every 64 \
+  2>data/logs/r5_ngp_refresh.err | tail -2
+
+log "stage 4a: flagship steady-state scale rows (8k/16k/65k)"
+BENCH_TAG=steady_state BENCH_OPTS="network.nerf.scan_trunk true" \
+timeout 7200 python scripts/bench_sweep.py \
+  --rays 8192 16384 65536 --dtypes bfloat16 --remat false \
+  --scan_steps 8 --grad_accum 1 8 --steps 40 --point_timeout 2400 \
+  --out BENCH_SWEEP.jsonl 2>data/logs/r5_sweep_flagship.err | tail -8
+
+log "stage 4b: packed-hash steady-state scale rows (4k/8k/16k, accum)"
+BENCH_TAG=steady_state timeout 5400 python scripts/bench_sweep.py \
+  --rays 4096 8192 16384 --dtypes bfloat16 --remat false \
+  --scan_steps 8 --grad_accum 1 4 --steps 40 --point_timeout 1800 \
+  --config lego_hash_packed.yaml --out BENCH_SWEEP_HASH.jsonl \
+  2>data/logs/r5_sweep_hash.err | tail -8
+
+log "stage 5: NGP H=400 quality trail (decoupled eval budget, packed)"
+timeout 2700 python scripts/quality_run.py --minutes 25 --H 400 \
+  --config lego_hash_packed.yaml --out_prefix QUALITY_NGP_R5 \
+  --tag q_ngp_r5 task_arg.ngp_training true \
+  task_arg.ngp_packed_march true $NGP_OPTS \
+  2>data/logs/r5_quality_ngp.err | tail -6
+
+log "stage 6: std quality trail + eval-fps shootout (lego.yaml)"
+timeout 2100 python scripts/quality_run.py --minutes 15 --H 400 \
+  --config lego.yaml --out_prefix QUALITY_R5 --tag q_std_r5 \
+  2>data/logs/r5_quality_std.err | tail -8
+
+log "stage 7: hard-scene trail (thin fence + checker)"
+timeout 2100 python scripts/quality_run.py --minutes 15 --H 400 \
+  --scene procedural_hard --config lego_hash_packed.yaml \
+  --out_prefix QUALITY_HARD --tag q_hard_r5 \
+  task_arg.ngp_training true task_arg.ngp_packed_march true $NGP_OPTS \
+  2>data/logs/r5_quality_hard.err | tail -6
+
+log "battery r5 done"

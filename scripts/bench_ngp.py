@@ -166,6 +166,10 @@ def main(argv=None):
             "config": args.config,
             "n_rays": args.n_rays,
             "ts": round(time.time(), 1),
+            # provenance: the march/trainer overrides this arm ran with
+            # (the r4 A/B's step-0.01/K-64 settings were only recoverable
+            # from shell history)
+            **({"opts": " ".join(args.opts)} if args.opts else {}),
         }
         if arm.startswith("ngp"):
             rec["occupancy"] = round(float(stats["occupancy"]), 4)

@@ -82,6 +82,7 @@ def main(argv=None):
     setup_backend(args.force_platform)
 
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     enable_compilation_cache()
@@ -246,11 +247,82 @@ def main(argv=None):
     import render_video
 
     renderer = make_renderer(cfg, network)
+    renderer.load_occupancy_grid(grid_path)
+
+    # eval-fps shootout (VERDICT r4 #3): the accelerated marcher must be
+    # SHOWN faster than the chunked path at equal PSNR on the trained net
+    # with the carved grid — not assumed. Runs BEFORE the video stage's
+    # budget doubling so it measures the EVAL operating point. Protocol =
+    # the reference's run.py:73-87: per-image wall clock ended on a
+    # device→host copy; image 0 is rendered once untimed (compile warmup)
+    # so every timed render — including single-test-view runs — is warm.
+    from nerf_replication_tpu.evaluators.nerf import psnr as _psnr
+    from nerf_replication_tpu.evaluators.nerf import ssim as _ssim
+
+    fps_rows = []
+
+    def _fps_path(tag, render_fn):
+        times, ps, ss = [], [], []
+        n_img = min(test_ds.n_images, max(int(args.test_views), 1))
+        np.asarray(render_fn(test_ds.image_batch(0))["rgb_map_f"])  # warm
+        for i in range(n_img):
+            b = test_ds.image_batch(i)
+            t0i = time.perf_counter()
+            out = render_fn(b)
+            pred = np.asarray(out["rgb_map_f"])  # device→host sync
+            times.append(time.perf_counter() - t0i)
+            meta = b["meta"]
+            pred = np.clip(
+                pred.reshape(meta["H"], meta["W"], 3), 0.0, 1.0
+            )
+            gt = np.asarray(b["rgbs"]).reshape(meta["H"], meta["W"], 3)
+            ps.append(float(_psnr(pred, gt)))
+            ss.append(float(_ssim(pred, gt)))
+        mean_s = float(np.mean(times))
+        rec = {
+            "eval_fps_path": tag,
+            "s_per_image": round(mean_s, 4),
+            "fps": round(1.0 / mean_s, 3),
+            "psnr": round(float(np.mean(ps)), 3),
+            "ssim": round(float(np.mean(ss)), 4),
+            "n_images": n_img,
+            "H": args.H,
+        }
+        fps_rows.append(rec)
+        print(json.dumps(rec), flush=True)
+        with open(trace_path, "a") as tfa:
+            tfa.write(json.dumps(rec) + "\n")
+        return rec
+
+    if ngp:
+        # chunked coarse+fine is meaningless here (NGP trains fine only);
+        # the march with the live grid IS the fast path — measure it
+        _fps_path(
+            "ngp_march",
+            lambda b: trainer.render_image(state, {"rays": b["rays"]}),
+        )
+    else:
+        _fps_path(
+            "render_chunked",
+            lambda b: renderer.render_chunked(params, {
+                "rays": jnp.asarray(b["rays"]),
+                "near": float(b["near"]), "far": float(b["far"]),
+            }),
+        )
+        _fps_path(
+            "render_accelerated",
+            lambda b: renderer.render_accelerated(params, {
+                "rays": jnp.asarray(b["rays"]),
+                "near": float(b["near"]), "far": float(b["far"]),
+            }),
+        )
+
     # the renderer takes the eval march budget when the config defines it
     # (task_arg.eval_max_march_samples — MarchOptions.eval_from_cfg). For
     # configs without eval keys, keep the measured video margin: at the
     # shared K=192 the chip quality run truncated ~2.3% of spiral rays
-    # while still transparent, so offline video doubles the budget.
+    # while still transparent, so offline video doubles the budget —
+    # AFTER the fps shootout, which must measure the eval operating point.
     if "eval_max_march_samples" not in cfg.task_arg:
         from dataclasses import replace as _dc_replace
 
@@ -258,7 +330,6 @@ def main(argv=None):
             renderer.march_options,
             max_samples=2 * renderer.march_options.max_samples,
         )
-    renderer.load_occupancy_grid(grid_path)
     frames = render_video.spiral_frames(
         renderer, params, H=min(args.H, 200), W=min(args.H, 200),
         focal=test_ds.focal * min(args.H, 200) / args.H,
@@ -326,6 +397,16 @@ def main(argv=None):
                 f"\nTail slope {dpsnr:.2f} dB / {b['t_s'] - a['t_s']:.0f} s "
                 f"⇒ naive wall-clock-to-north-star ≈ {b['t_s'] + max(eta, 0):.0f} s "
                 "(log-shaped convergence makes this a lower bound)."
+            )
+    if fps_rows:
+        lines.append("\n## Eval fps (ref run.py:73-87 protocol: first "
+                     "image excluded, timed to a device→host copy)\n")
+        lines.append("| path | s/image | fps | PSNR | SSIM |")
+        lines.append("|---|---|---|---|---|")
+        for r in fps_rows:
+            lines.append(
+                f"| {r['eval_fps_path']} | {r['s_per_image']} | "
+                f"{r['fps']} | {r['psnr']:.2f} | {r['ssim']:.3f} |"
             )
     with open(os.path.join(_REPO, args.out_prefix + ".md"), "w") as f:
         f.write("\n".join(lines) + "\n")

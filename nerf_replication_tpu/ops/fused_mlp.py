@@ -1,0 +1,478 @@
+"""Fused NeRF-MLP trunk as a Pallas TPU kernel — the HBM-traffic lever.
+
+PERF.md "f3 closure": the flagship train step is bound by 48.8 GB/step of
+forward-saved / backward-read activation traffic (~40 layer instances of
+[786k, 256]); XLA remat LOSES (recompute goes through HBM again), so the
+single-chip headline closed at ~48k rays/s, 73% of HBM peak, 22% MFU.
+
+This kernel attacks the bytes directly, flash-attention-style: the whole
+MLP chain runs per TILE of points with weights (~2.4 MB) and activations
+resident in VMEM. The forward writes ONLY the [M, 4] raw output; the
+backward re-runs the forward per tile in VMEM (recompute never touches
+HBM) and accumulates weight gradients across the sequentially-executed
+grid. HBM traffic per step drops from ~40 × [M, W] activations to
+inputs + outputs + per-tile weight streams — modeled ≥10× less.
+
+Unlike the hash-encoder Pallas attempt (models/encoding/pallas_hash.py —
+Mosaic rejects its in-kernel gather, a recorded negative), this kernel is
+pure matmul chain + elementwise: the exact op mix Mosaic is built for.
+
+Scope: the original-paper NeRF MLP family (models/nerf/network.py — D
+trunk layers of width W, ONE skip re-injection, viewdirs branch W/2,
+f32 density/rgb heads; reference src/models/nerf/network.py:9-192).
+``make_fused_apply`` builds a drop-in ``apply_fn(params, pts, viewdirs,
+model)`` for Renderer._apply_fn when ``network.nerf.fused_trunk`` is on;
+configs outside the supported family are refused loudly at build time.
+
+CPU (and any non-TPU backend) runs the same kernels under the Pallas
+interpreter — numerically verified against the Flax apply in
+tests/test_fused_mlp.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    # trace-time constant: Mosaic lowering only exists on real TPU
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+def _pad_cols(a, to):
+    c = a.shape[-1]
+    if c == to:
+        return a
+    return jnp.pad(a, ((0, 0),) * (a.ndim - 1) + ((0, to - c),))
+
+
+def _place_col(a, col, to):
+    """Pad [..., 1] to [..., to] with the live column at index ``col``.
+
+    Lets the alpha head write DIRECTLY into the raw layout's column
+    (raw = rgb8 + alpha8 — a plain element-wise add) so the kernel never
+    does lane-dimension concatenation, which Mosaic handles poorly. The
+    VJP of this pad extracts exactly the live column."""
+    return jnp.pad(
+        a, ((0, 0),) * (a.ndim - 1) + ((col, to - col - a.shape[-1]),)
+    )
+
+
+def _pad_rows(a, to):
+    r = a.shape[0]
+    if r == to:
+        return a
+    return jnp.pad(a, ((0, to - r),) + ((0, 0),) * (a.ndim - 1))
+
+
+def _rup(n, m):
+    return ((n + m - 1) // m) * m
+
+
+class FusedSpec:
+    """Static geometry of one fused MLP (shapes after padding)."""
+
+    def __init__(self, D, W, skip, c_in, c_views, compute_dtype):
+        if skip is not None and not (0 <= skip < D - 1):
+            raise ValueError(
+                f"fused_trunk: skip={skip} must feed a later trunk layer "
+                f"(D={D}) — a skip at the last layer changes the head width"
+            )
+        self.D, self.W, self.skip = int(D), int(W), skip
+        self.W2 = self.W // 2
+        self.c_in, self.c_views = int(c_in), int(c_views)
+        self.c_in_pad = _rup(max(self.c_in, 1), 64)
+        self.c_views_pad = _rup(max(self.c_views, 1), 32)
+        self.compute_dtype = compute_dtype
+
+    # canonical parameter order fed to the kernels (all f32, padded):
+    #   W0 [c_in_pad, W], b0 [1, W]
+    #   per trunk layer i in 1..D-1:
+    #       skip+1: Wsx [c_in_pad, W], Wsh [W, W], bs [1, W]
+    #       else:   Wi [W, W], bi [1, W]
+    #   Wa [W, 8], ba [1, 8]       (alpha head, col 0 live)
+    #   Wf [W, W], bf [1, W]       (feature head)
+    #   Wvf [W, W2], Wvv [c_views_pad, W2], bv [1, W2]
+    #   Wr [W2, 8], br [1, 8]      (rgb head, cols 0..2 live)
+    def flatten_params(self, branch: dict) -> list:
+        D, W, skip = self.D, self.W, self.skip
+        out = []
+
+        def kb(name):
+            p = branch[name]
+            return jnp.asarray(p["kernel"], jnp.float32), jnp.asarray(
+                p["bias"], jnp.float32
+            ).reshape(1, -1)
+
+        k0, b0 = kb("pts_linear_0")
+        out += [_pad_rows(k0, self.c_in_pad), b0]
+        for i in range(1, D):
+            ki, bi = kb(f"pts_linear_{i}")
+            if skip is not None and i == skip + 1:
+                # SplitDense layout: kernel [c_in + W, W]
+                out += [
+                    _pad_rows(ki[: self.c_in], self.c_in_pad),
+                    ki[self.c_in :],
+                    bi,
+                ]
+            else:
+                out += [ki, bi]
+        ka, ba = kb("alpha_linear")
+        # live column at 3: raw layout is [r, g, b, alpha, pad...]
+        out += [_place_col(ka, 3, 8), _place_col(ba, 3, 8)]
+        kf, bf = kb("feature_linear")
+        out += [kf, bf]
+        kv, bv = kb("views_linear_0")  # SplitDense [W + c_views, W2]
+        out += [
+            kv[: self.W],
+            _pad_rows(kv[self.W :], self.c_views_pad),
+            bv,
+        ]
+        kr, br = kb("rgb_linear")
+        out += [_pad_cols(kr, 8), _pad_cols(br, 8)]
+        return out
+
+    # (the inverse of flatten_params is free: fused_mlp_raw differentiates
+    # THROUGH flatten_params, whose pad/slice VJPs route the flat grads
+    # back into the branch dict automatically)
+
+    def n_params(self) -> int:
+        D, skip = self.D, self.skip
+        n = 2  # W0, b0
+        for i in range(1, D):
+            n += 3 if (skip is not None and i == skip + 1) else 2
+        n += 2 + 2 + 3 + 2  # alpha, feature, views, rgb
+        return n
+
+
+def _forward_tile(spec: FusedSpec, x, v, ws):
+    """The whole MLP on one tile; returns (raw8, activations list).
+
+    Mirrors NeRFMLP.__call__ exactly: trunk (+ optional skip via split
+    matmuls), f32 alpha head off the trunk, feature → viewdirs branch
+    (relu) → f32 rgb head. ``ws`` follows flatten_params order.
+    """
+    cd = spec.compute_dtype
+    it = iter(ws)
+
+    def nxt():
+        return next(it)
+
+    acts = []
+    h = jnp.dot(
+        x.astype(cd), nxt().astype(cd), preferred_element_type=jnp.float32
+    ) + nxt()
+    h = jax.nn.relu(h)
+    acts.append(h)
+    for i in range(1, spec.D):
+        if spec.skip is not None and i == spec.skip + 1:
+            wx, wh, b = nxt(), nxt(), nxt()
+            h = (
+                jnp.dot(x.astype(cd), wx.astype(cd),
+                        preferred_element_type=jnp.float32)
+                + jnp.dot(h.astype(cd), wh.astype(cd),
+                          preferred_element_type=jnp.float32)
+                + b
+            )
+        else:
+            w, b = nxt(), nxt()
+            h = jnp.dot(
+                h.astype(cd), w.astype(cd),
+                preferred_element_type=jnp.float32,
+            ) + b
+        h = jax.nn.relu(h)
+        acts.append(h)
+    wa, ba = nxt(), nxt()
+    alpha8 = jnp.dot(h, wa, preferred_element_type=jnp.float32) + ba
+    wf, bf = nxt(), nxt()
+    f = jnp.dot(
+        h.astype(cd), wf.astype(cd), preferred_element_type=jnp.float32
+    ) + bf
+    acts.append(f)
+    wvf, wvv, bv = nxt(), nxt(), nxt()
+    vh = jax.nn.relu(
+        jnp.dot(f.astype(cd), wvf.astype(cd),
+                preferred_element_type=jnp.float32)
+        + jnp.dot(v.astype(cd), wvv.astype(cd),
+                  preferred_element_type=jnp.float32)
+        + bv
+    )
+    acts.append(vh)
+    wr, br = nxt(), nxt()
+    rgb8 = jnp.dot(vh, wr, preferred_element_type=jnp.float32) + br
+    # raw layout [rgb, alpha, pad]: rgb lives in cols 0-2 (wr/br padding),
+    # alpha in col 3 (_place_col) — a plain add, no lane concat in-kernel
+    raw8 = rgb8 + alpha8
+    return raw8, acts
+
+
+def _backward_tile(spec: FusedSpec, x, v, draw, ws):
+    """Recompute forward in VMEM, return (dx, dv, [dW/db...])."""
+    cd = spec.compute_dtype
+    _, acts = _forward_tile(spec, x, v, ws)
+    # name the pieces
+    it = iter(ws)
+    w0, b0 = next(it), next(it)
+    trunk = []
+    for i in range(1, spec.D):
+        if spec.skip is not None and i == spec.skip + 1:
+            trunk.append((next(it), next(it), next(it)))
+        else:
+            trunk.append((next(it), next(it)))
+    wa, ba = next(it), next(it)
+    wf, bf = next(it), next(it)
+    wvf, wvv, bv = next(it), next(it), next(it)
+    wr, br = next(it), next(it)
+
+    h_last = acts[spec.D - 1]
+    f, vh = acts[spec.D], acts[spec.D + 1]
+
+    # raw = rgb8 + alpha8 with structurally-disjoint live columns (wr live
+    # cols 0-2, wa live col 3), so BOTH heads take the full [T, 8]
+    # cotangent: the dead columns of each head's weights zero out the
+    # other head's contribution, and the padding VJP outside the kernel
+    # slices the dead weight-gradient columns off.
+    drgb = draw
+    dalpha = draw
+
+    f32 = jnp.float32
+
+    def dotT(a, b):  # a @ b.T
+        return jax.lax.dot_general(
+            a.astype(f32), b.astype(f32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=f32,
+        )
+
+    def Tdot(a, b):  # a.T @ b
+        return jax.lax.dot_general(
+            a.astype(f32), b.astype(f32),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+
+    grads = []
+    # rgb head
+    dwr = Tdot(vh, drgb)
+    dbr = jnp.sum(drgb, axis=0, keepdims=True)
+    dvh = dotT(drgb, wr) * (vh > 0)
+    # views branch
+    dwvf = Tdot(f, dvh)
+    dwvv = Tdot(v, dvh)
+    dbv = jnp.sum(dvh, axis=0, keepdims=True)
+    df = dotT(dvh, wvf)
+    dv = dotT(dvh, wvv)
+    # feature + alpha heads (both read the last trunk activation)
+    dwf = Tdot(h_last, df)
+    dbf = jnp.sum(df, axis=0, keepdims=True)
+    dwa = Tdot(h_last, dalpha)
+    dba = jnp.sum(dalpha, axis=0, keepdims=True)
+    dh = dotT(df, wf) + dotT(dalpha, wa)
+    # trunk, in reverse
+    dx = jnp.zeros_like(x, dtype=f32)
+    trunk_grads = []
+    for i in range(spec.D - 1, 0, -1):
+        a_i = acts[i]
+        a_prev = acts[i - 1]
+        dz = dh * (a_i > 0)
+        if spec.skip is not None and i == spec.skip + 1:
+            wx, wh, _ = trunk[i - 1]
+            trunk_grads.append([
+                Tdot(x, dz), Tdot(a_prev, dz),
+                jnp.sum(dz, axis=0, keepdims=True),
+            ])
+            dx = dx + dotT(dz, wx)
+            dh = dotT(dz, wh)
+        else:
+            w, _ = trunk[i - 1]
+            trunk_grads.append([
+                Tdot(a_prev, dz), jnp.sum(dz, axis=0, keepdims=True),
+            ])
+            dh = dotT(dz, w)
+    dz0 = dh * (acts[0] > 0)
+    dw0 = Tdot(x, dz0)
+    db0 = jnp.sum(dz0, axis=0, keepdims=True)
+    dx = dx + dotT(dz0, w0)
+
+    grads = [dw0, db0]
+    for g in reversed(trunk_grads):
+        grads += g
+    grads += [dwa, dba, dwf, dbf, dwvf, dwvv, dbv, dwr, dbr]
+    return dx, dv, grads
+
+
+def _fwd_kernel(spec, x_ref, v_ref, *rest):
+    ws = rest[:-1]
+    out_ref = rest[-1]
+    raw8, _ = _forward_tile(
+        spec, x_ref[...], v_ref[...], [w[...] for w in ws]
+    )
+    out_ref[...] = raw8
+
+
+def _bwd_kernel(spec, x_ref, v_ref, draw_ref, *rest):
+    n_p = spec.n_params()
+    ws = rest[:n_p]
+    dx_ref, dv_ref = rest[n_p], rest[n_p + 1]
+    gr = rest[n_p + 2 :]
+    dx, dv, grads = _backward_tile(
+        spec, x_ref[...], v_ref[...], draw_ref[...], [w[...] for w in ws]
+    )
+    dx_ref[...] = dx
+    dv_ref[...] = dv
+    # weight grads accumulate across the SEQUENTIAL TPU grid
+    first = pl.program_id(0) == 0
+    for ref, g in zip(gr, grads):
+        @pl.when(first)
+        def _init(ref=ref, g=g):
+            ref[...] = g
+
+        @pl.when(jnp.logical_not(first))
+        def _acc(ref=ref, g=g):
+            ref[...] = ref[...] + g
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fused_raw(spec, tile, flat_ws, x, v):
+    out, _ = _fused_fwd(spec, tile, flat_ws, x, v)
+    return out
+
+
+def _pallas_fwd(spec, tile, flat_ws, x, v):
+    m = x.shape[0]
+    grid = (m // tile,)
+    in_specs = [
+        pl.BlockSpec((tile, x.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, v.shape[1]), lambda i: (i, 0)),
+    ] + [
+        pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
+        for w in flat_ws
+    ]
+    return pl.pallas_call(
+        partial(_fwd_kernel, spec),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((tile, 8), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, 8), jnp.float32),
+        interpret=_interpret(),
+    )(x, v, *flat_ws)
+
+
+def _fused_fwd(spec, tile, flat_ws, x, v):
+    out = _pallas_fwd(spec, tile, flat_ws, x, v)
+    return out, (flat_ws, x, v)
+
+
+def _fused_bwd(spec, tile, res, draw):
+    flat_ws, x, v = res
+    m = x.shape[0]
+    grid = (m // tile,)
+    in_specs = [
+        pl.BlockSpec((tile, x.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, v.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, 8), lambda i: (i, 0)),
+    ] + [
+        pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
+        for w in flat_ws
+    ]
+    out_specs = [
+        pl.BlockSpec((tile, x.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, v.shape[1]), lambda i: (i, 0)),
+    ] + [
+        # full-array blocks revisited every grid step: the accumulation
+        # target stays VMEM-resident (sequential grid on TPU)
+        pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
+        for w in flat_ws
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((m, x.shape[1]), jnp.float32),
+        jax.ShapeDtypeStruct((m, v.shape[1]), jnp.float32),
+    ] + [jax.ShapeDtypeStruct(w.shape, jnp.float32) for w in flat_ws]
+    outs = pl.pallas_call(
+        partial(_bwd_kernel, spec),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(x, v, jnp.asarray(draw, jnp.float32), *flat_ws)
+    dx, dv = outs[0], outs[1]
+    dws = list(outs[2:])
+    return tuple(dws), dx, dv
+
+
+_fused_raw.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_mlp_raw(spec: FusedSpec, branch: dict, x_enc, d_enc, tile=512):
+    """[M, c_in] encoded points + [M, c_views] encoded dirs → [M, 4] raw.
+
+    Pads M to a tile multiple and the channel dims to the spec's padded
+    widths; differentiable in (branch, x_enc, d_enc).
+    """
+    m = x_enc.shape[0]
+    m_pad = _rup(max(m, 1), tile)
+    x = _pad_cols(jnp.asarray(x_enc, jnp.float32), spec.c_in_pad)
+    v = _pad_cols(jnp.asarray(d_enc, jnp.float32), spec.c_views_pad)
+    x = _pad_rows(x, m_pad)
+    v = _pad_rows(v, m_pad)
+
+    flat = spec.flatten_params(branch)
+
+    raw8 = _fused_raw(spec, tile, tuple(flat), x, v)
+    return raw8[:m, :4]
+
+
+def make_fused_apply(network, cfg):
+    """Drop-in ``apply_fn(params, pts, viewdirs, model)`` running the MLP
+    through the fused kernels. Refuses unsupported families loudly."""
+    import flax.linen as nn
+
+    from ..models.nerf.network import Network
+
+    if not isinstance(network, Network):
+        raise ValueError("fused_trunk supports the NeRF Network family")
+    if isinstance(network.xyz_encoder, nn.Module) or isinstance(
+        network.dir_encoder, nn.Module
+    ):
+        raise ValueError(
+            "fused_trunk requires parameter-free encoders (frequency "
+            "family): a learnable encoder (hashgrid) cannot be called "
+            "outside the Flax apply and its tables would get no gradients "
+            "through the fused branch params"
+        )
+    if not network.use_viewdirs:
+        raise ValueError("fused_trunk requires use_viewdirs (rgb branch)")
+    if network.scan_trunk:
+        raise ValueError("fused_trunk and scan_trunk are exclusive")
+    skips = tuple(network.skips)
+    if len(skips) != 1:
+        raise ValueError("fused_trunk supports exactly one skip index")
+    tile = int(cfg.network.nerf.get("fused_tile", 512))
+    spec = FusedSpec(
+        D=network.D, W=network.W, skip=skips[0],
+        c_in=network.input_ch, c_views=network.input_ch_views,
+        compute_dtype=network.compute_dtype,
+    )
+
+    def apply_fn(params, pts, viewdirs, model):
+        x_enc = network.xyz_encoder(pts)
+        dirs = jnp.broadcast_to(
+            viewdirs[..., None, :], pts.shape[:-1] + (viewdirs.shape[-1],)
+        )
+        d_enc = network.dir_encoder(dirs)
+        lead = x_enc.shape[:-1]
+        branch = params["params"][model]
+        raw = fused_mlp_raw(
+            spec, branch,
+            x_enc.reshape(-1, x_enc.shape[-1]),
+            d_enc.reshape(-1, d_enc.shape[-1]),
+            tile=tile,
+        )
+        return raw.reshape(*lead, 4)
+
+    return apply_fn

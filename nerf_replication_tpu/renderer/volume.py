@@ -314,8 +314,22 @@ class Renderer:
         self._march_fns: dict = {}
         self._march_fns_cap = 8
         self._n_truncated = jnp.zeros((), jnp.int32)
+        # fused Pallas MLP trunk (ops/fused_mlp.py): weights + activations
+        # VMEM-resident per tile, backward recomputes in VMEM — the lever
+        # against the flagship's 48.8 GB/step activation traffic (PERF.md
+        # f3). Opt-in; unsupported families are refused at build time.
+        self._fused_apply = None
+        if bool(cfg.network.nerf.get("fused_trunk", False)):
+            from ..ops.fused_mlp import make_fused_apply
+
+            self._fused_apply = make_fused_apply(network, cfg)
 
     def _apply_fn(self, params):
+        if self._fused_apply is not None:
+            fused = self._fused_apply
+            return lambda pts, viewdirs, model: fused(
+                params, pts, viewdirs, model
+            )
         return lambda pts, viewdirs, model: self.network.apply(
             params, pts, viewdirs, model=model
         )

@@ -20,6 +20,7 @@
 #   7. hard-scene trail (thin fence + sub-voxel checker)
 set -u
 cd "$(dirname "$0")/.."
+mkdir -p data/logs
 log() { echo "[batteryR5 $(date +%H:%M:%S)] $*"; }
 export BENCH_INIT_TOTAL_S=${BENCH_INIT_TOTAL_S:-420}
 
@@ -29,6 +30,18 @@ task_arg.scan_steps 8"
 log "stage 1: headline bench (driver replay)"
 timeout 1800 python bench.py 2>data/logs/r5_bench.err \
   | tee -a BENCH_R5_HEADLINE.jsonl | tail -1
+
+log "stage 1b: fused Pallas trunk A/B at the headline shape"
+# ops/fused_mlp.py — VMEM-resident MLP chain, backward recomputes in
+# VMEM: the direct attack on the 48.8 GB/step activation traffic that
+# closed the flagship at 48k rays/s. First Mosaic compile of the kernel
+# happens here; a lowering failure is a RECORDED result, not a crash
+# (bench.py emits its JSON failure line either way).
+for tile in 512 1024; do
+  BENCH_OPTS="network.nerf.fused_trunk true network.nerf.fused_tile $tile" \
+  timeout 2400 python bench.py 2>data/logs/r5_bench_fused_$tile.err \
+    | tee -a BENCH_R5_HEADLINE.jsonl | tail -1
+done
 
 log "stage 2: NGP A/B std vs ngp vs ngp_packed (420 s/arm)"
 timeout 3600 python scripts/bench_ngp.py --seconds 420 \

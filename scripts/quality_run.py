@@ -316,6 +316,11 @@ def main(argv=None):
                 "near": float(b["near"]), "far": float(b["far"]),
             }),
         )
+        # drain the on-device truncation counter so the video stage's
+        # report attributes only ITS truncated rays, not the shootout's
+        renderer.report_truncation(
+            log=lambda m: print(f"[fps shootout] {m}")
+        )
 
     # the renderer takes the eval march budget when the config defines it
     # (task_arg.eval_max_march_samples — MarchOptions.eval_from_cfg). For

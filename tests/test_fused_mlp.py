@@ -1,0 +1,181 @@
+"""Fused NeRF-MLP Pallas kernel (ops/fused_mlp.py): forward and gradient
+parity with the Flax apply, run under the Pallas interpreter on CPU.
+
+The kernel exists to cut the flagship step's 48.8 GB of activation
+traffic (PERF.md f3): its forward saves only (x, d); its backward
+recomputes activations per tile in VMEM and accumulates weight grads
+across the sequential grid. Any numerical divergence from the Flax path
+would silently change training — these tests pin exact(±float) parity.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.ops.fused_mlp import make_fused_apply
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_fused"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=2, n_test=1)
+    # flagship-shaped but small: D=4 (skip at 1), W=128 — same structure
+    # class as lego.yaml's D=8/W=256/skip=4
+    cfg = tiny_cfg(
+        root,
+        ["network.nerf.D", "4",
+         "network.nerf.W", "128",
+         "network.nerf.skips", "[1]",
+         "network.nerf.fused_tile", "64"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    fused = make_fused_apply(network, cfg)
+
+    rng = np.random.default_rng(3)
+    pts = jnp.asarray(rng.normal(0, 0.6, (37, 5, 3)), jnp.float32)
+    dirs = rng.normal(0, 1, (37, 3))
+    dirs /= np.linalg.norm(dirs, axis=-1, keepdims=True)
+    dirs = jnp.asarray(dirs, jnp.float32)
+    return cfg, network, params, fused, pts, dirs
+
+
+def test_fused_forward_matches_flax(setup):
+    cfg, network, params, fused, pts, dirs = setup
+    for model in ("coarse", "fine"):
+        ref = network.apply(params, pts, dirs, model=model)
+        got = fused(params, pts, dirs, model)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=model,
+        )
+
+
+def test_fused_gradients_match_flax(setup):
+    """d(loss)/d(params) through the fused custom_vjp must equal the Flax
+    backward — including the skip split, both heads, and the padding VJPs
+    that route flat grads back into the branch dict."""
+    cfg, network, params, fused, pts, dirs = setup
+    gt = jnp.linspace(0, 1, pts.shape[0] * 4).reshape(pts.shape[0], 1, 4)
+    gt = jnp.broadcast_to(gt, pts.shape[:-1] + (4,))
+
+    def loss_ref(p):
+        raw = network.apply(p, pts, dirs, model="fine")
+        return jnp.mean((raw - gt) ** 2)
+
+    def loss_fused(p):
+        raw = fused(p, pts, dirs, "fine")
+        return jnp.mean((raw - gt) ** 2)
+
+    l_ref, g_ref = jax.value_and_grad(loss_ref)(params)
+    l_fused, g_fused = jax.value_and_grad(loss_fused)(params)
+    np.testing.assert_allclose(float(l_fused), float(l_ref), rtol=1e-6)
+
+    flat_ref = jax.tree_util.tree_leaves_with_path(g_ref)
+    flat_fused = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(g_fused)
+    )
+    assert flat_ref and len(flat_ref) == len(flat_fused)
+    for k, v_ref in flat_ref:
+        ks = jax.tree_util.keystr(k)
+        np.testing.assert_allclose(
+            np.asarray(flat_fused[ks]), np.asarray(v_ref),
+            rtol=2e-4, atol=1e-5, err_msg=ks,
+        )
+
+
+def test_fused_gradients_flow_to_inputs(setup):
+    """dx/dv must flow out of the kernel (hash-style encoders have
+    trainable params upstream of x_enc)."""
+    cfg, network, params, fused, pts, dirs = setup
+
+    def loss_pts(p3):
+        raw = fused(params, p3, dirs, "fine")
+        return jnp.sum(raw**2)
+
+    g = jax.grad(loss_pts)(pts)
+    assert g.shape == pts.shape
+    assert bool(jnp.isfinite(g).all())
+    assert float(jnp.abs(g).sum()) > 0.0
+
+    def loss_ref(p3):
+        raw = network.apply(params, p3, dirs, model="fine")
+        return jnp.sum(raw**2)
+
+    g_ref = jax.grad(loss_ref)(pts)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), rtol=2e-4, atol=1e-4
+    )
+
+
+def test_fused_apply_refuses_unsupported_families(setup):
+    cfg, network, params, fused, pts, dirs = setup
+    root = cfg.train_dataset.data_root
+    cfg_scan = tiny_cfg(
+        root,
+        ["network.nerf.D", "4", "network.nerf.W", "128",
+         "network.nerf.skips", "[1]", "network.nerf.scan_trunk", "true"],
+    )
+    with pytest.raises(ValueError, match="exclusive"):
+        make_fused_apply(make_network(cfg_scan), cfg_scan)
+    cfg_two = tiny_cfg(
+        root,
+        ["network.nerf.D", "4", "network.nerf.W", "128",
+         "network.nerf.skips", "[0, 2]"],
+    )
+    with pytest.raises(ValueError, match="one skip"):
+        make_fused_apply(make_network(cfg_two), cfg_two)
+
+
+def test_fused_train_step_matches_standard(setup):
+    """One full jitted train step (sample → render → MSE → grads → adam)
+    with fused_trunk on must land on the same params as the standard
+    path — the production integration seam is Renderer._apply_fn."""
+    cfg, network, params, fused, pts, dirs = setup
+    root = cfg.train_dataset.data_root
+    common = [
+        "network.nerf.D", "4", "network.nerf.W", "128",
+        "network.nerf.skips", "[1]", "network.nerf.fused_tile", "64",
+        "task_arg.N_rays", "32", "task_arg.precrop_iters", "0",
+    ]
+    from nerf_replication_tpu.datasets.blender import Dataset
+    from nerf_replication_tpu.train import make_loss, make_train_state
+    from nerf_replication_tpu.train.trainer import Trainer
+
+    states = {}
+    for tag, extra in (("std", []),
+                       ("fused", ["network.nerf.fused_trunk", "true"])):
+        cfg_i = tiny_cfg(root, common + extra)
+        net_i = make_network(cfg_i)
+        loss_i = make_loss(cfg_i, net_i)
+        trainer = Trainer(cfg_i, net_i, loss_i)
+        state, _ = make_train_state(cfg_i, net_i, jax.random.PRNGKey(0))
+        ds = Dataset(data_root=root, scene="procedural", split="train",
+                     H=16, W=16)
+        bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+        state, stats = trainer.step(state, bank[0], bank[1],
+                                    jax.random.PRNGKey(7))
+        states[tag] = (state, float(stats["loss"]))
+
+    np.testing.assert_allclose(states["fused"][1], states["std"][1],
+                               rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(states["fused"][0].params),
+        jax.tree_util.tree_leaves(states["std"][0].params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+        )

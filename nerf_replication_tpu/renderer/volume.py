@@ -360,11 +360,18 @@ class Renderer:
             options = self.eval_options
             network = self.network
 
+            fused = self._fused_apply
+
             @jax.jit
             def fn(params, rays_p, near, far, key):
-                apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
-                    params, pts, vd, model=model
-                )
+                if fused is not None:
+                    apply_fn = lambda pts, vd, model: fused(  # noqa: E731
+                        params, pts, vd, model
+                    )
+                else:
+                    apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+                        params, pts, vd, model=model
+                    )
 
                 def body(idx_and_rays):
                     idx, rays_chunk = idx_and_rays
@@ -424,12 +431,18 @@ class Renderer:
         if fn is None:
             network = self.network
             options = self.march_options
+            fused = self._fused_apply
 
             @jax.jit
             def fn(params, rays_p, grid, bbox):
-                apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
-                    params, pts, vd, model=model
-                )
+                if fused is not None:
+                    apply_fn = lambda pts, vd, model: fused(  # noqa: E731
+                        params, pts, vd, model
+                    )
+                else:
+                    apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+                        params, pts, vd, model=model
+                    )
                 return jax.lax.map(
                     lambda rc: march_rays_accelerated(
                         apply_fn, rc, near, far, grid, bbox, options

@@ -179,3 +179,54 @@ def test_fused_train_step_matches_standard(setup):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
         )
+
+
+def test_fused_eval_paths_match_standard(setup):
+    """render_chunked AND the accelerated march must produce the same
+    images with fused_trunk on (both route through the fused apply)."""
+    cfg, network, params, fused, pts, dirs = setup
+    root = cfg.train_dataset.data_root
+    common = [
+        "network.nerf.D", "4", "network.nerf.W", "128",
+        "network.nerf.skips", "[1]", "network.nerf.fused_tile", "64",
+        "task_arg.N_samples", "8", "task_arg.N_importance", "8",
+        "task_arg.chunk_size", "64",
+        "task_arg.render_step_size", "0.25",
+        "task_arg.max_march_samples", "16",
+        "task_arg.march_chunk_size", "64",
+    ]
+    from nerf_replication_tpu.renderer import make_renderer
+
+    rng = np.random.default_rng(5)
+    rays = np.concatenate(
+        [
+            np.tile([0.0, 0.0, 4.0], (50, 1)),
+            np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.1, (50, 3)),
+        ],
+        -1,
+    ).astype(np.float32)
+    batch = {"rays": jnp.asarray(rays), "near": 2.0, "far": 6.0}
+    grid = np.zeros((8, 8, 8), bool)
+    grid[2:6, 2:6, 2:6] = True
+
+    outs = {}
+    for tag, extra in (("std", []),
+                       ("fused", ["network.nerf.fused_trunk", "true"])):
+        cfg_i = tiny_cfg(root, common + extra)
+        net_i = make_network(cfg_i)
+        p_i = init_params(net_i, jax.random.PRNGKey(0))
+        r = make_renderer(cfg_i, net_i)
+        r.occupancy_grid = jnp.asarray(grid)
+        r.grid_bbox = jnp.asarray(
+            np.asarray(cfg_i.train_dataset.scene_bbox, np.float32)
+        )
+        outs[tag] = (
+            r.render_chunked(p_i, batch),
+            r.render_accelerated(p_i, batch),
+        )
+    for idx, name in ((0, "chunked"), (1, "accelerated")):
+        np.testing.assert_allclose(
+            np.asarray(outs["fused"][idx]["rgb_map_f"]),
+            np.asarray(outs["std"][idx]["rgb_map_f"]),
+            rtol=2e-4, atol=2e-5, err_msg=name,
+        )

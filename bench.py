@@ -71,6 +71,17 @@ def main():
     n_rays = int(os.environ.get("BENCH_N_RAYS", defaults["n_rays"]))
     n_steps = int(os.environ.get("BENCH_STEPS", defaults["steps"]))
     config = os.environ.get("BENCH_CONFIG", defaults["config"])
+    # promoted free-form cfg overrides (e.g. the fused trunk) — env wins;
+    # defaults' opts were measured on defaults' CONFIG and must not leak
+    # onto a different BENCH_CONFIG (a fused-trunk override would be
+    # rejected outright by the hash-encoder configs)
+    opts = os.environ.get("BENCH_OPTS")
+    if opts is None:
+        opts = (
+            defaults.get("opts", "")
+            if config == defaults.get("config")
+            else ""
+        )
 
     cfg = make_cfg(
         os.path.join(_REPO, "configs", "nerf", config),
@@ -94,7 +105,7 @@ def main():
             ),
             # space-separated trailing cfg overrides, e.g.
             # BENCH_OPTS="network.xyz_encoder.custom_bwd true"
-            *os.environ.get("BENCH_OPTS", "").split(),
+            *opts.split(),
         ],
     )
     network = make_network(cfg)
@@ -176,18 +187,17 @@ def main():
                 "n_rays": n_rays,
                 "scan_steps": scan_k,
                 "grad_accum": int(cfg.task_arg.get("grad_accum", 1)),
+                "remat": bool(cfg.task_arg.get("remat", False)),
                 # free-form label (e.g. BENCH_TAG=steady_state) for sweep
                 # rows that supersede compile-window measurements
+                "config": config,
+                "ts": round(time.time(), 1),
                 **(
                     {"tag": os.environ["BENCH_TAG"]}
                     if os.environ.get("BENCH_TAG")
                     else {}
                 ),
-                **(
-                    {"opts": os.environ["BENCH_OPTS"]}
-                    if os.environ.get("BENCH_OPTS")
-                    else {}
-                ),
+                **({"opts": opts} if opts else {}),
             }
         )
     )
@@ -220,7 +230,12 @@ if __name__ == "__main__":
                     for k in ("value", "n_rays", "dtype", "remat")
                 }
                 best_known["scan_steps"] = rec.get("scan_steps", 1)
+                best_known["grad_accum"] = rec.get("grad_accum", 1)
                 best_known["config"] = rec.get("config", "lego.yaml")
+                if rec.get("opts"):
+                    # the overrides that DEFINE the point — without them
+                    # the quoted number is not reproducible
+                    best_known["opts"] = rec["opts"]
         except Exception:
             pass
         print(

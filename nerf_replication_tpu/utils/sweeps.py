@@ -2,9 +2,9 @@
 
 Sweep files are append-only (a crash must never destroy prior records), so
 a point may appear many times across runs. The repo-wide recency rule: the
-LAST record per (config, n_rays, dtype, remat, scan_steps, grad_accum) key
-wins, ordered by the record's ``ts`` (absent on pre-round-3 records ⇒
-oldest), ties by file/line order. Used by scripts/promote_bench_defaults.py (writing BENCH_DEFAULTS.
+LAST record per (config, n_rays, dtype, remat, scan_steps, grad_accum,
+opts) key wins, ordered by the record's ``ts`` (absent on pre-round-3
+records ⇒ oldest), ties by file/line order. Used by scripts/promote_bench_defaults.py (writing BENCH_DEFAULTS.
 json) and bench.py's failure diagnostics — one implementation, one rule.
 """
 
@@ -14,8 +14,8 @@ import json
 
 
 def latest_points(paths) -> dict:
-    """{(config, n_rays, dtype, remat, scan_steps, grad_accum): record}
-    after recency resolution.
+    """{(config, n_rays, dtype, remat, scan_steps, grad_accum, opts):
+    record} after recency resolution.
 
     Malformed lines are skipped; error/null records are kept here (the
     caller decides) so a re-measured failure correctly supersedes an old
@@ -40,6 +40,7 @@ def latest_points(paths) -> dict:
                 rec.get("remat"),
                 rec.get("scan_steps", 1),
                 rec.get("grad_accum", 1),
+                rec.get("opts", ""),
             )
             if key not in latest or rec.get("ts", 0) >= latest[key].get("ts", 0):
                 latest[key] = rec

@@ -28,8 +28,10 @@ NGP_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 \
 task_arg.scan_steps 8"
 
 log "stage 1: headline bench (driver replay)"
+# rows land in a BENCH_SWEEP*-globbed file so promote_bench_defaults and
+# bench.py's failure diagnostics can see them (bench.py emits ts/config)
 timeout 1800 python bench.py 2>data/logs/r5_bench.err \
-  | tee -a BENCH_R5_HEADLINE.jsonl | tail -1
+  | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
 
 log "stage 1b: fused Pallas trunk A/B at the headline shape"
 # ops/fused_mlp.py — VMEM-resident MLP chain, backward recomputes in
@@ -40,8 +42,12 @@ log "stage 1b: fused Pallas trunk A/B at the headline shape"
 for tile in 512 1024; do
   BENCH_OPTS="network.nerf.fused_trunk true network.nerf.fused_tile $tile" \
   timeout 2400 python bench.py 2>data/logs/r5_bench_fused_$tile.err \
-    | tee -a BENCH_R5_HEADLINE.jsonl | tail -1
+    | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
 done
+# promote whatever now wins (incl. a fused row's opts) into the
+# defaults the driver's plain `python bench.py` replays at round end
+python scripts/promote_bench_defaults.py BENCH_SWEEP*.jsonl \
+  --config lego.yaml || true
 
 log "stage 2: NGP A/B std vs ngp vs ngp_packed (420 s/arm)"
 timeout 3600 python scripts/bench_ngp.py --seconds 420 \

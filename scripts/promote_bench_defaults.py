@@ -9,10 +9,11 @@ known config instead of the conservative 4096-ray default.
         [more.jsonl ...] [--config lego.yaml]
 
 Sweep files are append-only (a crash must not destroy prior records), so a
-point may appear many times across runs; only the LAST record per
-(config, n_rays, dtype, remat, scan_steps) key counts — a re-measured point replaces its
-stale history instead of a stale fast record winning forever. Error records
-are never promoted.
+point may appear many times across runs; only the LAST record per sweep
+point counts (the key tuple lives in ONE place —
+nerf_replication_tpu/utils/sweeps.py — shared with bench.py's failure
+diagnostics) — a re-measured point replaces its stale history instead of
+a stale fast record winning forever. Error records are never promoted.
 """
 
 from __future__ import annotations
@@ -49,6 +50,9 @@ def main(argv=None):
         "remat": "true" if best.get("remat") else "false",
         "scan_steps": int(best.get("scan_steps", 1)),
         "grad_accum": int(best.get("grad_accum", 1)),
+        # free-form cfg overrides (e.g. the fused Pallas trunk) travel
+        # with the winning point so the driver's plain bench replays them
+        "opts": best.get("opts", ""),
         "config": args.config,
         "measured_rays_per_sec": round(float(best["value"]), 1),
         "source": "scripts/promote_bench_defaults.py",

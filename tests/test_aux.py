@@ -476,15 +476,23 @@ def test_sweep_recency_keys_on_grad_accum_and_promotes_it(tmp_path):
         {"metric": "train_rays_per_sec", "value": 250.0, "n_rays": 4096,
          "dtype": "bfloat16", "remat": False, "scan_steps": 8,
          "grad_accum": 4, "config": "lego.yaml", "ts": 2.0},
+        # free-form opts (e.g. the fused trunk) are their OWN point and
+        # must travel into the promoted defaults when they win — as must
+        # grad_accum (a promoted accum row must replay WITH accumulation)
+        {"metric": "train_rays_per_sec", "value": 300.0, "n_rays": 4096,
+         "dtype": "bfloat16", "remat": False, "scan_steps": 8,
+         "grad_accum": 4, "opts": "network.nerf.fused_trunk true",
+         "config": "lego.yaml", "ts": 3.0},
     ]
     p = tmp_path / "BENCH_SWEEP_T.jsonl"
     p.write_text("".join(json.dumps(r) + "\n" for r in rows))
 
     pts = latest_points([str(p)])
-    assert len(pts) == 2  # the accum row did not replace the plain row
+    assert len(pts) == 3  # neither accum nor opts replaced the plain row
 
     best = best_point([str(p)], config="lego.yaml")
-    assert best["value"] == 250.0 and best.get("grad_accum") == 4
+    assert best["value"] == 300.0
+    assert best.get("opts") == "network.nerf.fused_trunk true"
 
     import importlib.util
     import os as _os
@@ -500,5 +508,6 @@ def test_sweep_recency_keys_on_grad_accum_and_promotes_it(tmp_path):
     rc = promote.main([str(p), "--config", "lego.yaml", "--out", str(out)])
     assert rc == 0
     promoted = json.loads(out.read_text())
+    assert promoted["opts"] == "network.nerf.fused_trunk true"
     assert promoted["grad_accum"] == 4
-    assert promoted["measured_rays_per_sec"] == 250.0
+    assert promoted["measured_rays_per_sec"] == 300.0

@@ -61,6 +61,14 @@ timeout 1800 python scripts/bench_ngp.py --seconds 420 \
   --out BENCH_NGP.jsonl $NGP_OPTS task_arg.ngp_grid_update_every 64 \
   2>data/logs/r5_ngp_refresh.err | tail -2
 
+log "stage 3b: NGP-step cost analysis (validates the PERF.md roofline)"
+for MODE in "" "task_arg.ngp_packed_march true"; do
+  BENCH_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 $MODE" \
+  timeout 1800 python scripts/profile_step.py --ngp --n_rays 4096 \
+    --remat false --config lego_hash_packed.yaml --steps 20 \
+    2>data/logs/r5_ngp_profile.err | tee -a PROFILE_STEP.jsonl | tail -2
+done
+
 log "stage 4a: flagship steady-state scale rows (8k/16k/65k)"
 BENCH_TAG=steady_state BENCH_OPTS="network.nerf.scan_trunk true" \
 timeout 7200 python scripts/bench_sweep.py \

@@ -29,6 +29,12 @@ def main(argv=None):
     p.add_argument("--config", default="lego.yaml")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--trace_dir", default="")
+    p.add_argument("--ngp", action="store_true",
+                   help="profile the NGP carved-march step instead of the "
+                        "hierarchical trainer (grid pre-carved to "
+                        "--occupancy; cost analysis is occupancy-"
+                        "independent — static shapes)")
+    p.add_argument("--occupancy", type=float, default=0.05)
     p.add_argument("--force_platform", default=os.environ.get(
         "BENCH_FORCE_PLATFORM", ""))
     args = p.parse_args(argv)
@@ -57,11 +63,28 @@ def main(argv=None):
         ],
     )
     network = make_network(cfg)
-    loss = make_loss(cfg, network)
-    trainer = Trainer(cfg, network, loss)
     key = jax.random.PRNGKey(0)
     k_init, k_bank, base_key = jax.random.split(key, 3)
-    state, _ = make_train_state(cfg, network, k_init)
+    if args.ngp:
+        from nerf_replication_tpu.train.ngp import make_ngp_trainer
+
+        trainer = make_ngp_trainer(cfg, network)
+        state, _ = trainer.make_state(k_init)
+        # pre-carve: the carved executable's OPS are occupancy-independent
+        # (static shapes), but a realistic grid keeps the timing honest
+        occ_mask = jax.random.bernoulli(
+            jax.random.PRNGKey(5), args.occupancy, state.grid_ema.shape
+        )
+        state = state.replace(
+            grid_ema=jnp.where(occ_mask, 2.0 * trainer.threshold, 0.0)
+        )
+        # skip straight to the carved phase
+        trainer._host_step = max(trainer.warmup_steps, int(state.step))
+        trainer._last_occ = float(args.occupancy)
+    else:
+        loss = make_loss(cfg, network)
+        trainer = Trainer(cfg, network, loss)
+        state, _ = make_train_state(cfg, network, k_init)
 
     n_bank = 1 << 20
     k1, k2, k3 = jax.random.split(k_bank, 3)
@@ -74,7 +97,10 @@ def main(argv=None):
     bank_rgbs = jax.random.uniform(k3, (n_bank, 3), jnp.float32)
 
     # compiled cost analysis (no execution needed beyond compile)
-    step_fn = trainer._build_step(with_pool=False)
+    if args.ngp:
+        step_fn = trainer._jit_step(1, warm=False)
+    else:
+        step_fn = trainer._build_step(with_pool=False)
     compiled = step_fn.lower(state, bank_rays, bank_rgbs, base_key).compile()
     ca = compiled.cost_analysis()
     ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})

@@ -44,6 +44,12 @@ class MarchOptions:
     max_samples: int = 192  # K: MLP-query budget per ray
     white_bkgd: bool = True
     chunk_size: int = 4096
+    # packed march only: clip each ray's march span to its scene-bbox
+    # intersection, so the SAME static S covers a shorter span at a finer
+    # per-ray effective step — equivalently, a config can raise step_size
+    # (shrinking the phase-1/sort row counts) at unchanged in-bbox
+    # resolution. Changes quadrature positions: off by default.
+    clip_bbox: bool = False
 
     @classmethod
     def from_cfg(cls, cfg) -> "MarchOptions":
@@ -56,6 +62,7 @@ class MarchOptions:
             max_samples=int(ta.get("max_march_samples", 192)),
             white_bkgd=bool(ta.get("white_bkgd", True)),
             chunk_size=int(ta.get("march_chunk_size", 4096)),
+            clip_bbox=bool(ta.get("march_clip_bbox", False)),
         )
 
     @classmethod
@@ -84,17 +91,23 @@ class MarchOptions:
         )
 
 
-def occupancy_sweep(rays, near, far, grid, bbox, step_size):
+def occupancy_sweep(rays, near, far, grid, bbox, step_size, spans=None):
     """Phase 1 shared by the per-ray and packed marches: classify every
-    fixed-step march position of every ray against the occupancy grid in
-    one vectorized gather (no MLP).
+    march position of every ray against the occupancy grid in one
+    vectorized gather (no MLP).
 
-    Returns ``(ts [S], flat_vox [N, S] voxel ids, occupied [N, S] bool,
-    n_steps)``. torch.arange(near, far, Δ) semantics: ceil((far−near)/Δ)
-    positions, far excluded (the epsilon keeps exactly-divisible ranges
-    from gaining one). Zero-direction rays (chunk/shard PADDING) are
-    forced unoccupied: their positions all collapse onto one voxel and
-    would otherwise consume march budget / inflate overflow stats.
+    Returns ``(ts, flat_vox [N, S] voxel ids, occupied [N, S] bool,
+    n_steps)``. torch.arange(near, far, Δ) semantics set S:
+    ceil((far−near)/Δ) positions, far excluded (the epsilon keeps
+    exactly-divisible ranges from gaining one). Zero-direction rays
+    (chunk/shard PADDING) are forced unoccupied: their positions all
+    collapse onto one voxel and would otherwise consume march budget /
+    inflate overflow stats.
+
+    ``spans=(t0 [N], step_r [N])`` switches to PER-RAY quadrature (the
+    packed march's clip_bbox mode): position s of ray r sits at
+    ``t0[r] + s·step_r[r]``, degenerate spans (step_r ≤ 0) are masked
+    unoccupied, and ``ts`` comes back as the [N, S] per-ray positions.
     """
     import math
 
@@ -112,13 +125,21 @@ def occupancy_sweep(rays, near, far, grid, bbox, step_size):
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     resolution = grid.shape[0]
     n_steps = max(math.ceil((far - near) / step_size - 1e-9), 1)
-    ts = near + jnp.arange(n_steps, dtype=jnp.float32) * step_size
-    pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[None, :, None]
+    s_idx = jnp.arange(n_steps, dtype=jnp.float32)
+    if spans is None:
+        ts = near + s_idx * step_size
+        pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[None, :, None]
+    else:
+        t0, step_r = spans
+        ts = t0[:, None] + s_idx[None, :] * step_r[:, None]  # [N, S]
+        pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[..., None]
     vox = world_to_voxel(pts, bbox, resolution)  # [N, S, 3]
     flat = (vox[..., 0] * resolution + vox[..., 1]) * resolution + vox[..., 2]
     occupied = jnp.take(grid.reshape(-1), flat)  # [N, S] bool
     real = jnp.sum(rays_d * rays_d, axis=-1) > 0.0  # [N]
     occupied = occupied & real[:, None]
+    if spans is not None:
+        occupied = occupied & (spans[1] > 0)[:, None]
     return ts, flat, occupied, n_steps
 
 
@@ -138,6 +159,13 @@ def march_rays_accelerated(
     live grid maintenance feeds on (train/ngp.py): ``sample_flat`` [N, K]
     int32 flat voxel ids, ``sample_sigma`` [N, K], ``sample_valid`` [N, K]
     bool — gradients stopped (grid maintenance must not backprop)."""
+    if options.clip_bbox:
+        raise ValueError(
+            "march_clip_bbox is implemented only by the packed march — "
+            "set task_arg.ngp_packed_march true (the per-ray [N, K] "
+            "march would silently run UNCLIPPED at the coarse step, "
+            "invalidating any A/B labeled with the clip knob)"
+        )
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     n_rays = rays.shape[0]
     step = options.step_size

@@ -46,6 +46,21 @@ import jax.numpy as jnp
 from .accelerated import MarchOptions, occupancy_sweep
 
 
+def _ray_bbox_spans(rays_o, rays_d, bbox, near, far):
+    """Per-ray [t0, t1] of the bbox intersection, clipped to [near, far].
+
+    Slab method; rays missing the bbox (or with a degenerate direction
+    component and origin outside the slab) come back with t1 == t0."""
+    inv = 1.0 / jnp.where(jnp.abs(rays_d) < 1e-12, 1e-12, rays_d)
+    t_lo = (bbox[0] - rays_o) * inv
+    t_hi = (bbox[1] - rays_o) * inv
+    tmin = jnp.max(jnp.minimum(t_lo, t_hi), axis=-1)
+    tmax = jnp.min(jnp.maximum(t_lo, t_hi), axis=-1)
+    t0 = jnp.clip(tmin, near, far)
+    t1 = jnp.clip(tmax, near, far)
+    return t0, jnp.maximum(t1, t0)
+
+
 def march_rays_packed(
     apply_fn,
     rays: jax.Array,
@@ -68,12 +83,22 @@ def march_rays_packed(
     n_rays = rays.shape[0]
     step = options.step_size
 
-    # phase 1: occupancy of every march position (shared with the per-ray
-    # march — one implementation, exact-parity contract). Zero-direction
-    # padding rays come back fully unoccupied, so they never consume
-    # stream budget or inflate overflow_frac.
-    ts, flat_vox, occupied, n_steps = occupancy_sweep(
-        rays, near, far, grid, bbox, step
+    # phase 1: occupancy of every march position — ONE implementation
+    # shared with the per-ray march (exact-parity contract). clip_bbox
+    # switches the shared sweep to per-ray quadrature: the same static S
+    # covers only the ray's bbox span at a finer per-ray step. Padding
+    # rays / bbox misses come back fully unoccupied either way.
+    if options.clip_bbox:
+        import math
+
+        n_est = max(math.ceil((far - near) / step - 1e-9), 1)
+        t0, t1 = _ray_bbox_spans(rays_o, rays_d, bbox, near, far)
+        step_r = (t1 - t0) / n_est  # [N]
+        spans = (t0, step_r)
+    else:
+        t0 = step_r = spans = None
+    _, flat_vox, occupied, n_steps = occupancy_sweep(
+        rays, near, far, grid, bbox, step, spans=spans
     )
     m_cap = min(int(n_rays * cap_avg), n_rays * n_steps)
 
@@ -89,7 +114,12 @@ def march_rays_packed(
 
     ray_id = order // n_steps  # [M] int32, nondecreasing over valid prefix
     s_id = order % n_steps
-    t_m = near + s_id.astype(jnp.float32) * step
+    if options.clip_bbox:
+        t_m = t0[ray_id] + s_id.astype(jnp.float32) * step_r[ray_id]
+        step_m = step_r[ray_id]
+    else:
+        t_m = near + s_id.astype(jnp.float32) * step
+        step_m = step
 
     o_m = rays_o[ray_id]
     d_m = rays_d[ray_id]
@@ -102,7 +132,7 @@ def march_rays_packed(
 
     rgb = jax.nn.sigmoid(raw[..., :3])  # [M, 3]
     sigma = jax.nn.relu(raw[..., 3])  # [M]
-    dists = step * jnp.linalg.norm(d_m, axis=-1)
+    dists = step_m * jnp.linalg.norm(d_m, axis=-1)
     # 1 − α = exp(−σδ): transmittance in log space is EXACT, no clamps
     tau = sigma * dists * valid.astype(jnp.float32)  # [M]
     c = jnp.cumsum(tau)

@@ -61,6 +61,17 @@ timeout 1800 python scripts/bench_ngp.py --seconds 420 \
   --out BENCH_NGP.jsonl $NGP_OPTS task_arg.ngp_grid_update_every 64 \
   2>data/logs/r5_ngp_refresh.err | tail -2
 
+log "stage 3c: packed + bbox-clip + slow refresh (the combined levers)"
+# per-ray clipping concentrates the SAME static S inside the bbox span,
+# so step 0.015 here has ~the 0.01 unclipped in-bbox resolution with 33%
+# fewer phase-1/sort rows; update_every 64 cuts the refresh 4x.
+timeout 1800 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
+  task_arg.max_march_samples 64 task_arg.scan_steps 8 \
+  task_arg.march_clip_bbox true task_arg.ngp_grid_update_every 64 \
+  2>data/logs/r5_ngp_clip.err | tail -2
+
 log "stage 3b: NGP-step cost analysis (validates the PERF.md roofline)"
 for MODE in "" "task_arg.ngp_packed_march true"; do
   BENCH_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 $MODE" \

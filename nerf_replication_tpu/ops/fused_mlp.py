@@ -43,6 +43,23 @@ def _interpret() -> bool:
     return jax.devices()[0].platform not in ("tpu", "axon")
 
 
+def _mosaic_kwargs() -> dict:
+    """Raise the scoped-VMEM (kernel stack) limit above Mosaic's 16 MB
+    default: the tile-1024 backward's stack is 17.4 MB (recorded OOM,
+    BENCH_SWEEP_FUSED.jsonl), comfortably inside the chip's 128 MB VMEM.
+    Bigger tiles matter because the per-tile weight stream (~2.4 MB f32)
+    is the kernel's own HBM term — grid steps halve as tiles double."""
+    if _interpret():
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {
+        "compiler_params": pltpu.CompilerParams(
+            vmem_limit_bytes=96 * 1024 * 1024
+        )
+    }
+
+
 def _pad_cols(a, to):
     c = a.shape[-1]
     if c == to:
@@ -359,6 +376,7 @@ def _pallas_fwd(spec, tile, flat_ws, x, v):
         out_specs=pl.BlockSpec((tile, 8), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((m, 8), jnp.float32),
         interpret=_interpret(),
+        **_mosaic_kwargs(),
     )(x, v, *flat_ws)
 
 
@@ -399,6 +417,7 @@ def _fused_bwd(spec, tile, res, draw):
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=_interpret(),
+        **_mosaic_kwargs(),
     )(x, v, jnp.asarray(draw, jnp.float32), *flat_ws)
     dx, dv = outs[0], outs[1]
     dws = list(outs[2:])

@@ -1,0 +1,67 @@
+#!/bin/bash
+# Round-5 follow-up battery — runs AFTER battery_r5.sh. Two questions the
+# first battery left open:
+#
+#   A. Did the fused Pallas trunk actually cut the step's HBM bytes?
+#      Stage 1b measured only +1.9% at the headline shape (48.6k vs
+#      47.7k), far off the modeled 65-100k. profile_step's XLA
+#      flops/bytes accounting on the FUSED step tells us whether the
+#      traffic went away (=> the step is bound elsewhere, attack that)
+#      or didn't (=> the kernel's sequential-grid dW accumulation or
+#      f32 weight streams eat the win).
+#   B. Where does fused win at SCALE? The std 65k-ray no-remat step
+#      OOMs HBM (24.6G, BENCH_SWEEP ts 1785518936); the fused forward
+#      saves only [M,4] + inputs, so 65k/scan-1 should now fit without
+#      remat — and big batches amortize the dispatch floor that caps
+#      the 4k-ray shape.
+#
+# Plus the packed-NGP scale rows the r4 verdict disowned as
+# compile-window artifacts: steady-state 8k/16k with enough budget to
+# carve (warmup is a fixed step count, so bigger batches need more
+# wall).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p data/logs
+log() { echo "[batteryR5b $(date +%H:%M:%S)] $*"; }
+export BENCH_INIT_TOTAL_S=${BENCH_INIT_TOTAL_S:-420}
+
+FUSED="network.nerf.fused_trunk true network.nerf.fused_tile 512"
+
+log "stage A: fused-step XLA bytes/flops (did the traffic go away?)"
+BENCH_OPTS="$FUSED" timeout 1800 python scripts/profile_step.py \
+  --n_rays 4096 --remat false --config lego.yaml --steps 20 \
+  2>data/logs/r5b_profile_fused.err | tee -a PROFILE_STEP.jsonl | tail -2
+
+log "stage B: fused at scale (16k/scan8, 65k/scan1 — std OOMs here)"
+for shape in "16384 8" "65536 1"; do
+  set -- $shape
+  BENCH_N_RAYS=$1 BENCH_SCAN_STEPS=$2 BENCH_OPTS="$FUSED" \
+  timeout 2400 python bench.py 2>data/logs/r5b_fused_$1.err \
+    | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
+done
+
+log "stage C: fused tile axis at the headline shape (256; 1024 retry)"
+# 1024 re-tries the recorded scoped-VMEM OOM with the raised Mosaic
+# vmem_limit_bytes (ops/fused_mlp.py _mosaic_kwargs) — doubling the tile
+# halves the kernel's per-tile weight-stream HBM term.
+for t in 256 1024; do
+  BENCH_OPTS="network.nerf.fused_trunk true network.nerf.fused_tile $t" \
+  timeout 1800 python bench.py 2>data/logs/r5b_fused_t$t.err \
+    | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
+done
+
+log "stage D: packed-NGP steady state at 8k/16k rays (600 s/arm)"
+for nr in 8192 16384; do
+  timeout 2400 python scripts/bench_ngp.py --seconds 600 --n_rays $nr \
+    --config lego_hash_packed.yaml --arms ngp_packed \
+    --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
+    task_arg.max_march_samples 64 task_arg.scan_steps 8 \
+    task_arg.march_clip_bbox true task_arg.ngp_grid_update_every 64 \
+    2>data/logs/r5b_ngp_$nr.err | tail -2
+done
+
+log "stage E: re-promote the winning point for the driver's bench"
+python scripts/promote_bench_defaults.py BENCH_SWEEP*.jsonl \
+  --config lego.yaml || true
+
+log "battery r5b done"

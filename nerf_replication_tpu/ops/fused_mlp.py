@@ -106,7 +106,8 @@ class FusedSpec:
         self.c_views_pad = _rup(max(self.c_views, 1), 32)
         self.compute_dtype = compute_dtype
 
-    # canonical parameter order fed to the kernels (all f32, padded):
+    # canonical parameter order fed to the kernels (compute-dtype streams
+    # for trunk/feature/views, f32 for the alpha/rgb heads; padded):
     #   W0 [c_in_pad, W], b0 [1, W]
     #   per trunk layer i in 1..D-1:
     #       skip+1: Wsx [c_in_pad, W], Wsh [W, W], bs [1, W]
@@ -119,10 +120,20 @@ class FusedSpec:
         D, W, skip = self.D, self.W, self.skip
         out = []
 
-        def kb(name):
+        # Stream dtype: the trunk/feature/views weights reach the MXU as
+        # compute_dtype anyway (the kernels .astype(cd) every operand),
+        # so streaming them bf16 halves the kernel's dominant HBM term —
+        # the per-tile weight re-read (~2.4 MB f32 × every grid step).
+        # The alpha/rgb heads stay f32 to mirror the Flax net's
+        # f32-head numerics (models/nerf/network.py:174-186). The VJP
+        # of the cast routes the f32 cotangent back exactly.
+        sd = jnp.dtype(self.compute_dtype)
+
+        def kb(name, dtype=None):
+            dt = sd if dtype is None else dtype
             p = branch[name]
-            return jnp.asarray(p["kernel"], jnp.float32), jnp.asarray(
-                p["bias"], jnp.float32
+            return jnp.asarray(p["kernel"], dt), jnp.asarray(
+                p["bias"], dt
             ).reshape(1, -1)
 
         k0, b0 = kb("pts_linear_0")
@@ -138,7 +149,7 @@ class FusedSpec:
                 ]
             else:
                 out += [ki, bi]
-        ka, ba = kb("alpha_linear")
+        ka, ba = kb("alpha_linear", dtype=jnp.float32)
         # live column at 3: raw layout is [r, g, b, alpha, pad...]
         out += [_place_col(ka, 3, 8), _place_col(ba, 3, 8)]
         kf, bf = kb("feature_linear")
@@ -149,7 +160,7 @@ class FusedSpec:
             _pad_rows(kv[self.W :], self.c_views_pad),
             bv,
         ]
-        kr, br = kb("rgb_linear")
+        kr, br = kb("rgb_linear", dtype=jnp.float32)
         out += [_pad_cols(kr, 8), _pad_cols(br, 8)]
         return out
 
@@ -420,7 +431,10 @@ def _fused_bwd(spec, tile, res, draw):
         **_mosaic_kwargs(),
     )(x, v, jnp.asarray(draw, jnp.float32), *flat_ws)
     dx, dv = outs[0], outs[1]
-    dws = list(outs[2:])
+    # cotangent dtypes must match the primals: bf16-streamed weights get
+    # their dW rounded to bf16 here (the Flax bf16 path rounds its dW the
+    # same way); flatten_params' cast-VJP upcasts back to f32 params
+    dws = [g.astype(w.dtype) for g, w in zip(outs[2:], flat_ws)]
     return tuple(dws), dx, dv
 
 

@@ -1,0 +1,105 @@
+#!/bin/bash
+# Resume of battery_r5.sh from stage 2 after the 09:0x tunnel wedge
+# (the ngp arm blocked ~45 min on a dead in-flight remote compile; the
+# battery was killed by PID per the kill discipline in
+# docs/operations.md). Stage 1/1b results are already recorded in
+# BENCH_SWEEP_FUSED.jsonl; stage 2's std arm is already in
+# BENCH_NGP.jsonl (ts 1785573924).
+#
+# Starts with the tpu_battery-style watch loop: two consecutive good
+# probes 60 s apart = a usable window.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p data/logs
+log() { echo "[batteryR5r $(date +%H:%M:%S)] $*"; }
+export BENCH_INIT_TOTAL_S=${BENCH_INIT_TOTAL_S:-420}
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform in ("tpu", "axon")
+import jax.numpy as jnp
+jnp.arange(8).sum().block_until_ready()
+EOF
+}
+
+log "watch loop: waiting for two good probes 60 s apart"
+good=0
+until [ "$good" -ge 2 ]; do
+  if probe; then
+    good=$((good + 1))
+    log "probe ok ($good/2)"
+    [ "$good" -lt 2 ] && sleep 60
+  else
+    good=0
+    log "probe failed; sleeping 120 s"
+    sleep 120
+  fi
+done
+log "tunnel usable; resuming stages"
+
+NGP_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 \
+task_arg.scan_steps 8"
+
+log "stage 2r: NGP A/B ngp vs ngp_packed (420 s/arm; std already done)"
+timeout 4800 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp ngp_packed \
+  --out BENCH_NGP.jsonl $NGP_OPTS \
+  2>data/logs/r5_ngp_ab2.err | tail -4
+
+log "stage 3: packed refresh lever (update_every 64)"
+timeout 1800 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl $NGP_OPTS task_arg.ngp_grid_update_every 64 \
+  2>data/logs/r5_ngp_refresh.err | tail -2
+
+log "stage 3c: packed + bbox-clip + slow refresh (the combined levers)"
+timeout 1800 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
+  task_arg.max_march_samples 64 task_arg.scan_steps 8 \
+  task_arg.march_clip_bbox true task_arg.ngp_grid_update_every 64 \
+  2>data/logs/r5_ngp_clip.err | tail -2
+
+log "stage 3b: NGP-step cost analysis (validates the PERF.md roofline)"
+for MODE in "" "task_arg.ngp_packed_march true"; do
+  BENCH_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 $MODE" \
+  timeout 1800 python scripts/profile_step.py --ngp --n_rays 4096 \
+    --remat false --config lego_hash_packed.yaml --steps 20 \
+    2>data/logs/r5_ngp_profile.err | tee -a PROFILE_STEP.jsonl | tail -2
+done
+
+log "stage 4a: flagship steady-state scale rows (8k/16k/65k)"
+BENCH_TAG=steady_state BENCH_OPTS="network.nerf.scan_trunk true" \
+timeout 7200 python scripts/bench_sweep.py \
+  --rays 8192 16384 65536 --dtypes bfloat16 --remat false \
+  --scan_steps 8 --grad_accum 1 8 --steps 40 --point_timeout 2400 \
+  --out BENCH_SWEEP.jsonl 2>data/logs/r5_sweep_flagship.err | tail -8
+
+log "stage 4b: packed-hash steady-state scale rows (4k/8k/16k, accum)"
+BENCH_TAG=steady_state timeout 5400 python scripts/bench_sweep.py \
+  --rays 4096 8192 16384 --dtypes bfloat16 --remat false \
+  --scan_steps 8 --grad_accum 1 4 --steps 40 --point_timeout 1800 \
+  --config lego_hash_packed.yaml --out BENCH_SWEEP_HASH.jsonl \
+  2>data/logs/r5_sweep_hash.err | tail -8
+
+log "stage 5: NGP H=400 quality trail (decoupled eval budget, packed)"
+timeout 2700 python scripts/quality_run.py --minutes 25 --H 400 \
+  --config lego_hash_packed.yaml --out_prefix QUALITY_NGP_R5 \
+  --tag q_ngp_r5 task_arg.ngp_training true \
+  task_arg.ngp_packed_march true $NGP_OPTS \
+  2>data/logs/r5_quality_ngp.err | tail -6
+
+log "stage 6: std quality trail + eval-fps shootout (lego.yaml)"
+timeout 2100 python scripts/quality_run.py --minutes 15 --H 400 \
+  --config lego.yaml --out_prefix QUALITY_R5 --tag q_std_r5 \
+  2>data/logs/r5_quality_std.err | tail -8
+
+log "stage 7: hard-scene trail (thin fence + checker)"
+timeout 2100 python scripts/quality_run.py --minutes 15 --H 400 \
+  --scene procedural_hard --config lego_hash_packed.yaml \
+  --out_prefix QUALITY_HARD --tag q_hard_r5 \
+  task_arg.ngp_training true task_arg.ngp_packed_march true $NGP_OPTS \
+  2>data/logs/r5_quality_hard.err | tail -6
+
+log "battery r5 resume done"

@@ -121,6 +121,57 @@ def test_fused_gradients_flow_to_inputs(setup):
     )
 
 
+def test_fused_bf16_stream_parity(setup):
+    """compute_dtype=bfloat16 streams the trunk/feature/views weights
+    into the kernel AS bf16 (flatten_params) and rounds dW back to bf16
+    in the custom_vjp (_fused_bwd) — the production TPU precision.
+    Pins (a) the mixed-dtype cotangent matching (a dropped astype raises
+    a custom_vjp dtype error on any grad call) and (b) forward/grad
+    agreement with the Flax bf16 path within bf16 rounding."""
+    cfg, _, _, _, pts, dirs = setup
+    root = cfg.train_dataset.data_root
+    cfg_bf = tiny_cfg(
+        root,
+        ["network.nerf.D", "4", "network.nerf.W", "128",
+         "network.nerf.skips", "[1]", "network.nerf.fused_tile", "64",
+         "precision.compute_dtype", "bfloat16"],
+    )
+    net = make_network(cfg_bf)
+    params = init_params(net, jax.random.PRNGKey(0))
+    fused = make_fused_apply(net, cfg_bf)
+
+    ref = net.apply(params, pts, dirs, model="fine")
+    got = fused(params, pts, dirs, "fine")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
+
+    gt = jnp.zeros(pts.shape[:-1] + (4,), jnp.float32)
+
+    def loss(apply_fn):
+        def f(p):
+            return jnp.mean((apply_fn(p) - gt) ** 2)
+        return f
+
+    g_ref = jax.grad(loss(lambda p: net.apply(p, pts, dirs, model="fine")))(
+        params
+    )
+    g_fus = jax.grad(loss(lambda p: fused(p, pts, dirs, "fine")))(params)
+    flat_fus = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(g_fus)
+    )
+    for k, v_ref in jax.tree_util.tree_leaves_with_path(g_ref):
+        ks = jax.tree_util.keystr(k)
+        v = flat_fus[ks]
+        assert v.dtype == v_ref.dtype, ks  # grads land in param dtype
+        assert bool(jnp.isfinite(v).all()), ks
+        np.testing.assert_allclose(
+            np.asarray(v), np.asarray(v_ref), rtol=6e-2, atol=2e-3,
+            err_msg=ks,
+        )
+
+
 def test_fused_apply_refuses_unsupported_families(setup):
     cfg, network, params, fused, pts, dirs = setup
     root = cfg.train_dataset.data_root

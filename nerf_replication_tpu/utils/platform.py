@@ -14,6 +14,32 @@ import os
 import re
 
 
+def parse_platform_pin(value: str) -> tuple[str, int | None]:
+    """Parse the documented pin syntax: ``"cpu"`` or ``"cpu:8"``.
+
+    The single parser for every consumer of ``NERF_PLATFORM`` /
+    ``--force_platform`` (utils/setup.configure_runtime, setup_backend,
+    __graft_entry__) — a malformed value fails loudly, naming the value,
+    instead of an int() traceback deep in backend setup."""
+    name, _, count = value.partition(":")
+    if not name:
+        raise ValueError(f"malformed platform pin {value!r}: empty name")
+    if not count:
+        return name, None
+    try:
+        n = int(count)
+    except ValueError:
+        raise ValueError(
+            f"malformed platform pin {value!r}: count {count!r} is not an "
+            f"integer (expected e.g. 'cpu' or 'cpu:8')"
+        ) from None
+    if n <= 0:
+        raise ValueError(
+            f"malformed platform pin {value!r}: device count must be >= 1"
+        )
+    return name, n
+
+
 def force_platform(platform: str = "cpu", device_count: int | None = None) -> None:
     """Pin the JAX platform (and, for cpu, the virtual device count).
 
@@ -265,8 +291,13 @@ def setup_backend(force_platform_name: str | None = None) -> None:
     can run on the chip routes through this — the round-3 20-minute silent
     hang was one entry point missing the guard.
     """
+    # the documented escape hatch (docs/operations.md) must work on every
+    # chip-facing CLI: an explicit --force_platform wins, else the
+    # NERF_PLATFORM env pin ("cpu" / "cpu:8"), else guarded real init
+    if not force_platform_name:
+        force_platform_name = os.environ.get("NERF_PLATFORM", "")
     if force_platform_name:
-        force_platform(force_platform_name)
+        force_platform(*parse_platform_pin(force_platform_name))
         return
     try:
         init_backend_with_retry()

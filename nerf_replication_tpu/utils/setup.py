@@ -33,13 +33,9 @@ def configure_runtime(cfg) -> None:
     # to the multi-device emulation the tests/dryrun use)
     platform = os.environ.get("NERF_PLATFORM", "")
     if platform:
-        from .platform import force_platform
+        from .platform import force_platform, parse_platform_pin
 
-        if ":" in platform:
-            name, _, count = platform.partition(":")
-            force_platform(name, device_count=int(count))
-        else:
-            force_platform(platform)
+        force_platform(*parse_platform_pin(platform))
     # persistent executable cache: battery stages / sweep points are fresh
     # processes that would otherwise re-pay identical compiles (no-op if a
     # caller — e.g. the test harness — already configured a cache dir)

@@ -382,10 +382,48 @@ def test_setup_backend_hard_exits_on_init_failure(monkeypatch):
         raise RuntimeError("backend unavailable after N attempts")
 
     exits = []
+    # the suite itself runs under a NERF_PLATFORM=cpu pin, which would
+    # (correctly) short-circuit the guarded-init path under test
+    monkeypatch.delenv("NERF_PLATFORM", raising=False)
     monkeypatch.setattr(plat, "init_backend_with_retry", fail)
     monkeypatch.setattr(os, "_exit", lambda code: exits.append(code))
     plat.setup_backend(None)
     assert exits == [1]
+
+
+def test_setup_backend_honors_nerf_platform_pin(monkeypatch):
+    """The documented escape hatch (docs/operations.md: NERF_PLATFORM=cpu
+    pins ANY chip-facing CLI) must reach setup_backend's no-arg path —
+    the round-5 smoke found quality_run probing a wedged tunnel for 6x120s
+    despite the pin."""
+    from nerf_replication_tpu.utils import platform as plat
+
+    pins = []
+    monkeypatch.setenv("NERF_PLATFORM", "cpu:4")
+    monkeypatch.setattr(
+        plat, "force_platform", lambda name, device_count=None: pins.append(
+            (name, device_count)
+        )
+    )
+    monkeypatch.setattr(
+        plat, "init_backend_with_retry",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("probed")),
+    )
+    plat.setup_backend(None)
+    assert pins == [("cpu", 4)]
+
+
+def test_parse_platform_pin_rejects_malformed():
+    import pytest
+
+    from nerf_replication_tpu.utils.platform import parse_platform_pin
+
+    assert parse_platform_pin("cpu") == ("cpu", None)
+    assert parse_platform_pin("cpu:8") == ("cpu", 8)
+    assert parse_platform_pin("cpu:") == ("cpu", None)
+    for bad in ("cpu:abc", "cpu:8x", "cpu:0", "cpu:-4", ":8"):
+        with pytest.raises(ValueError):
+            parse_platform_pin(bad)
 
 
 def test_param_prefix_surgery_roundtrip():

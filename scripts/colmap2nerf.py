@@ -29,138 +29,57 @@ import subprocess
 import sys
 
 
-def qvec2rotmat(q):
-    """COLMAP (qw, qx, qy, qz) → 3×3 rotation matrix."""
-    w, x, y, z = q
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nerf_replication_tpu.utils import colmap as _cm  # noqa: E402
+
+
+def _cam_dict(cam) -> dict:
+    return {
+        "model": cam.model,
+        "width": cam.width,
+        "height": cam.height,
+        "params": [float(p) for p in cam.params],
+    }
+
+
+def _image_tuples(images: dict) -> list:
     return [
-        [1 - 2 * y * y - 2 * z * z, 2 * x * y - 2 * z * w, 2 * x * z + 2 * y * w],
-        [2 * x * y + 2 * z * w, 1 - 2 * x * x - 2 * z * z, 2 * y * z - 2 * x * w],
-        [2 * x * z - 2 * y * w, 2 * y * z + 2 * x * w, 1 - 2 * x * x - 2 * y * y],
+        (im.name, im.camera_id, [float(v) for v in im.qvec],
+         [float(v) for v in im.tvec])
+        for im in images.values()
     ]
+
+
+qvec2rotmat = _cm.qvec2rotmat
 
 
 def parse_cameras_txt(path):
     """camera_id → dict(model, width, height, params)."""
-    cams = {}
-    with open(path) as f:
-        for line in f:
-            if line.startswith("#") or not line.strip():
-                continue
-            parts = line.split()
-            cams[int(parts[0])] = {
-                "model": parts[1],
-                "width": int(parts[2]),
-                "height": int(parts[3]),
-                "params": [float(p) for p in parts[4:]],
-            }
-    return cams
+    return {
+        cid: _cam_dict(cam)
+        for cid, cam in _cm.read_cameras_txt(path).items()
+    }
 
 
 def parse_images_txt(path):
-    """[(image_name, camera_id, qvec, tvec)].
-
-    COLMAP's format is 2 lines per image where the second (2D points) line
-    may be legitimately EMPTY — so blank lines can't be filtered wholesale
-    (that desyncs the pairing) nor kept wholesale (a stray blank between
-    records desyncs it the other way). Mirror colmap's own reader: skip
-    blank/comment lines only while LOOKING FOR an image line, then consume
-    the immediately following line (whatever it holds) as the points line.
-    """
-    out = []
-    with open(path) as f:
-        lines = f.read().splitlines()
-    i = 0
-    while i < len(lines):
-        line = lines[i].strip()
-        i += 1
-        if not line or line.startswith("#"):
-            continue
-        parts = line.split()
-        if len(parts) < 10:
-            continue
-        qvec = [float(v) for v in parts[1:5]]
-        tvec = [float(v) for v in parts[5:8]]
-        out.append((parts[9], int(parts[8]), qvec, tvec))
-        i += 1  # the 2D-points partner line, possibly empty
-    return out
-
-
-# COLMAP binary model support (the capability ref src/utils/colmap/
-# read_write_model.py:503 provides): model_id → (name, #params), from the
-# COLMAP camera-model table. Only ids that `intrinsics` understands are
-# listed; an unknown id fails loudly there with the model name.
-_CAMERA_MODELS = {
-    0: ("SIMPLE_PINHOLE", 3),
-    1: ("PINHOLE", 4),
-    2: ("SIMPLE_RADIAL", 4),
-    3: ("RADIAL", 5),
-    4: ("OPENCV", 8),
-    5: ("OPENCV_FISHEYE", 8),
-    6: ("FULL_OPENCV", 12),
-    7: ("FOV", 5),
-    8: ("SIMPLE_RADIAL_FISHEYE", 4),
-    9: ("RADIAL_FISHEYE", 5),
-    10: ("THIN_PRISM_FISHEYE", 12),
-}
+    """[(image_name, camera_id, qvec, tvec)] — the converter's pose
+    surface; the full reader (incl. the empty-observation-line pairing
+    discipline) lives in utils/colmap.read_images_txt."""
+    return _image_tuples(_cm.read_images_txt(path))
 
 
 def parse_cameras_bin(path):
-    """camera_id → dict(model, width, height, params), from cameras.bin.
-
-    Binary layout (little-endian): uint64 n_cameras, then per camera
-    int32 camera_id, int32 model_id, uint64 width, uint64 height,
-    double params[n_params(model_id)].
-    """
-    import struct
-
-    cams = {}
-    with open(path, "rb") as f:
-        (n,) = struct.unpack("<Q", f.read(8))
-        for _ in range(n):
-            cam_id, model_id, width, height = struct.unpack(
-                "<iiQQ", f.read(24)
-            )
-            if model_id not in _CAMERA_MODELS:
-                raise ValueError(f"unknown COLMAP camera model id {model_id}")
-            name, n_params = _CAMERA_MODELS[model_id]
-            params = struct.unpack(f"<{n_params}d", f.read(8 * n_params))
-            cams[cam_id] = {
-                "model": name,
-                "width": int(width),
-                "height": int(height),
-                "params": list(params),
-            }
-    return cams
+    """camera_id → dict(model, width, height, params), from cameras.bin."""
+    return {
+        cid: _cam_dict(cam)
+        for cid, cam in _cm.read_cameras_bin(path).items()
+    }
 
 
 def parse_images_bin(path):
-    """[(image_name, camera_id, qvec, tvec)], from images.bin.
-
-    Binary layout (little-endian): uint64 n_images, then per image
-    int32 image_id, double qvec[4], double tvec[3], int32 camera_id,
-    NUL-terminated name, uint64 n_points2D, then n_points2D ×
-    (double x, double y, int64 point3D_id) which we skip.
-    """
-    import struct
-
-    out = []
-    with open(path, "rb") as f:
-        (n,) = struct.unpack("<Q", f.read(8))
-        for _ in range(n):
-            vals = struct.unpack("<i7di", f.read(64))
-            qvec = list(vals[1:5])
-            tvec = list(vals[5:8])
-            cam_id = vals[8]
-            name = bytearray()
-            while True:
-                c = f.read(1)
-                if c in (b"", b"\x00"):
-                    break
-                name += c
-            (n_pts,) = struct.unpack("<Q", f.read(8))
-            f.seek(24 * n_pts, 1)  # (x, y, point3D_id) records
-            out.append((name.decode("utf-8"), cam_id, qvec, tvec))
-    return out
+    """[(image_name, camera_id, qvec, tvec)], from images.bin."""
+    return _image_tuples(_cm.read_images_bin(path))
 
 
 def parse_model(model_dir):

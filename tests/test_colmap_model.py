@@ -1,0 +1,162 @@
+"""COLMAP model I/O (utils/colmap.py): binary/text round trips and the
+quaternion helpers.
+
+The reference vendors COLMAP's read_write_model.py with self-tests that
+no runner executes (src/utils/colmap/test_read_write_model.py:37-118 —
+SURVEY.md §4 "vendored self-tests"); these are the wired equivalent for
+the independent implementation.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nerf_replication_tpu.utils.colmap import (
+    Camera,
+    Image,
+    Point3D,
+    qvec2rotmat,
+    read_model,
+    rotmat2qvec,
+    write_model,
+)
+
+
+def _model():
+    rng = np.random.default_rng(7)
+    cameras = {
+        1: Camera(1, "PINHOLE", 640, 480,
+                  np.array([500.0, 505.0, 320.0, 240.0])),
+        2: Camera(2, "SIMPLE_RADIAL", 800, 600,
+                  np.array([450.0, 400.0, 300.0, -0.03])),
+    }
+    images = {}
+    for iid in (1, 2, 3):
+        q = rng.normal(size=4)
+        q /= np.linalg.norm(q)
+        if q[0] < 0:
+            q = -q
+        m = int(rng.integers(0, 5))
+        images[iid] = Image(
+            iid, q, rng.normal(size=3), 1 + iid % 2, f"frame_{iid:04d}.png",
+            rng.uniform(0, 640, (m, 2)),
+            rng.integers(-1, 50, m).astype(np.int64),
+        )
+    points = {}
+    for pid in (10, 11):
+        t = int(rng.integers(1, 4))
+        points[pid] = Point3D(
+            pid, rng.normal(size=3),
+            rng.integers(0, 256, 3).astype(np.uint8),
+            float(rng.uniform(0, 2)),
+            rng.integers(1, 4, t).astype(np.int32),
+            rng.integers(0, 5, t).astype(np.int32),
+        )
+    return cameras, images, points
+
+
+def _assert_models_equal(a, b):
+    for (ca, ia, pa), (cb, ib, pb) in [(a, b)]:
+        assert ca.keys() == cb.keys()
+        for k in ca:
+            x, y = ca[k], cb[k]
+            assert (x.model, x.width, x.height) == (y.model, y.width,
+                                                   y.height)
+            np.testing.assert_array_equal(x.params, y.params)
+        assert ia.keys() == ib.keys()
+        for k in ia:
+            x, y = ia[k], ib[k]
+            assert (x.camera_id, x.name) == (y.camera_id, y.name)
+            np.testing.assert_array_equal(x.qvec, y.qvec)
+            np.testing.assert_array_equal(x.tvec, y.tvec)
+            np.testing.assert_array_equal(x.xys, y.xys)
+            np.testing.assert_array_equal(x.point3D_ids, y.point3D_ids)
+        assert pa.keys() == pb.keys()
+        for k in pa:
+            x, y = pa[k], pb[k]
+            np.testing.assert_array_equal(x.xyz, y.xyz)
+            np.testing.assert_array_equal(x.rgb, y.rgb)
+            assert x.error == y.error
+            np.testing.assert_array_equal(x.image_ids, y.image_ids)
+            np.testing.assert_array_equal(x.point2D_idxs, y.point2D_idxs)
+
+
+@pytest.mark.parametrize("ext", [".bin", ".txt"])
+def test_model_roundtrip(tmp_path, ext):
+    """write → read is the identity in both encodings (text uses repr
+    floats, so even the f64 bits survive)."""
+    model = _model()
+    d = str(tmp_path / "sparse")
+    write_model(*model, d, ext=ext)
+    got = read_model(d, ext=ext)
+    _assert_models_equal(model, got)
+    # auto-detect finds the same files
+    _assert_models_equal(model, read_model(d))
+
+
+def test_cross_format_equivalence(tmp_path):
+    """bin→read→write txt→read lands on the same model (the
+    model_converter guarantee the reference gets from COLMAP itself)."""
+    model = _model()
+    d_bin, d_txt = str(tmp_path / "b"), str(tmp_path / "t")
+    write_model(*model, d_bin, ext=".bin")
+    via = read_model(d_bin)
+    write_model(*via, d_txt, ext=".txt")
+    _assert_models_equal(model, read_model(d_txt))
+
+
+def test_missing_points3D_reads_empty(tmp_path):
+    cams, ims, pts = _model()
+    d = str(tmp_path / "sparse")
+    write_model(cams, ims, pts, d, ext=".bin")
+    os.remove(os.path.join(d, "points3D.bin"))
+    c2, i2, p2 = read_model(d)
+    assert p2 == {}
+    assert c2.keys() == cams.keys() and i2.keys() == ims.keys()
+
+
+def test_quaternion_helpers_roundtrip():
+    rng = np.random.default_rng(3)
+    for _ in range(20):
+        q = rng.normal(size=4)
+        q /= np.linalg.norm(q)
+        if q[0] < 0:
+            q = -q
+        R = qvec2rotmat(q)
+        # R must be a proper rotation
+        np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-12)
+        np.testing.assert_allclose(np.linalg.det(R), 1.0, atol=1e-12)
+        np.testing.assert_allclose(rotmat2qvec(R), q, atol=1e-12)
+
+
+def test_colmap2nerf_reads_written_models(tmp_path):
+    """The converter consumes models this module writes — the same
+    parse path run_colmap feeds (scripts/colmap2nerf.py parse_model)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "colmap2nerf",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "colmap2nerf.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    model = _model()
+    for ext in (".bin", ".txt"):
+        d = str(tmp_path / ext.lstrip("."))
+        write_model(*model, d, ext=ext)
+        cams, ims = mod.parse_model(d)
+        # converter-facing surface: cams dict + [(name, cam_id, q, t)]
+        assert set(cams) == set(model[0])
+        assert cams[1]["model"] == "PINHOLE"
+        by_name = {t[0]: t for t in ims}
+        assert set(by_name) == {im.name for im in model[1].values()}
+        name, cam_id, qvec, tvec = by_name["frame_0002.png"]
+        assert cam_id == model[1][2].camera_id
+        np.testing.assert_allclose(qvec, model[1][2].qvec)
+        np.testing.assert_allclose(tvec, model[1][2].tvec)

@@ -537,8 +537,12 @@ class NGPTrainer:
             jnp.asarray(batch["rays"]), self.eval_march.chunk_size
         )
 
-        render = self._render_fns.get((n_chunks, chunk))
-        if render is None:
+        def _render_fn():
+            # cap is part of the key: escalation below must recompile
+            key = (n_chunks, chunk, self.packed_cap_avg_eval)
+            render = self._render_fns.get(key)
+            if render is not None:
+                return render
             network, near, far = self.network, self.near, self.far
             bbox, options = self.bbox, self.eval_march
             packed, cap_eval = self.packed_march, self.packed_cap_avg_eval
@@ -564,12 +568,33 @@ class NGPTrainer:
 
                 return jax.lax.map(body, rays_p)
 
-            self._render_fns[(n_chunks, chunk)] = render
+            self._render_fns[key] = render
+            return render
 
-        out = render(state.params, rays_p, grid)
-        # per-chunk scalar, not per-ray: pull it out before unpadding and
-        # surface the stream-cap diagnostic instead of discarding it
-        overflow = out.pop("overflow_frac", None)
+        # a dense-phase grid can overflow the packed stream cap (dropped
+        # far samples → silently understated eval PSNR): escalate the cap
+        # and re-render, bounded; the raised cap persists on the trainer
+        # so later evals start right. Each new cap is one extra compile.
+        for attempt in range(4):
+            out = _render_fn()(state.params, rays_p, grid)
+            overflow = out.pop("overflow_frac", None)
+            max_of = (
+                float(np.asarray(jnp.max(overflow)))
+                if overflow is not None else 0.0
+            )
+            if max_of <= 0.0 or attempt == 3:
+                break  # clean, or out of escalations (warned below)
+            # the outgrown executable can never be hit again (the cap
+            # only grows) — drop it so it doesn't pin device memory
+            self._render_fns.pop(
+                (n_chunks, chunk, self.packed_cap_avg_eval), None
+            )
+            self.packed_cap_avg_eval *= 2
+            print(
+                f"ngp render_image: packed stream overflow "
+                f"{max_of:.1%} — escalating ngp_packed_cap_avg_eval to "
+                f"{self.packed_cap_avg_eval} and re-rendering"
+            )
         out = _unpad_outputs(out, n)
         # surface the budget diagnostics like Renderer.render_accelerated
         # does instead of silently dropping far content — citing the knob

@@ -179,8 +179,13 @@ def write_cameras_bin(cameras: dict[int, Camera], path) -> None:
             f.write(struct.pack(f"<{n_p}d", *map(float, cam.params)))
 
 
-def read_images_bin(path) -> dict[int, Image]:
+def read_images_bin(path, skip_points2D: bool = False) -> dict[int, Image]:
+    """``skip_points2D`` seeks past the observation records (a pose-only
+    consumer like colmap2nerf avoids materializing ~24 B × n_obs per
+    image); the Images then carry empty xys/point3D_ids."""
     out = {}
+    empty_xy = np.zeros((0, 2), np.float64)
+    empty_id = np.zeros((0,), np.int64)
     with open(path, "rb") as f:
         (n,) = _read(f, "<Q")
         for _ in range(n):
@@ -198,11 +203,17 @@ def read_images_bin(path) -> dict[int, Image]:
                     )
                 name += c
             (m,) = _read(f, "<Q")
-            # each observation is (f64 x, f64 y, i64 point3D_id): read the
-            # 24-byte records raw and reinterpret the two column groups
-            trip = np.frombuffer(f.read(24 * m), np.uint8).reshape(m, 24)
-            xys = trip[:, :16].copy().view(np.float64).reshape(m, 2)
-            p3d = trip[:, 16:].copy().view(np.int64).reshape(m)
+            if skip_points2D:
+                f.seek(24 * m, os.SEEK_CUR)
+                xys, p3d = empty_xy, empty_id
+            else:
+                # each observation is (f64 x, f64 y, i64 point3D_id): read
+                # the 24-byte records raw, reinterpret the column groups
+                trip = np.frombuffer(
+                    f.read(24 * m), np.uint8
+                ).reshape(m, 24)
+                xys = trip[:, :16].copy().view(np.float64).reshape(m, 2)
+                p3d = trip[:, 16:].copy().view(np.int64).reshape(m)
             out[iid] = Image(
                 iid, np.array(vals[:4]), np.array(vals[4:]), cam_id,
                 name.decode("utf-8"), xys, p3d,
@@ -292,7 +303,7 @@ def write_cameras_txt(cameras: dict[int, Camera], path) -> None:
             f.write(f"{cam.id} {cam.model} {cam.width} {cam.height} {ps}\n")
 
 
-def read_images_txt(path) -> dict[int, Image]:
+def read_images_txt(path, skip_points2D: bool = False) -> dict[int, Image]:
     # an image's observation line may be legitimately EMPTY, so blank
     # lines can't be skipped wholesale (that desyncs the 2-line pairing):
     # skip blanks/comments only while LOOKING FOR a header, then consume
@@ -312,7 +323,9 @@ def read_images_txt(path) -> dict[int, Image]:
             # junk/partial line — not an image header; do NOT consume a
             # partner line (matches COLMAP's own reader tolerance)
             continue
-        parts = (lines[i].split() if i < len(lines) else [])
+        parts = (
+            [] if skip_points2D or i >= len(lines) else lines[i].split()
+        )
         i += 1
         iid = int(header[0])
         q = np.array([float(v) for v in header[1:5]])

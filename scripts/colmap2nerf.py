@@ -66,7 +66,7 @@ def parse_images_txt(path):
     """[(image_name, camera_id, qvec, tvec)] — the converter's pose
     surface; the full reader (incl. the empty-observation-line pairing
     discipline) lives in utils/colmap.read_images_txt."""
-    return _image_tuples(_cm.read_images_txt(path))
+    return _image_tuples(_cm.read_images_txt(path, skip_points2D=True))
 
 
 def parse_cameras_bin(path):
@@ -79,7 +79,7 @@ def parse_cameras_bin(path):
 
 def parse_images_bin(path):
     """[(image_name, camera_id, qvec, tvec)], from images.bin."""
-    return _image_tuples(_cm.read_images_bin(path))
+    return _image_tuples(_cm.read_images_bin(path, skip_points2D=True))
 
 
 def parse_model(model_dir):

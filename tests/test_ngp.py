@@ -82,6 +82,30 @@ def test_ngp_trains_and_carves_occupancy(setup):
     assert mse_t < mse_0 * 0.5
 
 
+def test_ngp_eval_cap_escalates_on_overflow(setup):
+    """A dense-phase grid overflowing the packed eval stream cap must NOT
+    silently truncate (understated PSNR): render_image doubles the cap,
+    recompiles, and re-renders; the raised cap persists on the trainer."""
+    from test_train import tiny_cfg
+
+    root, _, _ = setup
+    cfg = tiny_cfg(root, NGP_EXTRA + (
+        "task_arg.ngp_packed_march", "true",
+        "task_arg.ngp_packed_cap_avg", "2",
+        "task_arg.ngp_packed_cap_avg_eval", "2",
+    ))
+    net = make_network(cfg)
+    trainer = make_ngp_trainer(cfg, net)
+    state, _ = trainer.make_state(jax.random.PRNGKey(0))
+    # fresh state: fully-dense grid, cap_avg 2 ≪ samples/ray ⇒ overflow
+    tds = Dataset(data_root=root, scene="procedural", split="test",
+                  H=32, W=32)
+    b = tds.image_batch(0)
+    out = trainer.render_image(state, {"rays": b["rays"]})
+    assert np.isfinite(np.asarray(out["rgb_map_f"])).all()
+    assert trainer.packed_cap_avg_eval > 2  # escalated at least once
+
+
 def test_ngp_grid_update_is_densitydriven(setup):
     """Cells the network marks empty must decay below the threshold while
     cells over real content stay occupied (scatter-max vs decay race)."""

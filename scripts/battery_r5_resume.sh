@@ -1,10 +1,14 @@
 #!/bin/bash
-# Resume of battery_r5.sh from stage 2 after the 09:0x tunnel wedge
-# (the ngp arm blocked ~45 min on a dead in-flight remote compile; the
-# battery was killed by PID per the kill discipline in
-# docs/operations.md). Stage 1/1b results are already recorded in
-# BENCH_SWEEP_FUSED.jsonl; stage 2's std arm is already in
-# BENCH_NGP.jsonl (ts 1785573924).
+# Resume of battery_r5.sh after the ~08:50 tunnel wedge (the ngp arm
+# blocked on a dead in-flight remote compile; killed by PID per
+# docs/operations.md "Mid-run wedges"). Stage 1/1b results are in
+# BENCH_SWEEP_FUSED.jsonl; stage 2's std arm is in BENCH_NGP.jsonl
+# (ts 1785573924).
+#
+# Stage order is VERDICT-value order, re-prioritized for a possibly
+# SHORT window (the wedge ate 5+ h): the NGP A/B + quality trails +
+# eval shootout land before the long scale sweeps, so a second wedge
+# can't take the headline deliverables with it.
 #
 # Starts with the tpu_battery-style watch loop: two consecutive good
 # probes 60 s apart = a usable window.
@@ -47,12 +51,6 @@ timeout 4800 python scripts/bench_ngp.py --seconds 420 \
   --out BENCH_NGP.jsonl $NGP_OPTS \
   2>data/logs/r5_ngp_ab2.err | tail -4
 
-log "stage 3: packed refresh lever (update_every 64)"
-timeout 1800 python scripts/bench_ngp.py --seconds 420 \
-  --config lego_hash_packed.yaml --arms ngp_packed \
-  --out BENCH_NGP.jsonl $NGP_OPTS task_arg.ngp_grid_update_every 64 \
-  2>data/logs/r5_ngp_refresh.err | tail -2
-
 log "stage 3c: packed + bbox-clip + slow refresh (the combined levers)"
 timeout 1800 python scripts/bench_ngp.py --seconds 420 \
   --config lego_hash_packed.yaml --arms ngp_packed \
@@ -60,28 +58,6 @@ timeout 1800 python scripts/bench_ngp.py --seconds 420 \
   task_arg.max_march_samples 64 task_arg.scan_steps 8 \
   task_arg.march_clip_bbox true task_arg.ngp_grid_update_every 64 \
   2>data/logs/r5_ngp_clip.err | tail -2
-
-log "stage 3b: NGP-step cost analysis (validates the PERF.md roofline)"
-for MODE in "" "task_arg.ngp_packed_march true"; do
-  BENCH_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 $MODE" \
-  timeout 1800 python scripts/profile_step.py --ngp --n_rays 4096 \
-    --remat false --config lego_hash_packed.yaml --steps 20 \
-    2>data/logs/r5_ngp_profile.err | tee -a PROFILE_STEP.jsonl | tail -2
-done
-
-log "stage 4a: flagship steady-state scale rows (8k/16k/65k)"
-BENCH_TAG=steady_state BENCH_OPTS="network.nerf.scan_trunk true" \
-timeout 7200 python scripts/bench_sweep.py \
-  --rays 8192 16384 65536 --dtypes bfloat16 --remat false \
-  --scan_steps 8 --grad_accum 1 8 --steps 40 --point_timeout 2400 \
-  --out BENCH_SWEEP.jsonl 2>data/logs/r5_sweep_flagship.err | tail -8
-
-log "stage 4b: packed-hash steady-state scale rows (4k/8k/16k, accum)"
-BENCH_TAG=steady_state timeout 5400 python scripts/bench_sweep.py \
-  --rays 4096 8192 16384 --dtypes bfloat16 --remat false \
-  --scan_steps 8 --grad_accum 1 4 --steps 40 --point_timeout 1800 \
-  --config lego_hash_packed.yaml --out BENCH_SWEEP_HASH.jsonl \
-  2>data/logs/r5_sweep_hash.err | tail -8
 
 log "stage 5: NGP H=400 quality trail (decoupled eval budget, packed)"
 timeout 2700 python scripts/quality_run.py --minutes 25 --H 400 \
@@ -94,6 +70,67 @@ log "stage 6: std quality trail + eval-fps shootout (lego.yaml)"
 timeout 2100 python scripts/quality_run.py --minutes 15 --H 400 \
   --config lego.yaml --out_prefix QUALITY_R5 --tag q_std_r5 \
   2>data/logs/r5_quality_std.err | tail -8
+
+log "stage 3: packed refresh lever alone (update_every 64, no clip)"
+timeout 1800 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl $NGP_OPTS task_arg.ngp_grid_update_every 64 \
+  2>data/logs/r5_ngp_refresh.err | tail -2
+
+log "stage 3b: NGP-step cost analysis (validates the PERF.md roofline)"
+for MODE in "" "task_arg.ngp_packed_march true"; do
+  BENCH_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 $MODE" \
+  timeout 1800 python scripts/profile_step.py --ngp --n_rays 4096 \
+    --remat false --config lego_hash_packed.yaml --steps 20 \
+    2>data/logs/r5_ngp_profile.err | tee -a PROFILE_STEP.jsonl | tail -2
+done
+
+log "stage B (r5b): fused at scale (16k/scan8, 65k/scan1 — std OOMs at 65k)"
+FUSED="network.nerf.fused_trunk true network.nerf.fused_tile 512"
+for shape in "16384 8" "65536 1"; do
+  set -- $shape
+  BENCH_N_RAYS=$1 BENCH_SCAN_STEPS=$2 BENCH_OPTS="$FUSED" \
+  timeout 2400 python bench.py 2>data/logs/r5b_fused_$1.err \
+    | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
+done
+
+log "stage C (r5b): fused tile axis (256; 1024 retries the VMEM OOM w/ raised limit)"
+for t in 256 1024; do
+  BENCH_OPTS="network.nerf.fused_trunk true network.nerf.fused_tile $t" \
+  timeout 1800 python bench.py 2>data/logs/r5b_fused_t$t.err \
+    | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
+done
+python scripts/promote_bench_defaults.py BENCH_SWEEP*.jsonl \
+  --config lego.yaml || true
+
+log "stage A (r5b): fused-step XLA bytes/flops (did the traffic go away?)"
+BENCH_OPTS="$FUSED" timeout 1800 python scripts/profile_step.py \
+  --n_rays 4096 --remat false --config lego.yaml --steps 20 \
+  2>data/logs/r5b_profile_fused.err | tee -a PROFILE_STEP.jsonl | tail -2
+
+log "stage 4b: packed-hash steady-state scale rows (4k/8k/16k, accum)"
+BENCH_TAG=steady_state timeout 5400 python scripts/bench_sweep.py \
+  --rays 4096 8192 16384 --dtypes bfloat16 --remat false \
+  --scan_steps 8 --grad_accum 1 4 --steps 40 --point_timeout 1800 \
+  --config lego_hash_packed.yaml --out BENCH_SWEEP_HASH.jsonl \
+  2>data/logs/r5_sweep_hash.err | tail -8
+
+log "stage D (r5b): packed-NGP steady state at 8k/16k rays (600 s/arm)"
+for nr in 8192 16384; do
+  timeout 2400 python scripts/bench_ngp.py --seconds 600 --n_rays $nr \
+    --config lego_hash_packed.yaml --arms ngp_packed \
+    --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
+    task_arg.max_march_samples 64 task_arg.scan_steps 8 \
+    task_arg.march_clip_bbox true task_arg.ngp_grid_update_every 64 \
+    2>data/logs/r5b_ngp_$nr.err | tail -2
+done
+
+log "stage 4a: flagship steady-state scale rows (8k/16k/65k)"
+BENCH_TAG=steady_state BENCH_OPTS="network.nerf.scan_trunk true" \
+timeout 7200 python scripts/bench_sweep.py \
+  --rays 8192 16384 65536 --dtypes bfloat16 --remat false \
+  --scan_steps 8 --grad_accum 1 8 --steps 40 --point_timeout 2400 \
+  --out BENCH_SWEEP.jsonl 2>data/logs/r5_sweep_flagship.err | tail -8
 
 log "stage 7: hard-scene trail (thin fence + checker)"
 timeout 2100 python scripts/quality_run.py --minutes 15 --H 400 \

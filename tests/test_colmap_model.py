@@ -7,6 +7,7 @@ SURVEY.md §4 "vendored self-tests"); these are the wired equivalent for
 the independent implementation.
 """
 
+import json
 import os
 import sys
 
@@ -131,6 +132,47 @@ def test_quaternion_helpers_roundtrip():
         np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-12)
         np.testing.assert_allclose(np.linalg.det(R), 1.0, atol=1e-12)
         np.testing.assert_allclose(rotmat2qvec(R), q, atol=1e-12)
+
+
+def test_colmap_stats_cli(tmp_path, capsys):
+    """scripts/colmap_stats.py summarizes a model in both output modes
+    (the headless seat of the reference's visualize_model.py)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "colmap_stats",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "colmap_stats.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    model = _model()
+    d = str(tmp_path / "sparse")
+    write_model(*model, d, ext=".bin")
+
+    s = mod.model_stats(d)
+    assert s["n_cameras"] == 2 and s["n_images"] == 3
+    assert s["n_points3D"] == 2
+    assert s["track_length"]["max"] <= 3
+    assert all(
+        a <= b
+        for a, b in zip(s["points_bbox"]["min"], s["points_bbox"]["max"])
+    )
+    # triangulated-only observation count: the fixture plants -1 ids
+    n_valid = sum(
+        int(np.sum(im.point3D_ids != -1)) for im in model[1].values()
+    )
+    n_all = sum(len(im.point3D_ids) for im in model[1].values())
+    assert s["obs_per_image"]["mean"] * s["n_images"] == n_valid
+    if n_all != n_valid:  # fixture planted at least one -1
+        assert s["obs_per_image"]["mean"] * s["n_images"] < n_all
+
+    mod.main([d, "--json"])
+    out = capsys.readouterr().out
+    assert json.loads(out)["n_images"] == 3
+    mod.main([d])
+    assert "reprojection error" in capsys.readouterr().out
 
 
 def test_colmap2nerf_reads_written_models(tmp_path):

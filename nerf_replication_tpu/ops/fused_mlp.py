@@ -43,13 +43,16 @@ def _interpret() -> bool:
     return jax.devices()[0].platform not in ("tpu", "axon")
 
 
-def _mosaic_kwargs() -> dict:
+def _mosaic_kwargs(tile: int) -> dict:
     """Raise the scoped-VMEM (kernel stack) limit above Mosaic's 16 MB
-    default: the tile-1024 backward's stack is 17.4 MB (recorded OOM,
-    BENCH_SWEEP_FUSED.jsonl), comfortably inside the chip's 128 MB VMEM.
-    Bigger tiles matter because the per-tile weight stream (~2.4 MB f32)
-    is the kernel's own HBM term — grid steps halve as tiles double."""
-    if _interpret():
+    default for BIG tiles only: the tile-1024 backward's stack is
+    17.4 MB (recorded OOM, BENCH_SWEEP_FUSED.jsonl), comfortably inside
+    the chip's 128 MB VMEM. Bigger tiles matter because the per-tile
+    weight stream (~2.4 MB f32) is the kernel's own HBM term — grid
+    steps halve as tiles double. Tiles ≤512 keep the default params so
+    the chip-measured headline executable (tile 512, 48.6k rays/s) is
+    replayed byte-identically by the driver's bench."""
+    if _interpret() or tile <= 512:
         return {}
     from jax.experimental.pallas import tpu as pltpu
 
@@ -387,7 +390,7 @@ def _pallas_fwd(spec, tile, flat_ws, x, v):
         out_specs=pl.BlockSpec((tile, 8), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((m, 8), jnp.float32),
         interpret=_interpret(),
-        **_mosaic_kwargs(),
+        **_mosaic_kwargs(tile),
     )(x, v, *flat_ws)
 
 
@@ -428,7 +431,7 @@ def _fused_bwd(spec, tile, res, draw):
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=_interpret(),
-        **_mosaic_kwargs(),
+        **_mosaic_kwargs(tile),
     )(x, v, jnp.asarray(draw, jnp.float32), *flat_ws)
     dx, dv = outs[0], outs[1]
     # cotangent dtypes must match the primals: bf16-streamed weights get

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-5 follow-up C — redo of battery_r5_resume stage 3c, which hit
+# its 1800 s timeout before writing a row: the combined-lever arm
+# (bbox clip + update_every 64) overflowed the packed EVAL stream at
+# val-render time (68% -> cap 512 -> 36% -> cap 1024), and each
+# escalation recompiled the eval executable.  Fix here: preset the
+# eval cap at 1024 so the render compiles once, and give the stage the
+# budget the escalation trail actually needed.
+#
+# Run AFTER battery_r5_resume.sh finishes (monoclient tunnel).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p data/logs
+log() { echo "[batteryR5c $(date +%H:%M:%S)] $*"; }
+export BENCH_INIT_TOTAL_S=${BENCH_INIT_TOTAL_S:-420}
+
+log "stage 3c-redo: packed + bbox-clip + slow refresh, eval cap preset"
+timeout 2700 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
+  task_arg.max_march_samples 64 task_arg.scan_steps 8 \
+  task_arg.march_clip_bbox true task_arg.ngp_grid_update_every 64 \
+  task_arg.ngp_packed_cap_avg_eval 1024 \
+  2>data/logs/r5c_ngp_clip.err | tail -2
+
+log "stage 3-redo: refresh lever alone (update_every 64, no clip)"
+timeout 2700 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl task_arg.render_step_size 0.01 \
+  task_arg.max_march_samples 64 task_arg.scan_steps 8 \
+  task_arg.ngp_grid_update_every 64 \
+  task_arg.ngp_packed_cap_avg_eval 1024 \
+  2>data/logs/r5c_ngp_refresh.err | tail -2
+
+log "battery r5c done"

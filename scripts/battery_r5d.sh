@@ -1,0 +1,156 @@
+#!/bin/bash
+# Round-5 battery D — the stages battery_r5_resume.sh did not land.
+#
+# What happened to the resume battery (2026-08-01 evening): stage 2r
+# landed the packed-march A/B (41.2k carved rays/s @ 32.4 dB), then
+# stage 3c's outer `timeout` killed it mid-eval-recompile (the val
+# render was escalating the packed eval cap, one recompile per
+# doubling) — and a killed in-flight compile wedges the tunnel
+# (docs/operations.md).  The battery had its watch loop only at the
+# START, so stages 5/6/3 burned their 420 s init budgets against the
+# dead tunnel and 3b was mid-burn when killed.
+#
+# Two structural fixes here:
+#   * `gate` — the two-good-probes watch loop runs before EVERY
+#     stage, so a wedge (including one caused by our own previous
+#     stage's timeout kill) costs waiting, not stages.
+#   * every ngp_packed arm presets ngp_packed_cap_avg_eval=1024 (the
+#     value stage 3c's escalation trail ended at) so the eval render
+#     compiles exactly once.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p data/logs
+log() { echo "[batteryR5d $(date +%H:%M:%S)] $*"; }
+export BENCH_INIT_TOTAL_S=${BENCH_INIT_TOTAL_S:-420}
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform in ("tpu", "axon")
+import jax.numpy as jnp
+jnp.arange(8).sum().block_until_ready()
+EOF
+}
+
+gate() {
+  local good=0
+  log "gate: waiting for two good probes 60 s apart"
+  until [ "$good" -ge 2 ]; do
+    if probe; then
+      good=$((good + 1))
+      log "gate: probe ok ($good/2)"
+      [ "$good" -lt 2 ] && sleep 60
+    else
+      good=0
+      log "gate: probe failed; sleeping 120 s"
+      sleep 120
+    fi
+  done
+  log "gate: tunnel usable"
+}
+
+NGP_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 \
+task_arg.scan_steps 8"
+CAP="task_arg.ngp_packed_cap_avg_eval 1024"
+
+gate
+log "stage 5: NGP H=400 quality trail (decoupled eval budget, packed)"
+timeout 3600 python scripts/quality_run.py --minutes 25 --H 400 \
+  --config lego_hash_packed.yaml --out_prefix QUALITY_NGP_R5 \
+  --tag q_ngp_r5 task_arg.ngp_training true \
+  task_arg.ngp_packed_march true $NGP_OPTS $CAP \
+  2>data/logs/r5_quality_ngp.err | tail -6
+
+gate
+log "stage 6: std quality trail + eval-fps shootout (lego.yaml)"
+timeout 2700 python scripts/quality_run.py --minutes 15 --H 400 \
+  --config lego.yaml --out_prefix QUALITY_R5 --tag q_std_r5 \
+  2>data/logs/r5_quality_std.err | tail -8
+
+gate
+log "stage 3c-redo: packed + bbox-clip + slow refresh, eval cap preset"
+timeout 2700 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
+  task_arg.max_march_samples 64 task_arg.scan_steps 8 \
+  task_arg.march_clip_bbox true task_arg.ngp_grid_update_every 64 \
+  $CAP 2>data/logs/r5c_ngp_clip.err | tail -2
+
+gate
+log "stage 3b: NGP-step cost analysis (validates the PERF.md roofline)"
+for MODE in "" "task_arg.ngp_packed_march true"; do
+  BENCH_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 $MODE" \
+  timeout 2400 python scripts/profile_step.py --ngp --n_rays 4096 \
+    --remat false --config lego_hash_packed.yaml --steps 20 \
+    2>data/logs/r5_ngp_profile.err | tee -a PROFILE_STEP.jsonl | tail -2
+done
+
+gate
+log "stage B: fused at scale (16k/scan8, 65k/scan1 — std OOMs at 65k)"
+FUSED="network.nerf.fused_trunk true network.nerf.fused_tile 512"
+for shape in "16384 8" "65536 1"; do
+  set -- $shape
+  BENCH_N_RAYS=$1 BENCH_SCAN_STEPS=$2 BENCH_OPTS="$FUSED" \
+  timeout 2400 python bench.py 2>data/logs/r5b_fused_$1.err \
+    | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
+done
+
+gate
+log "stage C: fused tile axis (256; 1024 retries the VMEM OOM w/ raised limit)"
+for t in 256 1024; do
+  BENCH_OPTS="network.nerf.fused_trunk true network.nerf.fused_tile $t" \
+  timeout 1800 python bench.py 2>data/logs/r5b_fused_t$t.err \
+    | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
+done
+python scripts/promote_bench_defaults.py BENCH_SWEEP*.jsonl \
+  --config lego.yaml || true
+
+gate
+log "stage A: fused-step XLA bytes/flops (did the traffic go away?)"
+BENCH_OPTS="$FUSED" timeout 1800 python scripts/profile_step.py \
+  --n_rays 4096 --remat false --config lego.yaml --steps 20 \
+  2>data/logs/r5b_profile_fused.err | tee -a PROFILE_STEP.jsonl | tail -2
+
+gate
+log "stage D: packed-NGP steady state at 8k/16k rays (600 s/arm)"
+for nr in 8192 16384; do
+  timeout 2400 python scripts/bench_ngp.py --seconds 600 --n_rays $nr \
+    --config lego_hash_packed.yaml --arms ngp_packed \
+    --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
+    task_arg.max_march_samples 64 task_arg.scan_steps 8 \
+    task_arg.march_clip_bbox true task_arg.ngp_grid_update_every 64 \
+    $CAP 2>data/logs/r5b_ngp_$nr.err | tail -2
+done
+
+gate
+log "stage 4b: packed-hash steady-state scale rows (4k/8k/16k, accum)"
+BENCH_TAG=steady_state timeout 5400 python scripts/bench_sweep.py \
+  --rays 4096 8192 16384 --dtypes bfloat16 --remat false \
+  --scan_steps 8 --grad_accum 1 4 --steps 40 --point_timeout 1800 \
+  --config lego_hash_packed.yaml --out BENCH_SWEEP_HASH.jsonl \
+  2>data/logs/r5_sweep_hash.err | tail -8
+
+gate
+log "stage 4a: flagship steady-state scale rows (8k/16k/65k)"
+BENCH_TAG=steady_state BENCH_OPTS="network.nerf.scan_trunk true" \
+timeout 7200 python scripts/bench_sweep.py \
+  --rays 8192 16384 65536 --dtypes bfloat16 --remat false \
+  --scan_steps 8 --grad_accum 1 8 --steps 40 --point_timeout 2400 \
+  --out BENCH_SWEEP.jsonl 2>data/logs/r5_sweep_flagship.err | tail -8
+
+gate
+log "stage 7: hard-scene trail (thin fence + checker)"
+timeout 2700 python scripts/quality_run.py --minutes 15 --H 400 \
+  --scene procedural_hard --config lego_hash_packed.yaml \
+  --out_prefix QUALITY_HARD --tag q_hard_r5 \
+  task_arg.ngp_training true task_arg.ngp_packed_march true $NGP_OPTS \
+  $CAP 2>data/logs/r5_quality_hard.err | tail -6
+
+gate
+log "stage 3: packed refresh lever alone (update_every 64, no clip)"
+timeout 2700 python scripts/bench_ngp.py --seconds 420 \
+  --config lego_hash_packed.yaml --arms ngp_packed \
+  --out BENCH_NGP.jsonl $NGP_OPTS task_arg.ngp_grid_update_every 64 \
+  $CAP 2>data/logs/r5_ngp_refresh.err | tail -2
+
+log "battery r5d done"

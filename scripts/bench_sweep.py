@@ -67,6 +67,10 @@ def main(argv=None):
             BENCH_DTYPE=dtype,
             BENCH_CONFIG=args.config,
             BENCH_SCAN_STEPS=str(scan_k),
+            # sweep rows are per-point records; the driver-facing NGP
+            # companion snapshot would freeze unrelated time-varying data
+            # into every appended row
+            BENCH_NO_COMPANION="1",
         )
         if accum > 1:
             env["BENCH_GRAD_ACCUM"] = str(accum)

@@ -549,3 +549,47 @@ def test_sweep_recency_keys_on_grad_accum_and_promotes_it(tmp_path):
     assert promoted["opts"] == "network.nerf.fused_trunk true"
     assert promoted["grad_accum"] == 4
     assert promoted["measured_rays_per_sec"] == 300.0
+
+
+def test_bench_ngp_companion_picks_best_converged_arm(tmp_path):
+    """bench.py's driver JSON carries the best NGP-training row as a
+    companion metric; warm-up-only / compile-window arms (single-digit
+    PSNR) and non-ngp arms must never occupy the slot."""
+    import importlib.util
+    import json
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "benchmod",
+        _os.path.join(_os.path.dirname(__file__), "..", "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    rows = [
+        # std arm: fastest of all, but not the NGP path
+        {"arm": "std", "rays_per_sec": 99000.0, "psnr": 31.0, "ts": 1.0},
+        # compile-window junk: high-rate field would be absent anyway,
+        # but the PSNR floor is what excludes it
+        {"arm": "ngp", "rays_per_sec": 50000.0, "psnr": 9.0, "ts": 2.0},
+        {"arm": "ngp", "rays_per_sec": 20000.0, "psnr": 29.9,
+         "carved_rays_per_sec": 21916.0, "ts": 3.0},
+        {"arm": "ngp_packed", "rays_per_sec": 28759.6, "psnr": 32.4,
+         "carved_rays_per_sec": 41231.3, "ssim": 0.9868, "ts": 4.0},
+        # malformed / null rows must be skipped, not crash
+        {"arm": "ngp_packed", "rays_per_sec": None, "psnr": 40.0},
+        "not json at all",
+    ]
+    p = tmp_path / "BENCH_NGP_T.jsonl"
+    p.write_text(
+        "".join(
+            (r if isinstance(r, str) else json.dumps(r)) + "\n" for r in rows
+        )
+    )
+
+    best = bench._ngp_companion(str(p))
+    assert best["arm"] == "ngp_packed"
+    assert best["rays_per_sec"] == 28759.6
+    assert best["carved_rays_per_sec"] == 41231.3
+
+    assert bench._ngp_companion(str(tmp_path / "missing.jsonl")) is None

@@ -44,6 +44,8 @@ def _ngp_companion(path=None):
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if not isinstance(rec, dict):
+                    continue
                 if not str(rec.get("arm", "")).startswith("ngp"):
                     continue
                 rate = rec.get("rays_per_sec")
@@ -61,7 +63,9 @@ def _ngp_companion(path=None):
                         )
                         if rec.get(k) is not None
                     }
-    except OSError:
+    except Exception:
+        # never let the companion break the driver's one-line contract
+        # (it is also emitted from the failure path)
         pass
     return best
 
@@ -306,6 +310,15 @@ if __name__ == "__main__":
                     # what was tried and when, not just an opaque message
                     "init_trail": getattr(exc, "trail", None),
                     "best_known_measurement": best_known,
+                    # a wedge-null record must still carry the round's
+                    # best NGP-training number (same slot and same
+                    # sweep-subprocess gate as the success path; the
+                    # helper swallows its own errors)
+                    "ngp_training_best": (
+                        None
+                        if os.environ.get("BENCH_NO_COMPANION") == "1"
+                        else _ngp_companion()
+                    ),
                 }
             )
         )

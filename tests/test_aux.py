@@ -555,16 +555,9 @@ def test_bench_ngp_companion_picks_best_converged_arm(tmp_path):
     """bench.py's driver JSON carries the best NGP-training row as a
     companion metric; warm-up-only / compile-window arms (single-digit
     PSNR) and non-ngp arms must never occupy the slot."""
-    import importlib.util
     import json
-    import os as _os
 
-    spec = importlib.util.spec_from_file_location(
-        "benchmod",
-        _os.path.join(_os.path.dirname(__file__), "..", "bench.py"),
-    )
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    import bench
 
     rows = [
         # std arm: fastest of all, but not the NGP path
@@ -593,3 +586,14 @@ def test_bench_ngp_companion_picks_best_converged_arm(tmp_path):
     assert best["carved_rays_per_sec"] == 41231.3
 
     assert bench._ngp_companion(str(tmp_path / "missing.jsonl")) is None
+
+
+def test_bench_ngp_companion_survives_non_dict_rows(tmp_path):
+    """The companion is emitted from bench.py's FAILURE path too — a
+    malformed record file (valid JSON that isn't an object) must yield
+    None/partial, never raise."""
+    import bench
+
+    p = tmp_path / "BENCH_NGP_T.jsonl"
+    p.write_text('[1, 2, 3]\n"a string"\n42\n')
+    assert bench._ngp_companion(str(p)) is None

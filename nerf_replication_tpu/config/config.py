@@ -89,6 +89,11 @@ def default_cfg() -> ConfigNode:
                 "milestones": [80, 120, 200, 240],
                 "gamma": 0.5,
             },
+            # observability: capture a jax.profiler xplane trace around
+            # exactly [start_step, start_step + num_steps) of the hot loop
+            # (obs/profiling.ProfileWindow; dir defaults to
+            # <record_dir>/profile). start_step -1 = disabled.
+            "profile": {"start_step": -1, "num_steps": 0, "dir": ""},
         }
     )
     cfg.test = ConfigNode(

@@ -1,0 +1,39 @@
+"""Run-scoped telemetry subsystem (the observability layer PERF.md's
+hand-rolled timers grew into).
+
+Every entrypoint (train.py, run.py, the bench scripts) writes through one
+schema-versioned JSONL emitter: a single ``telemetry.jsonl`` per run dir
+with typed rows — ``run_meta`` / ``step`` / ``epoch`` / ``eval`` /
+``compile`` / ``memory`` / ``heartbeat`` — chief-guarded like ``Recorder``
+and flushed crash-safely. ``scripts/tlm_report.py`` summarizes or diffs
+runs; ``scripts/check_telemetry_schema.py`` validates any telemetry or
+bench JSONL against the versioned schema.
+
+The classic failure modes of a fully-jitted TPU hot loop are invisible
+ones — silent recompilation storms, HBM creep, host-dispatch stalls that
+only show up as a slow ``eta:`` line. The hooks here make each one a typed
+row: ``obs.hooks.CompileTracker`` counts compiles/retraces per compiled
+function, ``obs.hooks.sample_memory`` snapshots per-device
+``memory_stats()``, and the trainer's dispatch-vs-block step-time split
+distinguishes latency-bound from compute-bound regressions.
+"""
+
+from .emit import Emitter, NullEmitter, append_jsonl, get_emitter, init_run
+from .hooks import CompileTracker, sample_memory
+from .profiling import ProfileWindow, annotate
+from .schema import SCHEMA_VERSION, validate_bench_row, validate_row
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "Emitter",
+    "NullEmitter",
+    "CompileTracker",
+    "ProfileWindow",
+    "annotate",
+    "append_jsonl",
+    "get_emitter",
+    "init_run",
+    "sample_memory",
+    "validate_bench_row",
+    "validate_row",
+]

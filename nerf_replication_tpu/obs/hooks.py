@@ -1,0 +1,177 @@
+"""Instrumentation hooks: compile/retrace counting, memory sampling, and
+the host/device step-time split.
+
+These are the probes for the hot loop's three invisible failure modes:
+
+* **Recompilation storms** — :class:`CompileTracker` wraps each compiled
+  function and watches its lowering cache (``jit``'s ``_cache_size``): a
+  growing cache on a steady-state step means a retrace (a shape or dtype
+  the builder didn't pin), each one worth seconds of wall clock. Every
+  growth emits a ``compile`` row carrying the triggering call's wall time
+  next to the steady-state median, so the report can price the storm.
+* **HBM creep** — :func:`sample_memory` snapshots every local device's
+  ``memory_stats()`` (plus host RSS, which also covers backends that
+  don't implement device stats) into a ``memory`` row on the epoch
+  cadence.
+* **Host-dispatch stalls** — :func:`timed_call` splits a step's wall time
+  into dispatch (host time to enqueue) and block (device time waited at
+  the sync point), so a latency-bound regression (dispatch grows) is
+  distinguishable from a compute-bound one (block grows).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from .emit import get_emitter
+
+
+def _cache_size(fn) -> int | None:
+    """Lowering-cache size of a ``jax.jit``-returned callable (None when
+    the callable doesn't expose one — e.g. a plain python wrapper)."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+class _TrackedFn:
+    """One wrapped compiled function: counts calls and compiles."""
+
+    def __init__(self, name: str, fn, steady_window: int = 64):
+        self.name = name
+        self.fn = fn
+        self.n_calls = 0
+        self.n_compiles = 0
+        self._steady = deque(maxlen=steady_window)
+
+    def steady_p50(self) -> float | None:
+        if not self._steady:
+            return None
+        ordered = sorted(self._steady)
+        return ordered[len(ordered) // 2]
+
+    def __call__(self, *args, **kwargs):
+        before = _cache_size(self.fn)
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        wall = time.perf_counter() - t0
+        self.n_calls += 1
+        after = _cache_size(self.fn)
+        if after is not None and before is not None:
+            compiled = after > before
+        else:
+            # no cache probe: the first call is the one that compiles
+            compiled = self.n_calls == 1
+        if compiled:
+            self.n_compiles += 1
+            get_emitter().emit(
+                "compile",
+                name=self.name,
+                n_compiles=self.n_compiles,
+                wall_s=wall,
+                call_index=self.n_calls,
+                steady_p50_s=self.steady_p50(),
+            )
+        else:
+            self._steady.append(wall)
+        return out
+
+
+class CompileTracker:
+    """Registry of tracked compiled functions for one trainer/run.
+
+    ``wrap(name, fn)`` returns a drop-in callable; compile counts
+    accumulate per name even when a builder is re-invoked (scan-burst
+    variants, precrop retirement), so ``counts()`` is the run's honest
+    compile inventory.
+    """
+
+    def __init__(self):
+        self._fns: dict[str, _TrackedFn] = {}
+
+    def wrap(self, name: str, fn):
+        tracked = self._fns.get(name)
+        if tracked is None or tracked.fn is not fn:
+            tracked = _TrackedFn(name, fn)
+            prev = self._fns.get(name)
+            if prev is not None:
+                # same logical step rebuilt (fresh executable): carry the
+                # cumulative compile count forward
+                tracked.n_compiles = prev.n_compiles
+                tracked.n_calls = prev.n_calls
+            self._fns[name] = tracked
+        return tracked
+
+    def counts(self) -> dict[str, int]:
+        return {name: t.n_compiles for name, t in self._fns.items()}
+
+    def total_compiles(self) -> int:
+        return sum(t.n_compiles for t in self._fns.values())
+
+
+def timed_call(fn, *args, block: bool = False, **kwargs):
+    """``(out, dispatch_s, block_s)`` — block_s is None unless ``block``.
+
+    With ``block=False`` this adds only two clock reads to the call, so
+    the hot loop can stay asynchronous between logging points; at the
+    logging cadence the caller passes ``block=True`` and pays the one
+    sync it was about to pay anyway for host-side stats.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    dispatch_s = time.perf_counter() - t0
+    block_s = None
+    if block:
+        t1 = time.perf_counter()
+        jax.block_until_ready(out)
+        block_s = time.perf_counter() - t1
+    return out, dispatch_s, block_s
+
+
+def device_memory() -> tuple[list[dict], int | None]:
+    """``(devices, host_rss_bytes)`` snapshot for a ``memory`` row."""
+    import jax
+
+    devices = []
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            pass
+        devices.append({
+            "id": int(d.id),
+            "platform": str(d.platform),
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        })
+    rss = None
+    try:
+        import resource
+
+        # linux reports ru_maxrss in KiB
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        pass
+    return devices, rss
+
+
+def sample_memory(step: int | None = None, epoch: int | None = None) -> None:
+    """Emit one ``memory`` row (per-device stats + host RSS)."""
+    emitter = get_emitter()
+    if not emitter.chief:
+        return
+    devices, rss = device_memory()
+    fields = {"devices": devices, "host_rss_bytes": rss}
+    if step is not None:
+        fields["step"] = int(step)
+    if epoch is not None:
+        fields["epoch"] = int(epoch)
+    emitter.emit("memory", **fields)

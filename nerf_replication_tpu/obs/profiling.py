@@ -1,0 +1,88 @@
+"""Config-driven ``jax.profiler`` windows + trace annotations.
+
+``train.profile: {start_step, num_steps, dir}`` captures an xplane trace
+around exactly those steps of the hot loop — the profiler runs for a
+bounded window instead of the whole run (a full-run trace of a 200k-step
+job is unopenable). The window is ticked with the HOST step counter, so
+it composes with scan bursts: capture starts at the first burst touching
+``start_step`` and stops at the first burst boundary past
+``start_step + num_steps`` (a burst is one device dispatch — there is no
+tighter host-side seam).
+
+``annotate(name)`` is the host-side ``TraceAnnotation`` scope the
+entrypoints put around bank draw / step dispatch / grid update /
+validation so the xplane timeline is legible; inside jitted code the step
+builders use ``jax.named_scope`` (which lands in the compiled op names)
+instead.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def annotate(name: str):
+    """Named host-side region on the profiler timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class ProfileWindow:
+    """Start/stop a ``jax.profiler`` trace around a configured step span."""
+
+    def __init__(self, start_step: int = -1, num_steps: int = 0,
+                 trace_dir: str = "", chief: bool | None = None):
+        if chief is None:
+            from ..parallel.mesh import is_chief
+
+            chief = is_chief()
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self.trace_dir = trace_dir
+        self.enabled = chief and self.start_step >= 0 and self.num_steps > 0
+        self.active = False
+        self.done = False
+
+    @classmethod
+    def from_cfg(cls, cfg):
+        prof = cfg.get("train", {}).get("profile", None)
+        if not prof:
+            return cls()  # disabled
+        trace_dir = str(prof.get("dir", "")) or os.path.join(
+            str(cfg.get("record_dir", ".")), "profile"
+        )
+        return cls(
+            start_step=int(prof.get("start_step", -1)),
+            num_steps=int(prof.get("num_steps", 0)),
+            trace_dir=trace_dir,
+        )
+
+    def tick(self, host_step: int) -> None:
+        """Advance the window; call with the post-burst host step counter.
+
+        Starts capture when the NEXT dispatch would overlap the window,
+        stops once the window's last step has executed.
+        """
+        if not self.enabled or self.done:
+            return
+        import jax
+
+        if self.active and host_step >= self.start_step + self.num_steps:
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+            return
+        if not self.active and host_step >= self.start_step:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self.active = True
+
+    def stop(self) -> None:
+        """Safety stop (end of training / exception unwind)."""
+        if self.active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True

@@ -1,0 +1,178 @@
+"""Versioned row schema for telemetry and bench JSONL files.
+
+One place declares what a row of ``telemetry.jsonl`` looks like, so the
+emitter, the report CLI, and ``scripts/check_telemetry_schema.py`` can
+never drift apart (the way the hand-rolled ``BENCH_*.jsonl`` shapes did —
+three incompatible row families across ten scripts).
+
+Telemetry rows share three stamped fields:
+
+* ``v``    — schema version (``SCHEMA_VERSION``)
+* ``kind`` — one of ``ROW_KINDS``
+* ``t``    — unix seconds at emit time
+
+plus the per-kind fields declared in ``ROW_KINDS`` below. Bench rows
+(``BENCH_*.jsonl``, ``PROFILE_STEP.jsonl``, quality traces) predate the
+schema and are validated structurally by :func:`validate_bench_row`.
+"""
+
+from __future__ import annotations
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+_OPT_NUM = (int, float, type(None))
+
+# kind -> (required fields, optional fields); value = allowed types.
+# dict/list values are shallow-checked (JSON-serializable containers).
+ROW_KINDS: dict[str, tuple[dict, dict]] = {
+    "run_meta": (
+        {
+            "run_id": (str,),
+            "component": (str,),
+            "config_hash": (str,),
+            "process_index": _NUM,
+            "process_count": _NUM,
+            "device_count": _NUM,
+            "local_device_count": _NUM,
+            "platform": (str,),
+        },
+        {
+            "task": (str,),
+            "scene": (str,),
+            "exp_name": (str,),
+            "device_kind": (str,),
+            "argv": (list,),
+            "jax_version": (str,),
+        },
+    ),
+    "step": (
+        {"step": _NUM},
+        {
+            "epoch": _NUM,
+            "k": _NUM,                 # burst size the row covers
+            "step_time_s": _NUM,       # per-step wall time (window median)
+            "step_time_avg_s": _NUM,
+            "data_time_s": _NUM,
+            "dispatch_s": _NUM,        # host time to enqueue the burst
+            "block_s": _NUM,           # device time waited at the sync point
+            "lr": _NUM,
+            "max_mem_mb": _OPT_NUM,
+            "stats": (dict,),          # loss/psnr/... scalars
+        },
+    ),
+    "epoch": (
+        {"epoch": _NUM},
+        {"steps": _NUM, "wall_s": _NUM, "steps_per_sec": _NUM},
+    ),
+    "eval": (
+        {"metrics": (dict,)},
+        {"step": _NUM, "epoch": _NUM, "prefix": (str,), "n_images": _NUM,
+         "mean_net_time_s": _NUM, "fps": _NUM},
+    ),
+    "compile": (
+        {"name": (str,), "n_compiles": _NUM, "wall_s": _NUM},
+        {"call_index": _NUM, "steady_p50_s": _OPT_NUM, "step": _OPT_NUM},
+    ),
+    "memory": (
+        {"devices": (list,)},
+        {"step": _NUM, "epoch": _NUM, "host_rss_bytes": _OPT_NUM},
+    ),
+    "heartbeat": (
+        {"wall_s": _NUM},
+        {"step": _NUM, "epoch": _NUM},
+    ),
+}
+
+
+def validate_row(row) -> list[str]:
+    """Errors for one telemetry row (empty list = valid)."""
+    if not isinstance(row, dict):
+        return [f"row is {type(row).__name__}, not an object"]
+    errors = []
+    v = row.get("v")
+    if not isinstance(v, int):
+        errors.append("missing/non-int schema version field 'v'")
+    elif v > SCHEMA_VERSION:
+        errors.append(f"schema version {v} is newer than {SCHEMA_VERSION}")
+    kind = row.get("kind")
+    if kind not in ROW_KINDS:
+        return errors + [f"unknown kind {kind!r}"]
+    if not isinstance(row.get("t"), _NUM):
+        errors.append("missing/non-numeric timestamp field 't'")
+    required, optional = ROW_KINDS[kind]
+    for field, types in required.items():
+        if field not in row:
+            errors.append(f"{kind}: missing required field {field!r}")
+        elif not isinstance(row[field], types):
+            errors.append(
+                f"{kind}: field {field!r} is {type(row[field]).__name__}"
+            )
+    known = {"v", "kind", "t", *required, *optional}
+    for field, value in row.items():
+        if field not in known:
+            errors.append(f"{kind}: unknown field {field!r}")
+        elif field in optional and not isinstance(value, optional[field]):
+            errors.append(
+                f"{kind}: field {field!r} is {type(value).__name__}"
+            )
+    return errors
+
+
+# -- bench rows (pre-schema JSONL: BENCH_*.jsonl, PROFILE_STEP.jsonl) --------
+# Three row families grew across the bench scripts; each is keyed by its
+# discriminator. A row must belong to exactly one family (or be an error
+# row), so a script that drifts shape fails the checker instead of
+# producing a fourth silent family.
+
+_BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
+    # bench.py / bench_sweep.py / bench_hash_step.py headline rows
+    "metric": ("value",),
+    # bench_ngp.py A/B arm rows
+    "arm": ("rays_per_sec",),
+    # bench_hash.py / bench_primitives*.py kernel-shootout rows
+    "impl": (),
+    # profile_step.py cost-analysis / timing rows
+    "section": (),
+    "xla_flops_per_step": (),
+    "s_per_step": (),
+    # quality_run.py trace headers / samples / eval-fps rows
+    "run_start": (),
+    "t_s": ("step",),
+    "eval_fps_path": ("fps",),
+    # bench_hash_step.py / bench_primitives*.py per-stage rows
+    "stage": (),
+    # scale_check.py render-path / executable-census rows
+    "path": (),
+    "chunked_fns": (),
+}
+
+
+def bench_family(row: dict) -> str | None:
+    """The family discriminator present in ``row`` (None if no match)."""
+    for key in _BENCH_FAMILIES:
+        if key in row:
+            return key
+    return None
+
+
+def validate_bench_row(row) -> list[str]:
+    """Structural errors for one bench/quality JSONL row."""
+    if not isinstance(row, dict):
+        return [f"row is {type(row).__name__}, not an object"]
+    if not row:
+        return ["empty row"]
+    family = bench_family(row)
+    if family is None:
+        if "error" in row:  # bare failure rows are legal in every family
+            return []
+        return [
+            "row matches no known bench family (expected one of "
+            + ", ".join(sorted(_BENCH_FAMILIES)) + ", or an 'error' row)"
+        ]
+    if "error" in row:
+        return []
+    missing = [f for f in _BENCH_FAMILIES[family] if f not in row]
+    if missing:
+        return [f"family {family!r}: missing fields {missing}"]
+    return []

@@ -1,0 +1,34 @@
+"""JAX version compatibility for the sharding surface.
+
+The step/render builders target the modern spelling (``jax.shard_map``
+with ``check_vma=``, jax >= 0.6); this environment's jax 0.4.x only has
+``jax.experimental.shard_map.shard_map`` with the older ``check_rep=``
+knob. One shim resolves the import and translates the kwarg so every
+builder (parallel/step.py, parallel/sequence.py, train/ngp.py) and test
+imports ``shard_map`` from here instead of guessing the jax layout.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x/0.5.x: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma; key off
+# the actual signature, not the import location
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``shard_map`` with the modern signature on either jax line."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

@@ -22,10 +22,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..renderer.volume import render_rays
+from .compat import shard_map
 from .mesh import DATA_AXIS
 
 

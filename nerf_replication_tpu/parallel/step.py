@@ -23,8 +23,9 @@ trainer.
 from __future__ import annotations
 
 import jax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .compat import shard_map
 
 from ..datasets.sampling import sample_rays, sample_step_key
 from ..train.step_core import sampled_grad_step, scan_k_steps
@@ -65,16 +66,18 @@ def build_dp_step(
     def one_step(st, bank_rays, bank_rgbs, base_key, pool):
         # disjoint stream per (step, device-shard) — axis_index is global
         # across processes, so this is multi-controller-safe
-        key = sample_step_key(base_key, st.step)
-        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
-        k_sample, k_render = jax.random.split(key)
-        grads, stats = sampled_grad_step(
-            loss, st.params, bank_rays, bank_rgbs, n_local, near, far,
-            k_sample, k_render, index_pool=pool, grad_accum=grad_accum,
-        )
-        grads = tree_pmean(grads, DATA_AXIS)
-        stats = tree_pmean(stats, DATA_AXIS)
-        return st.apply_gradients(grads=grads), stats
+        with jax.named_scope("dp_step"):
+            key = sample_step_key(base_key, st.step)
+            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            k_sample, k_render = jax.random.split(key)
+            grads, stats = sampled_grad_step(
+                loss, st.params, bank_rays, bank_rgbs, n_local, near, far,
+                k_sample, k_render, index_pool=pool, grad_accum=grad_accum,
+            )
+            with jax.named_scope("grad_allreduce"):
+                grads = tree_pmean(grads, DATA_AXIS)
+                stats = tree_pmean(stats, DATA_AXIS)
+            return st.apply_gradients(grads=grads), stats
 
     def body(state, bank_rays, bank_rgbs, base_key, *pool):
         p = pool[0] if pool else None
@@ -126,8 +129,9 @@ def build_gspmd_step(
     # all-gather of the bank.
     def make_sampler(n):
         def _sample_local(k, bank_rays, bank_rgbs):
-            k = jax.random.fold_in(k, jax.lax.axis_index(DATA_AXIS))
-            return sample_rays(k, bank_rays, bank_rgbs, n)
+            with jax.named_scope("bank_draw"):
+                k = jax.random.fold_in(k, jax.lax.axis_index(DATA_AXIS))
+                return sample_rays(k, bank_rays, bank_rgbs, n)
 
         return shard_map(
             _sample_local,
@@ -204,10 +208,11 @@ def build_gspmd_step(
         return new_state, stats
 
     def step(state, bank_rays, bank_rgbs, base_key):
-        return scan_k_steps(
-            lambda st: one_step(st, bank_rays, bank_rgbs, base_key),
-            state, k_steps,
-        )
+        def body(st):
+            with jax.named_scope("gspmd_step"):
+                return one_step(st, bank_rays, bank_rgbs, base_key)
+
+        return scan_k_steps(body, state, k_steps)
 
     return jax.jit(step, donate_argnums=(0,))
 

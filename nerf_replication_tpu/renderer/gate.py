@@ -19,6 +19,18 @@ from __future__ import annotations
 import numpy as np
 
 
+def _annotated(render):
+    """Host-side profiler scope around every whole-image render, so eval
+    time is attributable on an xplane trace captured during validation."""
+    from ..obs import annotate
+
+    def wrapped(params, batch):
+        with annotate("render/full_image"):
+            return render(params, batch)
+
+    return wrapped
+
+
 def full_image_render_fn(cfg, network, renderer, test_ds, use_grid=False):
     """Return ``render(params, batch) -> out`` for whole test images.
 
@@ -33,8 +45,10 @@ def full_image_render_fn(cfg, network, renderer, test_ds, use_grid=False):
     )
     if not sharded:
         if use_grid:
-            return renderer.render_accelerated
-        return lambda params, batch: renderer.render_chunked(params, batch)
+            return _annotated(renderer.render_accelerated)
+        return _annotated(
+            lambda params, batch: renderer.render_chunked(params, batch)
+        )
 
     import jax.numpy as jnp
 
@@ -76,7 +90,7 @@ def full_image_render_fn(cfg, network, renderer, test_ds, use_grid=False):
             renderer.accumulate_truncated(out.pop("n_truncated"))
             return out
 
-        return render
+        return _annotated(render)
 
     # reuse the renderer's own eval options — a second from_cfg would be
     # a divergence point if Renderer ever adjusts them
@@ -90,4 +104,4 @@ def full_image_render_fn(cfg, network, renderer, test_ds, use_grid=False):
         check_bounds(batch)
         return sp(params, jnp.asarray(batch["rays"]))
 
-    return render
+    return _annotated(render)

@@ -92,9 +92,15 @@ def _bundle(state, epoch: int, recorder_state: dict | None):
     }
 
 
+def _recorder_sidecar(model_dir: str, name: str) -> str:
+    return os.path.join(model_dir, f"{name}_recorder.json")
+
+
 def save_model(model_dir: str, state, epoch: int, recorder_state=None,
                latest: bool = False) -> str:
     """Save a checkpoint bundle; prune numbered checkpoints to KEEP_EPOCHS."""
+    import json
+
     os.makedirs(model_dir, exist_ok=True)
     name = "latest" if latest else str(epoch)
     path = _abs(os.path.join(model_dir, name))
@@ -104,12 +110,26 @@ def save_model(model_dir: str, state, epoch: int, recorder_state=None,
     ckptr.save(path, _bundle(state, epoch, recorder_state))
     ckptr.wait_until_finished()
 
+    # full recorder state (incl. variable-key SmoothedValue trees, which
+    # the fixed-schema orbax bundle can't structure-match) rides in a
+    # sidecar JSON, written atomically AFTER the bundle so a crash can
+    # only leave a loadable bundle with a stale/absent sidecar
+    if recorder_state:
+        sidecar = _recorder_sidecar(model_dir, name)
+        tmp = sidecar + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(recorder_state, f)
+        os.replace(tmp, sidecar)
+
     if not latest:
         numbered = sorted(
             (int(d) for d in os.listdir(model_dir) if re.fullmatch(r"\d+", d))
         )
         for old in numbered[:-KEEP_EPOCHS]:
             shutil.rmtree(os.path.join(model_dir, str(old)), ignore_errors=True)
+            sidecar = _recorder_sidecar(model_dir, str(old))
+            if os.path.exists(sidecar):
+                os.remove(sidecar)
     return path
 
 
@@ -144,6 +164,17 @@ def load_model(model_dir: str, state, epoch: int = -1):
         step=int(restored["step"]),
     )
     recorder = {k: int(v) for k, v in restored["recorder"].items()}
+    # the sidecar carries the full recorder state (SmoothedValue
+    # totals/counts); merge it over the bundle's fixed {step, epoch}
+    sidecar = _recorder_sidecar(model_dir, os.path.basename(target))
+    if os.path.exists(sidecar):
+        import json
+
+        try:
+            with open(sidecar) as f:
+                recorder = {**recorder, **json.load(f)}
+        except (OSError, ValueError):
+            pass  # stale/torn sidecar: resume with step/epoch only
     return new_state, int(restored["epoch"]) + 1, recorder
 
 

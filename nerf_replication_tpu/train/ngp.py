@@ -60,6 +60,7 @@ import numpy as np
 from flax.training.train_state import TrainState
 
 from ..datasets.sampling import sample_rays, sample_step_key
+from ..obs import CompileTracker, ProfileWindow, init_run, sample_memory
 from ..renderer.accelerated import MarchOptions, march_rays_accelerated
 from .loss import mse, mse_to_psnr
 from .optim import make_optimizer
@@ -159,6 +160,11 @@ class NGPTrainer:
         self._trunc_warned: bool = False
         self._step_fns: dict = {}
         self._render_fns: dict = {}
+        # observability: compile/retrace counting per (k, warm) executable
+        # and the config-driven profiler window — the NGP loop's phase
+        # switches are exactly where silent recompiles hide
+        self.tracker = CompileTracker()
+        self.profile = ProfileWindow.from_cfg(cfg)
 
     # -- state ---------------------------------------------------------------
     def make_state(self, key):
@@ -224,7 +230,10 @@ class NGPTrainer:
             else:
                 key = sample_step_key(base_key, state.step, process_index)
             k_sample, k_cells, k_jitter, k_z = jax.random.split(key, 4)
-            rays, rgbs = sample_rays(k_sample, bank_rays, bank_rgbs, n_rays)
+            with jax.named_scope("bank_draw"):
+                rays, rgbs = sample_rays(
+                    k_sample, bank_rays, bank_rgbs, n_rays
+                )
 
             grid = state.grid_ema > thr  # bool [R,R,R], jit-static shape
 
@@ -321,49 +330,53 @@ class NGPTrainer:
                 stats = tree_pmean(stats, axis_name)
             new_state = state.apply_gradients(grads=grads)
 
-            ema = state.grid_ema.reshape(-1) * decay
+            with jax.named_scope("grid_update"):
+                ema = state.grid_ema.reshape(-1) * decay
 
-            # carve from what training actually SAMPLED: scatter-max the
-            # march's compacted sigmas into their cells (stop_gradient'd by
-            # the march; subsampled by a static stride to bound the
-            # ~23M rows/s scatter cost). Cells with visible matter refresh
-            # every step they are trained on — this is what lets the warm
-            # start sit just above threshold and empty space carve fast.
-            s_flat = out["sample_flat"].reshape(-1)
-            s_sigma = (out["sample_sigma"]
-                       * out["sample_valid"]).reshape(-1)
-            stride = max(1, int(np.ceil(s_flat.shape[0] / sample_cap)))
-            if stride > 1:
-                s_flat = s_flat[::stride]
-                s_sigma = s_sigma[::stride]
-            ema = ema.at[s_flat].max(s_sigma)
+                # carve from what training actually SAMPLED: scatter-max
+                # the march's compacted sigmas into their cells
+                # (stop_gradient'd by the march; subsampled by a static
+                # stride to bound the ~23M rows/s scatter cost). Cells
+                # with visible matter refresh every step they are trained
+                # on — this is what lets the warm start sit just above
+                # threshold and empty space carve fast.
+                s_flat = out["sample_flat"].reshape(-1)
+                s_sigma = (out["sample_sigma"]
+                           * out["sample_valid"]).reshape(-1)
+                stride = max(1, int(np.ceil(s_flat.shape[0] / sample_cap)))
+                if stride > 1:
+                    s_flat = s_flat[::stride]
+                    s_sigma = s_sigma[::stride]
+                ema = ema.at[s_flat].max(s_sigma)
 
-            # exploration refresh: random cells probed with the LIVE
-            # network at a jittered point (matter occluded on training rays
-            # must still be discoverable)
-            idx = jax.random.randint(
-                k_cells, (n_cells,), 0, res * res * res
-            )
-            iz = idx % res
-            iy = (idx // res) % res
-            ix = idx // (res * res)
-            cell = jnp.stack([ix, iy, iz], axis=-1).astype(jnp.float32)
-            u = jax.random.uniform(k_jitter, (n_cells, 3))
-            lo, hi = bbox[0], bbox[1]
-            pts = lo + (cell + u) / res * (hi - lo)
-            dirs = jnp.zeros((n_cells, 3), jnp.float32)
-            raw = network.apply(
-                {"params": jax.lax.stop_gradient(new_state.params)},
-                pts[:, None, :], dirs, model="fine",
-            )
-            sigma = jax.nn.relu(raw[..., 0, 3])
-            ema = ema.at[idx].max(sigma)
-            if axis_name is not None:
-                # max-merge the shards' EMA candidates (all start from the
-                # same replicated decayed base, so this is exactly the
-                # union of every shard's scatter-max updates)
-                ema = jax.lax.pmax(ema, axis_name)
-            new_state = new_state.replace(grid_ema=ema.reshape(res, res, res))
+                # exploration refresh: random cells probed with the LIVE
+                # network at a jittered point (matter occluded on training
+                # rays must still be discoverable)
+                idx = jax.random.randint(
+                    k_cells, (n_cells,), 0, res * res * res
+                )
+                iz = idx % res
+                iy = (idx // res) % res
+                ix = idx // (res * res)
+                cell = jnp.stack([ix, iy, iz], axis=-1).astype(jnp.float32)
+                u = jax.random.uniform(k_jitter, (n_cells, 3))
+                lo, hi = bbox[0], bbox[1]
+                pts = lo + (cell + u) / res * (hi - lo)
+                dirs = jnp.zeros((n_cells, 3), jnp.float32)
+                raw = network.apply(
+                    {"params": jax.lax.stop_gradient(new_state.params)},
+                    pts[:, None, :], dirs, model="fine",
+                )
+                sigma = jax.nn.relu(raw[..., 0, 3])
+                ema = ema.at[idx].max(sigma)
+                if axis_name is not None:
+                    # max-merge the shards' EMA candidates (all start from
+                    # the same replicated decayed base, so this is exactly
+                    # the union of every shard's scatter-max updates)
+                    ema = jax.lax.pmax(ema, axis_name)
+                new_state = new_state.replace(
+                    grid_ema=ema.reshape(res, res, res)
+                )
             return new_state, stats
 
         return one_step
@@ -372,8 +385,9 @@ class NGPTrainer:
         from .step_core import scan_k_steps
 
         if self.mesh is not None:
-            from jax import shard_map
             from jax.sharding import PartitionSpec as P
+
+            from ..parallel.compat import shard_map
 
             from ..parallel.mesh import DATA_AXIS
 
@@ -460,7 +474,10 @@ class NGPTrainer:
             k = min(k, self.warmup_steps - self._host_step)
         fn = self._step_fns.get((k, warm))
         if fn is None:
-            fn = self._step_fns[(k, warm)] = self._jit_step(k, warm=warm)
+            fn = self._step_fns[(k, warm)] = self.tracker.wrap(
+                f"ngp_step_k{k}_{'warm' if warm else 'march'}",
+                self._jit_step(k, warm=warm),
+            )
         self._host_step += k
         if warm:
             self._warm_steps_total += k
@@ -673,6 +690,9 @@ def fit_ngp(cfg, network=None, log=print):
     trainer = NGPTrainer(cfg, network, mesh=mesh)
     evaluator = None if cfg.get("skip_eval", False) else make_evaluator(cfg)
     recorder = make_recorder(cfg)
+    # telemetry opens AFTER the recorder (a fresh run wipes record_dir —
+    # the stream must not be orphaned by that wipe)
+    emitter = init_run(cfg, component="train_ngp")
 
     seed = int(cfg.get("seed", 0))
     key = jax.random.PRNGKey(seed)
@@ -717,52 +737,94 @@ def fit_ngp(cfg, network=None, log=print):
     eval_ep = int(cfg.get("eval_ep", 10))
     log_interval = int(cfg.get("log_interval", 20))
 
-    for epoch in range(begin_epoch, epochs):
-        recorder.epoch = epoch
-        host_step = int(state.step)
-        it = 0
-        end = time.time()
-        while it < ep_iter:
-            k = min(trainer.scan_steps, ep_iter - it)
-            state, stats = trainer.multi_step(
-                state, bank[0], bank[1], base_key, k
-            )
-            # multi_step may clamp a burst at the warmup boundary — account
-            # the steps that actually ran, or epochs undertrain silently
-            k = trainer.last_burst_steps
-            host_step += k
-            should_log = (
-                it == 0
-                or (it + k - 1) // log_interval > (it - 1) // log_interval
-                or it + k >= ep_iter
-            )
-            recorder.step = host_step
-            recorder.batch_time.update((time.time() - end) / k)
-            recorder.data_time.update(0.0)
+    t_fit_start = time.time()
+    try:
+        for epoch in range(begin_epoch, epochs):
+            recorder.epoch = epoch
+            host_step = int(state.step)
+            step_before = host_step
+            t_epoch = time.time()
+            it = 0
             end = time.time()
-            if should_log:
-                recorder.update_loss_stats(
-                    {kk: float(v) for kk, v in stats.items()}
+            while it < ep_iter:
+                trainer.profile.tick(host_step)
+                k = min(trainer.scan_steps, ep_iter - it)
+                t_dispatch = time.perf_counter()
+                state, stats = trainer.multi_step(
+                    state, bank[0], bank[1], base_key, k
                 )
-                lr = float(schedule(host_step))
-                log(recorder.console_line(
-                    epoch, min(it + k - 1, ep_iter - 1), ep_iter, lr, None
-                ))
-                recorder.record("train")
-            it += k
-        chief = is_chief()
-        saving = (epoch + 1) % save_ep == 0 or (epoch + 1) % save_latest_ep == 0
-        if saving:
-            barrier("pre_save")
-            if chief and (epoch + 1) % save_ep == 0:
-                save_model(cfg.trained_model_dir, state, epoch,
-                           recorder.state_dict(), latest=False)
-            if chief and (epoch + 1) % save_latest_ep == 0:
-                save_model(cfg.trained_model_dir, state, epoch,
-                           recorder.state_dict(), latest=True)
-            barrier("post_save")
-        if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
-            result = trainer.val(state, test_ds, evaluator, log=log)
-            if result:
-                recorder.record("val", step=epoch, stats=result)
+                dispatch_s = time.perf_counter() - t_dispatch
+                # multi_step may clamp a burst at the warmup boundary —
+                # account the steps that actually ran, or epochs
+                # undertrain silently
+                k = trainer.last_burst_steps
+                host_step += k
+                should_log = (
+                    it == 0
+                    or (it + k - 1) // log_interval > (it - 1) // log_interval
+                    or it + k >= ep_iter
+                )
+                recorder.step = host_step
+                recorder.batch_time.update((time.time() - end) / k)
+                recorder.data_time.update(0.0)
+                end = time.time()
+                if should_log:
+                    t_block = time.perf_counter()
+                    jax.block_until_ready(stats)
+                    block_s = time.perf_counter() - t_block
+                    stats_host = {kk: float(v) for kk, v in stats.items()}
+                    recorder.update_loss_stats(stats_host)
+                    lr = float(schedule(host_step))
+                    log(recorder.console_line(
+                        epoch, min(it + k - 1, ep_iter - 1), ep_iter, lr,
+                        None,
+                    ))
+                    recorder.record("train")
+                    emitter.emit(
+                        "step",
+                        step=host_step,
+                        epoch=epoch,
+                        k=k,
+                        step_time_s=recorder.batch_time.median,
+                        step_time_avg_s=recorder.batch_time.avg,
+                        data_time_s=recorder.data_time.avg,
+                        dispatch_s=dispatch_s / k,
+                        block_s=block_s / k,
+                        lr=lr,
+                        stats=stats_host,
+                    )
+                it += k
+            trainer.profile.tick(host_step)
+            wall = time.time() - t_epoch
+            emitter.emit(
+                "epoch", epoch=epoch, steps=host_step - step_before,
+                wall_s=wall,
+                steps_per_sec=(host_step - step_before) / max(wall, 1e-9),
+            )
+            sample_memory(step=host_step, epoch=epoch)
+            emitter.emit(
+                "heartbeat", wall_s=time.time() - t_fit_start,
+                step=host_step, epoch=epoch,
+            )
+            chief = is_chief()
+            saving = (
+                (epoch + 1) % save_ep == 0
+                or (epoch + 1) % save_latest_ep == 0
+            )
+            if saving:
+                barrier("pre_save")
+                if chief and (epoch + 1) % save_ep == 0:
+                    save_model(cfg.trained_model_dir, state, epoch,
+                               recorder.state_dict(), latest=False)
+                if chief and (epoch + 1) % save_latest_ep == 0:
+                    save_model(cfg.trained_model_dir, state, epoch,
+                               recorder.state_dict(), latest=True)
+                barrier("post_save")
+            if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
+                result = trainer.val(state, test_ds, evaluator, log=log)
+                if result:
+                    recorder.record("val", step=epoch, stats=result)
+    finally:
+        trainer.profile.stop()
+        emitter.close()
     return state

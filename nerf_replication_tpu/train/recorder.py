@@ -49,6 +49,24 @@ class SmoothedValue:
     def __str__(self):
         return f"{self.median:.4f} ({self.global_avg:.4f})"
 
+    # -- checkpointable state -----------------------------------------------
+    # total/count feed global_avg, which drives the eta: column — without
+    # them a resumed run's eta restarts from zero (reference bug preserved
+    # until PR 1; see Recorder.state_dict).
+    def state_dict(self) -> dict:
+        return {
+            "total": self.total,
+            "count": self.count,
+            "window": [float(v) for v in self.deque],
+        }
+
+    def load_state_dict(self, state: dict):
+        self.total = float(state.get("total", 0.0))
+        self.count = int(state.get("count", 0))
+        self.deque.clear()
+        for v in state.get("window", []):
+            self.deque.append(float(v))
+
 
 class Recorder:
     def __init__(self, cfg, window_size: int = 20):
@@ -102,14 +120,52 @@ class Recorder:
                 if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):
                     arr = np.transpose(arr, (2, 0, 1))
                 self.writer.add_image(pattern.format(k), arr, step)
+        # telemetry: non-train records are eval-cadence metric summaries
+        # (val/ngp val/test) — one typed row each; train-cadence rows are
+        # emitted by the trainer's epoch loop with timing detail the
+        # recorder doesn't have. TensorBoard/console output above is
+        # byte-identical with or without an active emitter.
+        if stats is not None and prefix != "train":
+            from ..obs import get_emitter
+
+            get_emitter().emit(
+                "eval",
+                prefix=prefix,
+                step=int(step),
+                metrics={
+                    k: float(v.median if isinstance(v, SmoothedValue) else v)
+                    for k, v in stats.items()
+                },
+            )
 
     # -- checkpointable state (recorder.py:109-119) -------------------------
     def state_dict(self) -> dict:
-        return {"step": self.step, "epoch": self.epoch}
+        # "smoothed" also persists the SmoothedValue totals/counts so a
+        # resumed run's eta: and global averages continue instead of
+        # resetting to zero (checkpoint.py stores it in a sidecar JSON —
+        # the orbax bundle keeps its fixed {step, epoch} schema)
+        return {
+            "step": self.step,
+            "epoch": self.epoch,
+            "smoothed": {
+                "batch_time": self.batch_time.state_dict(),
+                "data_time": self.data_time.state_dict(),
+                "loss_stats": {
+                    k: sv.state_dict() for k, sv in self.loss_stats.items()
+                },
+            },
+        }
 
     def load_state_dict(self, state: dict):
         self.step = int(state.get("step", 0))
         self.epoch = int(state.get("epoch", 0))
+        smoothed = state.get("smoothed") or {}
+        if "batch_time" in smoothed:
+            self.batch_time.load_state_dict(smoothed["batch_time"])
+        if "data_time" in smoothed:
+            self.data_time.load_state_dict(smoothed["data_time"])
+        for k, sv_state in (smoothed.get("loss_stats") or {}).items():
+            self.loss_stats[k].load_state_dict(sv_state)
 
     # -- console ------------------------------------------------------------
     def console_line(self, epoch: int, it: int, max_iter: int, lr: float,

@@ -108,9 +108,13 @@ def fix_accum_psnr(stats: dict) -> dict:
 
 def _one_grad(loss, params, bank_rays, bank_rgbs, n_rays, near, far,
               k_sample, k_render, index_pool):
-    rays, rgbs = sample_rays(
-        k_sample, bank_rays, bank_rgbs, n_rays, index_pool=index_pool
-    )
+    # named scopes land in the compiled op names, so the xplane trace a
+    # profiler window captures (obs/profiling.py) attributes device time
+    # to the bank draw vs the render+grad sweep
+    with jax.named_scope("bank_draw"):
+        rays, rgbs = sample_rays(
+            k_sample, bank_rays, bank_rgbs, n_rays, index_pool=index_pool
+        )
 
     def loss_fn(p):
         _, l, stats = loss(
@@ -121,5 +125,6 @@ def _one_grad(loss, params, bank_rays, bank_rgbs, n_rays, near, far,
         )
         return l, stats
 
-    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    with jax.named_scope("render_grad"):
+        (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
     return grads, stats

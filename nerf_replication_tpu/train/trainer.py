@@ -28,6 +28,14 @@ import numpy as np
 from flax.training.train_state import TrainState
 
 from ..datasets.sampling import sample_step_key
+from ..obs import (
+    CompileTracker,
+    ProfileWindow,
+    annotate,
+    get_emitter,
+    init_run,
+    sample_memory,
+)
 from .checkpoint import load_model, load_pretrain, save_model, save_trained_config
 from .step_core import sampled_grad_step, scan_k_steps
 from .optim import make_optimizer
@@ -87,6 +95,11 @@ class Trainer:
         self._step_fn_pool = None
         self._multi_step_fns: dict[int, object] = {}
         self._val_render = None
+        # observability: compile/retrace counting on every built step fn
+        # and the config-driven profiler window (train.profile) — both
+        # no-ops unless a run emitter / profile config is active
+        self.tracker = CompileTracker()
+        self.profile = ProfileWindow.from_cfg(cfg)
 
     def epoch_iters(self, bank_size: int) -> int:
         """Steps per epoch. ep_iter=-1 (the reference's 'no resampling'
@@ -187,19 +200,25 @@ class Trainer:
             return self.step(state, bank_rays, bank_rgbs, base_key)
         fn = self._multi_step_fns.get(k)
         if fn is None:
-            fn = self._multi_step_fns[k] = self._build_multi_step(k)
+            fn = self._multi_step_fns[k] = self.tracker.wrap(
+                f"train_step_k{k}", self._build_multi_step(k)
+            )
         return fn(state, bank_rays, bank_rgbs, base_key)
 
     def step(self, state, bank_rays, bank_rgbs, base_key, index_pool=None):
         """One optimization step; dispatches to the precrop or full variant."""
         if index_pool is not None:
             if self._step_fn_pool is None:
-                self._step_fn_pool = self._build_step(with_pool=True)
+                self._step_fn_pool = self.tracker.wrap(
+                    "train_step_pool", self._build_step(with_pool=True)
+                )
             return self._step_fn_pool(
                 state, bank_rays, bank_rgbs, base_key, index_pool
             )
         if self._step_fn is None:
-            self._step_fn = self._build_step(with_pool=False)
+            self._step_fn = self.tracker.wrap(
+                "train_step", self._build_step(with_pool=False)
+            )
         return self._step_fn(state, bank_rays, bank_rgbs, base_key)
 
     # -- epoch loops ---------------------------------------------------------
@@ -211,27 +230,35 @@ class Trainer:
         max_iter = self.epoch_iters(int(bank_rays.shape[0]))
         end = time.time()
         log_interval = int(self.cfg.get("log_interval", 20))
+        emitter = get_emitter()
         stats = None
         # track the step on the host: int(state.step) would block on the
         # in-flight device step and serialize async dispatch
         host_step = int(state.step)
         it = 0
         while it < max_iter:
+            # the profiler window opens BEFORE the burst that first
+            # overlaps it, so the windowed steps' dispatches are on-trace
+            self.profile.tick(host_step)
             data_time = time.time() - end
             use_pool = pool is not None and host_step < self.precrop_iters
-            if use_pool or self.scan_steps <= 1:
-                k = 1
-                state, stats = self.step(
-                    state, bank_rays, bank_rgbs, base_key,
-                    index_pool=pool if use_pool else None,
-                )
-            else:
-                # burst of K steps in one dispatch; clamp at the epoch end
-                # (the clamped tail compiles one extra small executable)
-                k = min(self.scan_steps, max_iter - it)
-                state, stats = self.multi_step(
-                    state, bank_rays, bank_rgbs, base_key, k
-                )
+            t_dispatch = time.perf_counter()
+            with annotate("train/step_dispatch"):
+                if use_pool or self.scan_steps <= 1:
+                    k = 1
+                    state, stats = self.step(
+                        state, bank_rays, bank_rgbs, base_key,
+                        index_pool=pool if use_pool else None,
+                    )
+                else:
+                    # burst of K steps in one dispatch; clamp at the epoch
+                    # end (the clamped tail compiles one extra small
+                    # executable)
+                    k = min(self.scan_steps, max_iter - it)
+                    state, stats = self.multi_step(
+                        state, bank_rays, bank_rgbs, base_key, k
+                    )
+            dispatch_s = time.perf_counter() - t_dispatch
             host_step += k
             # log when a burst crosses a log_interval boundary (k=1 ⇒ the
             # reference cadence, trainer.py:79)
@@ -240,8 +267,14 @@ class Trainer:
                 or (it + k - 1) // log_interval > (it - 1) // log_interval
                 or it + k >= max_iter
             )
+            block_s = None
             if should_log:
-                # host sync only at the logging cadence
+                # host sync only at the logging cadence — timed, so the
+                # step row splits host dispatch cost from device wait
+                # (latency-bound vs compute-bound regressions)
+                t_block = time.perf_counter()
+                jax.block_until_ready(stats)
+                block_s = time.perf_counter() - t_block
                 stats_host = {kk: float(v) for kk, v in stats.items()}
                 recorder.update_loss_stats(stats_host)
             recorder.step = host_step
@@ -257,7 +290,22 @@ class Trainer:
                     epoch, min(it + k - 1, max_iter - 1), max_iter, lr, mem
                 ))
                 recorder.record("train")
+                emitter.emit(
+                    "step",
+                    step=host_step,
+                    epoch=epoch,
+                    k=k,
+                    step_time_s=recorder.batch_time.median,
+                    step_time_avg_s=recorder.batch_time.avg,
+                    data_time_s=recorder.data_time.avg,
+                    dispatch_s=dispatch_s / k,
+                    block_s=block_s / k,
+                    lr=lr,
+                    max_mem_mb=mem,
+                    stats=stats_host,
+                )
             it += k
+        self.profile.tick(host_step)
         return state, stats
 
     def val(self, state, epoch: int, test_dataset, recorder: Recorder | None = None,
@@ -284,19 +332,20 @@ class Trainer:
         n = len(test_dataset)
         if max_images is not None:
             n = min(n, max_images)
-        for i in range(n):
-            batch = test_dataset.image_batch(i)
-            out = self._val_render[1](
-                params,
-                {
-                    "rays": jnp.asarray(batch["rays"]),
-                    "near": batch["near"],
-                    "far": batch["far"],
-                },
-            )
-            out = {k: np.asarray(v) for k, v in out.items()}
-            if self.evaluator is not None:
-                self.evaluator.evaluate(out, batch)
+        with annotate("train/validation"):
+            for i in range(n):
+                batch = test_dataset.image_batch(i)
+                out = self._val_render[1](
+                    params,
+                    {
+                        "rays": jnp.asarray(batch["rays"]),
+                        "near": batch["near"],
+                        "far": batch["far"],
+                    },
+                )
+                out = {k: np.asarray(v) for k, v in out.items()}
+                if self.evaluator is not None:
+                    self.evaluator.evaluate(out, batch)
         result = {}
         if self.evaluator is not None:
             result = self.evaluator.summarize()
@@ -376,6 +425,9 @@ def fit(cfg, network=None, log=print):
 
     trainer = Trainer(cfg, network, loss, evaluator, mesh=mesh)
     recorder = make_recorder(cfg)
+    # telemetry opens AFTER the recorder (a fresh run wipes record_dir —
+    # the stream must not be orphaned by that wipe)
+    emitter = init_run(cfg, component="train")
 
     seed = int(cfg.get("seed", 0))
     key = jax.random.PRNGKey(seed)
@@ -433,30 +485,55 @@ def fit(cfg, network=None, log=print):
     save_latest_ep = int(cfg.get("save_latest_ep", 10))
     eval_ep = int(cfg.get("eval_ep", 10))
 
-    for epoch in range(begin_epoch, epochs):
-        recorder.epoch = epoch
-        state, _ = trainer.train_epoch(
-            state, epoch, bank, base_key, recorder, schedule, index_pool=pool,
-            log=log,
-        )
-        chief = is_chief()
-        saving = (epoch + 1) % save_ep == 0 or (epoch + 1) % save_latest_ep == 0
-        if saving:
-            # bracket chief-only saves with barriers so a non-chief process
-            # (or a shared-FS reader resuming from `latest`) can never
-            # observe a half-written bundle
-            barrier("pre_save")
-            if chief and (epoch + 1) % save_ep == 0:
-                save_model(cfg.trained_model_dir, state, epoch,
-                           recorder.state_dict(), latest=False)
-            if chief and (epoch + 1) % save_latest_ep == 0:
-                save_model(cfg.trained_model_dir, state, epoch,
-                           recorder.state_dict(), latest=True)
-            barrier("post_save")
-        # chief-only: validation renders/writes artifacts on one process
-        # (the reference runs val on rank 0 only, train.py:84-85)
-        if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
-            trainer.val(state, epoch, test_ds, recorder, log=log)
+    t_fit_start = time.time()
+    try:
+        for epoch in range(begin_epoch, epochs):
+            recorder.epoch = epoch
+            t_epoch = time.time()
+            step_before = int(state.step)
+            state, _ = trainer.train_epoch(
+                state, epoch, bank, base_key, recorder, schedule,
+                index_pool=pool, log=log,
+            )
+            # epoch cadence telemetry: throughput + HBM creep + liveness
+            step_after = int(state.step)
+            wall = time.time() - t_epoch
+            emitter.emit(
+                "epoch", epoch=epoch, steps=step_after - step_before,
+                wall_s=wall,
+                steps_per_sec=(step_after - step_before) / max(wall, 1e-9),
+            )
+            sample_memory(step=step_after, epoch=epoch)
+            emitter.emit(
+                "heartbeat", wall_s=time.time() - t_fit_start,
+                step=step_after, epoch=epoch,
+            )
+            chief = is_chief()
+            saving = (
+                (epoch + 1) % save_ep == 0
+                or (epoch + 1) % save_latest_ep == 0
+            )
+            if saving:
+                # bracket chief-only saves with barriers so a non-chief
+                # process (or a shared-FS reader resuming from `latest`)
+                # can never observe a half-written bundle
+                barrier("pre_save")
+                if chief and (epoch + 1) % save_ep == 0:
+                    save_model(cfg.trained_model_dir, state, epoch,
+                               recorder.state_dict(), latest=False)
+                if chief and (epoch + 1) % save_latest_ep == 0:
+                    save_model(cfg.trained_model_dir, state, epoch,
+                               recorder.state_dict(), latest=True)
+                barrier("post_save")
+            # chief-only: validation renders/writes artifacts on one process
+            # (the reference runs val on rank 0 only, train.py:84-85)
+            if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
+                trainer.val(state, epoch, test_ds, recorder, log=log)
+    finally:
+        # a window still open at exit (crash mid-capture) must be closed
+        # or the xplane file is unreadable
+        trainer.profile.stop()
+        emitter.close()
     return state
 
 

@@ -81,6 +81,18 @@ def run_network(cfg, args=None):
     print(
         f"mean net_time: {np.mean(times):.4f}s  fps: {1.0 / np.mean(times):.3f}"
     )
+    from nerf_replication_tpu.obs import init_run
+
+    emitter = init_run(cfg, component="eval_network")
+    emitter.emit(
+        "eval",
+        prefix="network",
+        metrics={},
+        n_images=len(net_times),
+        mean_net_time_s=float(np.mean(times)),
+        fps=float(1.0 / np.mean(times)),
+    )
+    emitter.close()
 
 
 def run_evaluate(cfg, args=None):
@@ -124,6 +136,20 @@ def run_evaluate(cfg, args=None):
         f"mean net_time: {np.mean(times):.4f}s  fps: {1.0 / np.mean(times):.3f}"
     )
     print(result)
+    # telemetry: the eval CLI emits a typed row instead of only printing,
+    # so quality regressions are diffable by tlm_report like step-time ones
+    from nerf_replication_tpu.obs import init_run
+
+    emitter = init_run(cfg, component="evaluate")
+    emitter.emit(
+        "eval",
+        prefix="evaluate",
+        metrics={k: float(v) for k, v in (result or {}).items()},
+        n_images=len(net_times),
+        mean_net_time_s=float(np.mean(times)),
+        fps=float(1.0 / np.mean(times)),
+    )
+    emitter.close()
     return result
 
 

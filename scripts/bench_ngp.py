@@ -83,7 +83,10 @@ def main(argv=None):
             ],
         )
 
-    out_f = open(args.out, "a")
+    # rows go through the telemetry append helper (crash-safe single-line
+    # writes) and are shape-checked against the bench schema before they
+    # land — a drifted row fails the bench loudly, not the report later
+    from nerf_replication_tpu.obs import append_jsonl, validate_bench_row
 
     for arm in args.arms:
         if arm == "ngp":
@@ -192,10 +195,11 @@ def main(argv=None):
                     rec["carved_rays_per_sec"] = round(
                         (steps - s_sw) * args.n_rays / (dt - t_sw), 1
                     )
+        errors = validate_bench_row(rec)
+        if errors:
+            raise SystemExit(f"bench row failed schema check: {errors}")
         print(json.dumps(rec), flush=True)
-        out_f.write(json.dumps(rec) + "\n")
-        out_f.flush()
-    out_f.close()
+        append_jsonl(args.out, rec)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,98 @@
+"""Validate telemetry/bench JSONL files against the versioned schema.
+
+    python scripts/check_telemetry_schema.py data/record/**/telemetry.jsonl
+    python scripts/check_telemetry_schema.py BENCH_*.jsonl PROFILE_STEP.jsonl
+    python scripts/check_telemetry_schema.py          # repo-default file set
+
+Files named ``telemetry*.jsonl`` are checked row-by-row against the typed
+telemetry schema (``obs/schema.py:ROW_KINDS``); every other JSONL is
+checked structurally against the known bench row families — so a bench
+script that drifts shape (the pre-PR-1 failure mode: three incompatible
+row families grew across ten scripts) fails here instead of silently
+producing a fourth. Exit code is nonzero on any invalid row; host-only
+(no JAX import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from nerf_replication_tpu.obs.schema import (  # noqa: E402
+    validate_bench_row,
+    validate_row,
+)
+
+
+def check_file(path: str, max_report: int = 5) -> list[str]:
+    """Errors for one file (truncated to ``max_report`` rows' worth)."""
+    telemetry = os.path.basename(path).startswith("telemetry")
+    validate = validate_row if telemetry else validate_bench_row
+    errors: list[str] = []
+    bad_rows = 0
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                bad_rows += 1
+                if bad_rows <= max_report:
+                    errors.append(f"{path}:{i}: unparseable JSON")
+                continue
+            row_errors = validate(row)
+            if row_errors:
+                bad_rows += 1
+                if bad_rows <= max_report:
+                    errors.extend(
+                        f"{path}:{i}: {e}" for e in row_errors
+                    )
+    if bad_rows > max_report:
+        errors.append(f"{path}: ... and {bad_rows - max_report} more bad rows")
+    return errors
+
+
+def default_paths() -> list[str]:
+    """The repo's committed JSONL measurement trails."""
+    pats = ("BENCH_*.jsonl", "PROFILE_STEP.jsonl", "QUALITY*.jsonl",
+            "SCALE_CHECK.jsonl")
+    paths: list[str] = []
+    for pat in pats:
+        paths.extend(sorted(glob.glob(os.path.join(_REPO, pat))))
+    return paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="JSONL schema checker")
+    p.add_argument("paths", nargs="*",
+                   help="jsonl files (default: the repo's bench trails)")
+    args = p.parse_args(argv)
+    paths = args.paths or default_paths()
+    if not paths:
+        print("no files to check")
+        return 0
+    failed = 0
+    for path in paths:
+        errors = check_file(path)
+        if errors:
+            failed += 1
+            for e in errors:
+                print(e)
+        else:
+            print(f"{path}: ok")
+    if failed:
+        print(f"{failed}/{len(paths)} files failed schema validation")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

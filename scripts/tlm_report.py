@@ -1,0 +1,260 @@
+"""Run-report CLI over telemetry.jsonl: summarize one run, diff two.
+
+    python scripts/tlm_report.py <run_dir | telemetry.jsonl>
+    python scripts/tlm_report.py <run_a> --diff <run_b> [--gate 10]
+    python scripts/tlm_report.py <run_dir> --json
+
+Summary: p50/p95/max per-step time, steps/s, compile count (+ total
+compile seconds), peak device memory / host RSS, final PSNR. ``--diff``
+compares run A (baseline) against run B (candidate) and flags
+regressions past ``--gate`` percent (step-time p50, peak memory) or any
+compile-count increase / PSNR drop > 0.1 dB; with ``--gate`` the exit
+code is nonzero when a regression is flagged, so a bench battery can use
+it as its gate against a saved baseline run (e.g. the run behind
+``BASELINE.json``).
+
+A file holds every run ever appended to it (one ``run_meta`` row each);
+the summary covers the LAST run unless ``--all-runs`` is given. Purely
+host-side — no JAX import, safe to run anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def resolve_path(run: str) -> str:
+    """Accept a run dir (containing telemetry.jsonl) or a jsonl path."""
+    if os.path.isdir(run):
+        path = os.path.join(run, "telemetry.jsonl")
+        if not os.path.exists(path):
+            raise SystemExit(f"no telemetry.jsonl under {run}")
+        return path
+    if not os.path.exists(run):
+        raise SystemExit(f"no such run dir or file: {run}")
+    return run
+
+
+def load_rows(path: str) -> list[dict]:
+    rows = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                # a torn final line (crash mid-write) is expected; a torn
+                # middle line is worth a warning but not a hard failure
+                print(f"warning: {path}:{i}: unparseable row (skipped)",
+                      file=sys.stderr)
+    return rows
+
+
+def last_run(rows: list[dict]) -> list[dict]:
+    """Rows of the last run segment (from the final run_meta on)."""
+    start = 0
+    for i, row in enumerate(rows):
+        if row.get("kind") == "run_meta":
+            start = i
+    return rows[start:]
+
+
+def _percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (no numpy dependency on purpose)."""
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[idx]
+
+
+def summarize(rows: list[dict]) -> dict:
+    """The report's headline numbers for one run's rows."""
+    meta = next((r for r in rows if r.get("kind") == "run_meta"), {})
+    steps = [r for r in rows if r.get("kind") == "step"]
+    compiles = [r for r in rows if r.get("kind") == "compile"]
+    memories = [r for r in rows if r.get("kind") == "memory"]
+    evals = [r for r in rows if r.get("kind") == "eval"]
+    epochs = [r for r in rows if r.get("kind") == "epoch"]
+
+    step_times = [r["step_time_s"] for r in steps if "step_time_s" in r]
+    summary = {
+        "run_id": meta.get("run_id", ""),
+        "config_hash": meta.get("config_hash", ""),
+        "platform": meta.get("platform", ""),
+        "n_step_rows": len(steps),
+        "last_step": max((int(r["step"]) for r in steps), default=0),
+        "step_time_p50_s": _percentile(step_times, 50) if step_times else None,
+        "step_time_p95_s": _percentile(step_times, 95) if step_times else None,
+        "step_time_max_s": max(step_times) if step_times else None,
+        "compile_count": (
+            # per-name cumulative counters: the last row of each name is
+            # its final count
+            sum({r["name"]: int(r["n_compiles"]) for r in compiles}.values())
+            if compiles else 0
+        ),
+        "compile_wall_s": sum(float(r.get("wall_s", 0.0)) for r in compiles),
+        "peak_device_bytes": None,
+        "peak_host_rss_bytes": None,
+        "final_psnr": None,
+    }
+    if epochs:
+        rates = [float(r["steps_per_sec"]) for r in epochs
+                 if "steps_per_sec" in r]
+        summary["steps_per_sec"] = _percentile(rates, 50) if rates else None
+    elif step_times:
+        summary["steps_per_sec"] = 1.0 / max(_percentile(step_times, 50), 1e-9)
+    else:
+        summary["steps_per_sec"] = None
+
+    device_peaks = [
+        d.get("peak_bytes_in_use")
+        for r in memories for d in r.get("devices", [])
+        if d.get("peak_bytes_in_use") is not None
+    ]
+    if device_peaks:
+        summary["peak_device_bytes"] = max(device_peaks)
+    rss = [r.get("host_rss_bytes") for r in memories
+           if r.get("host_rss_bytes") is not None]
+    if rss:
+        summary["peak_host_rss_bytes"] = max(rss)
+
+    for r in reversed(evals):
+        psnr = (r.get("metrics") or {}).get("psnr")
+        if psnr is not None:
+            summary["final_psnr"] = float(psnr)
+            break
+    # dispatch/block split (medians): is the loop latency- or
+    # compute-bound?
+    dispatch = [r["dispatch_s"] for r in steps if r.get("dispatch_s") is not None]
+    block = [r["block_s"] for r in steps if r.get("block_s") is not None]
+    summary["dispatch_p50_s"] = _percentile(dispatch, 50) if dispatch else None
+    summary["block_p50_s"] = _percentile(block, 50) if block else None
+    return summary
+
+
+def _fmt_s(v) -> str:
+    return "n/a" if v is None else f"{v * 1e3:.2f} ms"
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "n/a"
+    return f"{v / 2**20:.1f} MiB"
+
+
+def print_summary(summary: dict, label: str = "") -> None:
+    head = f"run {summary['run_id']}" + (f" ({label})" if label else "")
+    print(head)
+    print(f"  config_hash:   {summary['config_hash']}  "
+          f"platform: {summary['platform']}")
+    print(f"  steps:         {summary['last_step']} "
+          f"({summary['n_step_rows']} step rows)")
+    print(f"  step time:     p50 {_fmt_s(summary['step_time_p50_s'])}  "
+          f"p95 {_fmt_s(summary['step_time_p95_s'])}  "
+          f"max {_fmt_s(summary['step_time_max_s'])}")
+    sps = summary.get("steps_per_sec")
+    print(f"  steps/s:       {sps:.2f}" if sps is not None
+          else "  steps/s:       n/a")
+    print(f"  dispatch/block p50: {_fmt_s(summary['dispatch_p50_s'])} / "
+          f"{_fmt_s(summary['block_p50_s'])}")
+    print(f"  compiles:      {summary['compile_count']} "
+          f"({summary['compile_wall_s']:.2f}s wall)")
+    print(f"  peak memory:   device {_fmt_bytes(summary['peak_device_bytes'])}"
+          f"  host rss {_fmt_bytes(summary['peak_host_rss_bytes'])}")
+    psnr = summary["final_psnr"]
+    print(f"  final psnr:    {psnr:.3f}" if psnr is not None
+          else "  final psnr:    n/a")
+
+
+def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
+    """Regression flags: candidate vs baseline summaries."""
+    flags = []
+
+    def pct(a, b):
+        return (b - a) / a * 100.0 if a else float("inf")
+
+    a, b = base.get("step_time_p50_s"), cand.get("step_time_p50_s")
+    if a is not None and b is not None and pct(a, b) > gate_pct:
+        flags.append(
+            f"step time p50 regressed {pct(a, b):+.1f}% "
+            f"({_fmt_s(a)} -> {_fmt_s(b)})"
+        )
+    a, b = base.get("compile_count"), cand.get("compile_count")
+    if a is not None and b is not None and b > a:
+        flags.append(f"compile count grew {a} -> {b} (retrace storm?)")
+    a, b = base.get("peak_device_bytes"), cand.get("peak_device_bytes")
+    if a and b and pct(a, b) > gate_pct:
+        flags.append(
+            f"peak device memory grew {pct(a, b):+.1f}% "
+            f"({_fmt_bytes(a)} -> {_fmt_bytes(b)})"
+        )
+    a, b = base.get("final_psnr"), cand.get("final_psnr")
+    if a is not None and b is not None and b < a - 0.1:
+        flags.append(f"final psnr dropped {a:.3f} -> {b:.3f}")
+    return flags
+
+
+def report(run: str, diff_run: str | None = None, gate: float | None = None,
+           as_json: bool = False, all_runs: bool = False) -> int:
+    rows = load_rows(resolve_path(run))
+    if not all_runs:
+        rows = last_run(rows)
+    summary = summarize(rows)
+    if diff_run is None:
+        if as_json:
+            print(json.dumps(summary, indent=2))
+        else:
+            print_summary(summary)
+        return 0
+
+    rows_b = load_rows(resolve_path(diff_run))
+    if not all_runs:
+        rows_b = last_run(rows_b)
+    summary_b = summarize(rows_b)
+    gate_pct = gate if gate is not None else 10.0
+    flags = diff(summary, summary_b, gate_pct)
+    if as_json:
+        print(json.dumps(
+            {"baseline": summary, "candidate": summary_b, "flags": flags},
+            indent=2,
+        ))
+    else:
+        print_summary(summary, label="baseline")
+        print()
+        print_summary(summary_b, label="candidate")
+        print()
+        if flags:
+            for f in flags:
+                print(f"REGRESSION: {f}")
+        else:
+            print(f"no regressions past {gate_pct:.0f}% gate")
+    return 1 if flags and gate is not None else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="summarize/diff telemetry.jsonl runs"
+    )
+    p.add_argument("run", help="run dir or telemetry.jsonl path")
+    p.add_argument("--diff", default=None, metavar="RUN_B",
+                   help="second run to compare (candidate vs baseline)")
+    p.add_argument("--gate", type=float, default=None, metavar="PCT",
+                   help="regression threshold %%; exit 1 on a flagged "
+                        "regression (default report-only at 10%%)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--all-runs", action="store_true",
+                   help="summarize every appended run, not just the last")
+    args = p.parse_args(argv)
+    return report(args.run, diff_run=args.diff, gate=args.gate,
+                  as_json=args.as_json, all_runs=args.all_runs)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

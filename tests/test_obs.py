@@ -1,0 +1,352 @@
+"""Telemetry subsystem tests: emitter row schema round-trip, chief guard,
+compile/retrace counting, the report CLI's summary/diff math, the JSONL
+schema checker, and the end-to-end fit() acceptance slice (a CPU smoke
+train run must produce run_meta / step / compile / memory rows)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from nerf_replication_tpu.obs import (  # noqa: E402
+    SCHEMA_VERSION,
+    CompileTracker,
+    Emitter,
+    append_jsonl,
+    validate_bench_row,
+    validate_row,
+)
+from nerf_replication_tpu.obs.emit import config_hash  # noqa: E402
+
+
+def _load_script(name):
+    path = os.path.join(_REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_rows(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- emitter ----------------------------------------------------------------
+
+def test_emitter_row_schema_roundtrip(tmp_path):
+    """Every row kind the emitter can produce round-trips through JSON and
+    validates against the schema."""
+    path = str(tmp_path / "telemetry.jsonl")
+    with Emitter(path, chief=True) as em:
+        em.emit("run_meta", run_id=em.run_id, component="test",
+                config_hash="abc123", process_index=0, process_count=1,
+                device_count=8, local_device_count=8, platform="cpu",
+                argv=["test"], jax_version=jax.__version__)
+        em.emit("step", step=10, epoch=0, k=1, step_time_s=0.01,
+                step_time_avg_s=0.011, data_time_s=0.001, dispatch_s=0.002,
+                block_s=0.008, lr=5e-4, max_mem_mb=None,
+                stats={"loss": 0.5, "psnr": 20.0})
+        em.emit("epoch", epoch=0, steps=25, wall_s=1.0, steps_per_sec=25.0)
+        em.emit("eval", prefix="val", step=1,
+                metrics={"psnr": 21.5, "ssim": 0.8})
+        em.emit("compile", name="train_step", n_compiles=1, wall_s=2.0,
+                call_index=1, steady_p50_s=None)
+        em.emit("memory", step=10, devices=[
+            {"id": 0, "platform": "cpu", "bytes_in_use": 100,
+             "peak_bytes_in_use": 200}], host_rss_bytes=10**9)
+        em.emit("heartbeat", wall_s=3.0, step=10, epoch=0)
+
+    rows = _read_rows(path)
+    assert len(rows) == 7
+    for row in rows:
+        assert validate_row(row) == [], row
+        assert row["v"] == SCHEMA_VERSION
+    assert [r["kind"] for r in rows] == [
+        "run_meta", "step", "epoch", "eval", "compile", "memory", "heartbeat"
+    ]
+
+
+def test_emitter_chief_guard(tmp_path):
+    """A non-chief emitter writes NOTHING — not even the file."""
+    path = str(tmp_path / "telemetry.jsonl")
+    em = Emitter(path, chief=False)
+    em.emit("heartbeat", wall_s=1.0)
+    em.close()
+    assert not os.path.exists(path)
+
+
+def test_emitter_appends_run_segments(tmp_path):
+    """Re-opening the same path appends a new run instead of truncating."""
+    path = str(tmp_path / "telemetry.jsonl")
+    for i in range(2):
+        with Emitter(path, chief=True) as em:
+            em.emit("heartbeat", wall_s=float(i))
+    rows = _read_rows(path)
+    assert [r["wall_s"] for r in rows] == [0.0, 1.0]
+
+
+def test_validate_row_rejects_drift():
+    assert validate_row({"v": 1, "kind": "nope", "t": 0.0})
+    assert validate_row({"v": 1, "kind": "step", "t": 0.0}) != []  # no step
+    ok = {"v": 1, "kind": "step", "t": 0.0, "step": 1}
+    assert validate_row(ok) == []
+    assert validate_row({**ok, "surprise": 1}) != []  # unknown field
+    assert validate_row({**ok, "lr": "high"}) != []  # wrong type
+
+
+def test_config_hash_stable_and_sensitive():
+    from nerf_replication_tpu.config import ConfigNode
+
+    a = ConfigNode({"task": "nerf", "train": {"lr": 5e-4}})
+    b = ConfigNode({"task": "nerf", "train": {"lr": 5e-4}})
+    c = ConfigNode({"task": "nerf", "train": {"lr": 1e-3}})
+    assert config_hash(a) == config_hash(b)
+    assert config_hash(a) != config_hash(c)
+
+
+# -- compile tracking -------------------------------------------------------
+
+def test_compile_tracker_detects_forced_retrace(tmp_path, monkeypatch):
+    """A jitted fn called with a new shape retraces; the tracker must
+    count both compiles and emit a compile row for each."""
+    import nerf_replication_tpu.obs.emit as emit_mod
+
+    path = str(tmp_path / "telemetry.jsonl")
+    em = Emitter(path, chief=True)
+    monkeypatch.setattr(emit_mod, "_active", em)
+
+    tracker = CompileTracker()
+    f = tracker.wrap("f", jax.jit(lambda x: x * 2))
+    f(jnp.zeros((4,)))
+    f(jnp.zeros((4,)))          # steady-state: cache hit
+    f(jnp.zeros((8,)))          # forced retrace: new shape
+    f(jnp.zeros((8,)))
+    em.close()
+
+    assert tracker.counts() == {"f": 2}
+    rows = [r for r in _read_rows(path) if r["kind"] == "compile"]
+    assert [r["n_compiles"] for r in rows] == [1, 2]
+    assert all(validate_row(r) == [] for r in rows)
+    # the retrace row happened on call 3 (two steady calls in between)
+    assert rows[1]["call_index"] == 3
+
+
+def test_compile_tracker_steady_state_median(tmp_path, monkeypatch):
+    import nerf_replication_tpu.obs.emit as emit_mod
+
+    path = str(tmp_path / "telemetry.jsonl")
+    em = Emitter(path, chief=True)
+    monkeypatch.setattr(emit_mod, "_active", em)
+
+    tracker = CompileTracker()
+    f = tracker.wrap("g", jax.jit(lambda x: x + 1))
+    for _ in range(5):
+        f(jnp.zeros((4,)))
+    f(jnp.zeros((2,)))  # retrace AFTER steady calls
+    em.close()
+    rows = [r for r in _read_rows(path) if r["kind"] == "compile"]
+    assert rows[-1]["steady_p50_s"] is not None  # median was available
+
+
+# -- memory sampling --------------------------------------------------------
+
+def test_sample_memory_emits_row(tmp_path, monkeypatch):
+    import nerf_replication_tpu.obs.emit as emit_mod
+    from nerf_replication_tpu.obs import sample_memory
+
+    path = str(tmp_path / "telemetry.jsonl")
+    em = Emitter(path, chief=True)
+    monkeypatch.setattr(emit_mod, "_active", em)
+    sample_memory(step=5, epoch=1)
+    em.close()
+    rows = _read_rows(path)
+    assert len(rows) == 1 and rows[0]["kind"] == "memory"
+    assert validate_row(rows[0]) == []
+    assert len(rows[0]["devices"]) == jax.local_device_count()
+    # host RSS is the backend-independent floor: always present on linux
+    assert rows[0]["host_rss_bytes"] > 0
+
+
+# -- report CLI -------------------------------------------------------------
+
+def _write_fixture_run(path, step_time, compiles=2, psnr=25.0, peak=2 * 10**9):
+    rows = [
+        {"v": 1, "kind": "run_meta", "t": 0.0, "run_id": "r", "component":
+         "train", "config_hash": "c", "process_index": 0,
+         "process_count": 1, "device_count": 1, "local_device_count": 1,
+         "platform": "cpu"},
+    ]
+    for i in range(1, compiles + 1):
+        rows.append({"v": 1, "kind": "compile", "t": float(i), "name":
+                     "train_step", "n_compiles": i, "wall_s": 2.0})
+    for s in range(10, 110, 10):
+        rows.append({"v": 1, "kind": "step", "t": float(s), "step": s,
+                     "step_time_s": step_time, "dispatch_s": 0.1 * step_time,
+                     "block_s": 0.9 * step_time,
+                     "stats": {"loss": 1.0 / s}})
+    rows.append({"v": 1, "kind": "memory", "t": 200.0, "devices": [
+        {"id": 0, "platform": "cpu", "bytes_in_use": peak // 2,
+         "peak_bytes_in_use": peak}], "host_rss_bytes": peak})
+    rows.append({"v": 1, "kind": "eval", "t": 300.0,
+                 "metrics": {"psnr": psnr, "ssim": 0.9}})
+    with open(path, "w") as f:
+        for r in rows:
+            assert validate_row(r) == [], r
+            f.write(json.dumps(r) + "\n")
+
+
+def test_tlm_report_summary(tmp_path, capsys):
+    tlm = _load_script("tlm_report")
+    run = tmp_path / "runA"
+    run.mkdir()
+    _write_fixture_run(str(run / "telemetry.jsonl"), step_time=0.02)
+    rc = tlm.report(str(run))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "p50 20.00 ms" in out
+    assert "compiles:      2" in out
+    assert "final psnr:    25.000" in out
+    # summary numbers directly
+    summary = tlm.summarize(tlm.load_rows(str(run / "telemetry.jsonl")))
+    assert summary["step_time_p50_s"] == pytest.approx(0.02)
+    assert summary["step_time_p95_s"] == pytest.approx(0.02)
+    assert summary["compile_count"] == 2
+    assert summary["peak_device_bytes"] == 2 * 10**9
+    assert summary["last_step"] == 100
+
+
+def test_tlm_report_diff_flags_injected_regression(tmp_path, capsys):
+    """--diff on two fixture runs flags an injected step-time regression
+    (and exits nonzero under --gate)."""
+    tlm = _load_script("tlm_report")
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    _write_fixture_run(str(a / "telemetry.jsonl"), step_time=0.02)
+    _write_fixture_run(str(b / "telemetry.jsonl"), step_time=0.03,
+                       compiles=4)  # +50% step time, compile storm
+    rc = tlm.report(str(a), diff_run=str(b), gate=10.0)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "step time p50 regressed" in out
+    assert "compile count grew 2 -> 4" in out
+
+    # same run against itself: clean diff, exit 0
+    rc = tlm.report(str(a), diff_run=str(a), gate=10.0)
+    assert rc == 0
+    assert "no regressions" in capsys.readouterr().out
+
+
+def test_tlm_report_scopes_to_last_run(tmp_path):
+    """A resumed run appends a second segment; the summary must cover the
+    LAST segment only (unless --all-runs)."""
+    tlm = _load_script("tlm_report")
+    path = str(tmp_path / "telemetry.jsonl")
+    _write_fixture_run(path, step_time=0.05)
+    with open(path) as f:
+        first = f.read()
+    _write_fixture_run(str(tmp_path / "t2.jsonl"), step_time=0.01)
+    with open(str(tmp_path / "t2.jsonl")) as f:
+        second = f.read()
+    with open(path, "w") as f:
+        f.write(first + second)
+    rows = tlm.last_run(tlm.load_rows(path))
+    summary = tlm.summarize(rows)
+    assert summary["step_time_p50_s"] == pytest.approx(0.01)
+
+
+# -- schema checker CLI -----------------------------------------------------
+
+def test_check_telemetry_schema_cli(tmp_path):
+    chk = _load_script("check_telemetry_schema")
+    good = tmp_path / "telemetry.jsonl"
+    _write_fixture_run(str(good), step_time=0.02)
+    assert chk.check_file(str(good)) == []
+    assert chk.main([str(good)]) == 0
+
+    bad = tmp_path / "telemetry_bad.jsonl"
+    bad.write_text('{"v": 1, "kind": "mystery", "t": 0.0}\nnot json\n')
+    errors = chk.check_file(str(bad))
+    assert len(errors) == 2
+    assert chk.main([str(bad)]) == 1
+
+    bench = tmp_path / "BENCH_X.jsonl"
+    bench.write_text(
+        json.dumps({"metric": "train_rays_per_sec", "value": 1.0}) + "\n"
+        + json.dumps({"arm": "std", "rays_per_sec": 2.0}) + "\n"
+        + json.dumps({"error": "OOM"}) + "\n"
+    )
+    assert chk.check_file(str(bench)) == []
+    # drifted bench row: no family discriminator
+    drift = tmp_path / "BENCH_DRIFT.jsonl"
+    drift.write_text(json.dumps({"speed": 12.0}) + "\n")
+    assert chk.check_file(str(drift)) != []
+
+
+def test_repo_bench_trails_validate():
+    """The committed measurement trails must keep passing the checker —
+    this is the 'bench files can't silently drift shape again' pin."""
+    chk = _load_script("check_telemetry_schema")
+    paths = chk.default_paths()
+    assert paths, "repo bench trails missing"
+    for path in paths:
+        assert chk.check_file(path) == [], path
+
+
+def test_validate_bench_row_families():
+    assert validate_bench_row({"metric": "x", "value": 1.0}) == []
+    assert validate_bench_row({"metric": "x"}) != []  # family field missing
+    assert validate_bench_row({"impl": "xla", "ms": 0.1}) == []
+    assert validate_bench_row({"whatever": 1}) != []
+    assert validate_bench_row({"error": "boom"}) == []
+    assert validate_bench_row([1, 2]) != []
+
+
+def test_append_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "sub" / "BENCH_T.jsonl")
+    append_jsonl(path, {"metric": "m", "value": 1.5})
+    append_jsonl(path, {"metric": "m", "value": np.float32(2.5)})
+    rows = _read_rows(path)
+    assert [r["value"] for r in rows] == [1.5, 2.5]
+
+
+# -- end-to-end: the acceptance smoke slice ---------------------------------
+
+def test_fit_smoke_produces_telemetry(tmp_path):
+    """A tiny CPU fit() must produce a telemetry.jsonl with run_meta,
+    >=1 step, >=1 compile, and >=1 memory row, all schema-valid, and
+    tlm_report must summarize it (the PR's acceptance criterion)."""
+    from test_fit_dp import dp_cfg, generate_scene
+    from nerf_replication_tpu.train.trainer import fit
+
+    root = str(tmp_path / "scene")
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4, n_test=1)
+    cfg = dp_cfg(root, tmp_path, ["parallel.data_axis", "1",
+                                  "train.epoch", "1",
+                                  "eval_ep", "1",
+                                  "save_latest_ep", "100"])
+    fit(cfg, log=lambda *a, **k: None)
+
+    telem = os.path.join(cfg.record_dir, "telemetry.jsonl")
+    assert os.path.exists(telem), "fit() produced no telemetry.jsonl"
+    rows = _read_rows(telem)
+    for row in rows:
+        assert validate_row(row) == [], row
+    kinds = {r["kind"] for r in rows}
+    assert {"run_meta", "step", "compile", "memory"} <= kinds
+    # the val epoch emitted an eval row through the recorder
+    assert "eval" in kinds
+    # report runs end-to-end over the real artifact
+    tlm = _load_script("tlm_report")
+    summary = tlm.summarize(tlm.last_run(tlm.load_rows(telem)))
+    assert summary["compile_count"] >= 1
+    assert summary["step_time_p50_s"] > 0
+    assert summary["final_psnr"] is not None

@@ -1,0 +1,153 @@
+"""Recorder/SmoothedValue parity surface: median/global-avg math, eta
+formatting in console_line, and checkpointable state that preserves the
+smoothing totals across a resume (the reference resets eta to zero on
+resume — fixed here, PR 1 satellite)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nerf_replication_tpu.config import ConfigNode  # noqa: E402
+from nerf_replication_tpu.train.recorder import (  # noqa: E402
+    Recorder,
+    SmoothedValue,
+)
+
+
+def _recorder(tmp_path, **extra):
+    cfg = ConfigNode(
+        {"record_dir": str(tmp_path / "rec"), "resume": False, **extra}
+    )
+    return Recorder(cfg)
+
+
+def test_smoothed_value_window_math():
+    sv = SmoothedValue(window_size=4)
+    for v in (1.0, 3.0, 5.0, 7.0):
+        sv.update(v)
+    assert sv.median == 4.0        # even window: mean of middle pair
+    assert np.isclose(sv.avg, 4.0)
+    assert np.isclose(sv.global_avg, 4.0)
+    sv.update(9.0)                 # 1.0 falls out of the window...
+    assert sv.median == 6.0
+    assert np.isclose(sv.avg, 6.0)
+    # ...but stays in the global average
+    assert np.isclose(sv.global_avg, 5.0)
+    assert sv.count == 5 and sv.total == 25.0
+
+
+def test_smoothed_value_empty_defaults():
+    sv = SmoothedValue()
+    assert sv.median == 0.0 and sv.avg == 0.0 and sv.global_avg == 0.0
+    assert str(sv) == "0.0000 (0.0000)"
+
+
+def test_smoothed_value_state_roundtrip():
+    sv = SmoothedValue(window_size=3)
+    for v in (2.0, 4.0, 6.0, 8.0):
+        sv.update(v)
+    sv2 = SmoothedValue(window_size=3)
+    sv2.load_state_dict(sv.state_dict())
+    assert sv2.total == sv.total and sv2.count == sv.count
+    assert list(sv2.deque) == list(sv.deque)
+    assert sv2.median == sv.median and sv2.global_avg == sv.global_avg
+
+
+def test_console_line_eta_formatting(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.step = 40
+    rec.batch_time.update(2.0)     # global_avg 2 s/step
+    rec.data_time.update(0.25)
+    rec.update_loss_stats({"loss": 0.125})
+    # eta = 2.0 * (3700 - 100) = 7200 s = 2:00:00
+    line = rec.console_line(epoch=3, it=100, max_iter=3700, lr=1.25e-3)
+    assert "eta: 2:00:00" in line
+    assert "epoch: 3" in line and "step: 40" in line
+    assert "loss: 0.1250 (0.1250)" in line
+    assert "lr: 0.001250" in line
+    assert "data: 0.2500" in line and "batch: 2.0000" in line
+    assert "max_mem" not in line
+    assert "max_mem: 123" in rec.console_line(0, 0, 1, 1e-3, max_mem_mb=123.4)
+
+
+def test_console_line_eta_seconds_and_minutes(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.batch_time.update(0.5)
+    line = rec.console_line(epoch=0, it=0, max_iter=125, lr=1e-3)
+    assert "eta: 0:01:02" in line  # 62.5 s floors to 0:01:02
+
+
+def test_recorder_state_dict_preserves_smoothing(tmp_path):
+    """A resumed recorder must continue eta/global averages, not reset."""
+    rec = _recorder(tmp_path)
+    rec.step, rec.epoch = 500, 3
+    for i in range(30):
+        rec.batch_time.update(0.1 + 0.001 * i)
+        rec.data_time.update(0.01)
+        rec.update_loss_stats({"loss": 1.0 / (i + 1), "psnr": 20.0 + i})
+
+    state = rec.state_dict()
+    rec2 = _recorder(tmp_path, record_dir=str(tmp_path / "rec2"))
+    rec2.load_state_dict(state)
+
+    assert rec2.step == 500 and rec2.epoch == 3
+    assert rec2.batch_time.count == 30
+    assert np.isclose(rec2.batch_time.global_avg, rec.batch_time.global_avg)
+    assert np.isclose(rec2.batch_time.median, rec.batch_time.median)
+    assert set(rec2.loss_stats) == {"loss", "psnr"}
+    assert rec2.loss_stats["psnr"].count == 30
+    # the console line (eta + global averages) is bit-identical
+    assert rec2.console_line(3, 10, 100, 5e-4) == rec.console_line(
+        3, 10, 100, 5e-4
+    )
+
+
+def test_recorder_legacy_state_dict_still_loads(tmp_path):
+    """Pre-PR-1 checkpoints carry only {step, epoch} — they must load."""
+    rec = _recorder(tmp_path)
+    rec.load_state_dict({"step": 42, "epoch": 7})
+    assert rec.step == 42 and rec.epoch == 7
+    assert rec.batch_time.count == 0
+
+
+def test_checkpoint_recorder_sidecar_roundtrip(tmp_path):
+    """save_model/load_model carry the FULL recorder state through the
+    sidecar while the orbax bundle keeps its fixed schema."""
+    import jax
+
+    from test_train import tiny_cfg
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.train import make_train_state
+    from nerf_replication_tpu.train.checkpoint import load_model, save_model
+
+    root = str(tmp_path / "scene")
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=2, n_test=1)
+    cfg = tiny_cfg(root)
+    net = make_network(cfg)
+    state, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    state = state.replace(step=77)
+
+    rec = _recorder(tmp_path)
+    rec.step, rec.epoch = 77, 2
+    for _ in range(5):
+        rec.batch_time.update(0.2)
+        rec.update_loss_stats({"loss": 0.5})
+
+    model_dir = str(tmp_path / "ckpt")
+    save_model(model_dir, state, epoch=2, recorder_state=rec.state_dict(),
+               latest=True)
+    assert os.path.exists(os.path.join(model_dir, "latest_recorder.json"))
+
+    state2, _ = make_train_state(cfg, net, jax.random.PRNGKey(1))
+    _, begin_epoch, rec_state = load_model(model_dir, state2)
+    assert begin_epoch == 3
+    rec2 = _recorder(tmp_path, record_dir=str(tmp_path / "rec3"))
+    rec2.load_state_dict(rec_state)
+    assert rec2.step == 77
+    assert rec2.batch_time.count == 5
+    assert np.isclose(rec2.batch_time.global_avg, 0.2)
+    assert rec2.loss_stats["loss"].count == 5

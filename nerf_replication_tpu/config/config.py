@@ -130,6 +130,27 @@ def default_cfg() -> ConfigNode:
     # precision knobs (TPU-native: bfloat16 compute, f32 params/accumulation)
     cfg.precision = ConfigNode({"compute_dtype": "float32", "param_dtype": "float32"})
 
+    # render-serving engine (nerf_replication_tpu/serve, docs/serving.md):
+    # shape buckets are ray-chunk sizes arbitrary request shapes pad into
+    # (each rounded up to a multiple of the render chunk size), so a mixed
+    # request stream never retraces; the micro-batcher coalesces pending
+    # requests until max_batch_rays or max_delay_ms, whichever first; under
+    # backlog, shed_queue_depths are the queue depths (requests still
+    # waiting) that activate degradation tiers 1..3
+    # (reduced_k / coarse / half_res)
+    cfg.serve = ConfigNode(
+        {
+            "buckets": [4096, 16384],
+            "max_batch_rays": 16384,
+            "max_delay_ms": 5.0,
+            "request_timeout_s": 30.0,
+            "cache_entries": 64,     # pose->image LRU slots (0 disables)
+            "pose_decimals": 3,      # camera-pose quantization for cache keys
+            "warmup": True,          # pre-compile every (bucket, tier) pair
+            "shed_queue_depths": [4, 8, 16],
+        }
+    )
+
     return cfg
 
 

@@ -82,6 +82,26 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"wall_s": _NUM},
         {"step": _NUM, "epoch": _NUM},
     ),
+    # -- serving rows (nerf_replication_tpu/serve) ---------------------------
+    # one per completed (or timed-out) render request: end-to-end latency,
+    # the degradation tier it was served at, and whether the pose cache hit
+    "serve_request": (
+        {"latency_s": _NUM, "n_rays": _NUM, "tier": (str,)},
+        {"queue_s": _NUM, "status": (str,), "cache_hit": (bool, int),
+         "n_buckets": _NUM, "bucket_rays": _NUM},
+    ),
+    # one per coalesced engine dispatch: how many requests/rays rode the
+    # batch and how full the padded buckets were (occupancy = real/padded)
+    "serve_batch": (
+        {"n_requests": _NUM, "n_rays": _NUM, "occupancy": _NUM},
+        {"tier": (str,), "render_s": _NUM, "queue_depth": _NUM,
+         "bucket_rays": _NUM},
+    ),
+    # one per load-shed decision: the backlog that triggered a degraded tier
+    "serve_shed": (
+        {"tier": (str,), "queue_depth": _NUM},
+        {"n_requests": _NUM, "n_rays": _NUM},
+    ),
 }
 
 
@@ -145,6 +165,9 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # scale_check.py render-path / executable-census rows
     "path": (),
     "chunked_fns": (),
+    # scripts/serve_bench.py summary rows (BENCH_SERVE.jsonl): one row per
+    # closed/open-loop run of the serving load generator
+    "serve_mode": ("n_requests", "p50_ms"),
 }
 
 

@@ -19,6 +19,35 @@ from __future__ import annotations
 import numpy as np
 
 
+class BakedBoundsError(ValueError):
+    """A render surface with jit-static (baked) near/far received a request
+    carrying different bounds.
+
+    Raised instead of a bare ValueError so callers holding a baked
+    executable set — the sharded gate, the serve engine's bucketed
+    executables — surface ONE unambiguous error naming both sides, rather
+    than a comparison buried mid-traceback."""
+
+
+def check_baked_bounds(baked_near, baked_far, near, far,
+                       surface: str = "eval.sharded render gate") -> None:
+    """Reject a near/far pair that differs from the baked ones.
+
+    Both sides are coerced through float32 before comparing: batches carry
+    np.float32 values, so e.g. near=0.1 (not exactly f32-representable)
+    would otherwise mismatch on every image. ``surface`` names the baked
+    executable set in the error so a serving stack with several of them
+    (gate, engine buckets) points at the right one."""
+    bn, bf = float(np.float32(baked_near)), float(np.float32(baked_far))
+    rn, rf = float(np.float32(near)), float(np.float32(far))
+    if bn != rn or bf != rf:
+        raise BakedBoundsError(
+            f"{surface}: baked bounds near={bn:g} far={bf:g} do not match "
+            f"the requested bounds near={rn:g} far={rf:g} — rebuild the "
+            "render surface for the new bounds, or fix the batch"
+        )
+
+
 def _annotated(render):
     """Host-side profiler scope around every whole-image render, so eval
     time is attributable on an xplane trace captured during validation."""
@@ -64,17 +93,8 @@ def full_image_render_fn(cfg, network, renderer, test_ds, use_grid=False):
     def check_bounds(batch):
         # the single-device paths honor per-batch bounds; the sharded
         # executables can't — reject a mismatch instead of silently
-        # rendering at the wrong depth range.
-        # coerce both sides through float32 before comparing: the batch
-        # carries np.float32 values, so e.g. near=0.1 (not exactly f32-
-        # representable) would otherwise mismatch on every image
-        b_near, b_far = float(batch["near"]), float(batch["far"])
-        if (float(np.float32(near)) != float(np.float32(b_near))
-                or float(np.float32(far)) != float(np.float32(b_far))):
-            raise ValueError(
-                f"eval.sharded baked bounds ({near}, {far}) but the batch "
-                f"carries ({b_near}, {b_far})"
-            )
+        # rendering at the wrong depth range
+        check_baked_bounds(near, far, batch["near"], batch["far"])
 
     mesh = make_mesh_from_cfg(cfg)
     if use_grid:

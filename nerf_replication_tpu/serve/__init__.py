@@ -1,0 +1,31 @@
+"""Batched render-serving engine (docs/serving.md).
+
+The subsystem that turns the one-shot batch renderer into the serving
+layer the ROADMAP's north star asks for: a checkpoint-resident
+:class:`RenderEngine` with pre-warmed shape-bucketed executables (zero
+retraces across arbitrary request shapes), a deadline-coalescing
+:class:`MicroBatcher` that amortizes dispatch across concurrent requests,
+a deterministic :class:`DegradationPolicy` that sheds load by serving
+cheaper tiers instead of timing out, and a quantized-pose
+:class:`PoseCache` for repeated-view traffic. Entry points: ``serve.py``
+(HTTP) and ``scripts/serve_bench.py`` (closed/open-loop load generator).
+"""
+
+from .batcher import MicroBatcher, ServeFuture, ServeTimeoutError
+from .cache import PoseCache
+from .engine import RenderEngine, ServeOptions, engine_from_cfg
+from .policy import FAMILIES, TIER_IMPL, TIER_NAMES, DegradationPolicy
+
+__all__ = [
+    "FAMILIES",
+    "TIER_IMPL",
+    "TIER_NAMES",
+    "DegradationPolicy",
+    "MicroBatcher",
+    "PoseCache",
+    "RenderEngine",
+    "ServeFuture",
+    "ServeOptions",
+    "ServeTimeoutError",
+    "engine_from_cfg",
+]

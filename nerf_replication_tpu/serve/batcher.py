@@ -1,0 +1,298 @@
+"""Request micro-batching: coalesce pending ray batches across requests.
+
+Per-request dispatch wastes the engine's buckets — a 640-ray request in a
+4096-ray bucket is 84% padding. The micro-batcher holds a request queue
+and cuts a batch when EITHER edge fires: total pending rays reach
+``max_batch_rays``, or the oldest request has waited ``max_delay_s``
+(the classic max-batch/max-delay deadline pair). The batch concatenates
+whole requests, renders through the engine's bucketed executables in one
+flat call, and scatters the output slices back per request.
+
+Backpressure is handled by degradation, not queueing to death: the tier
+for each batch comes from ``DegradationPolicy.tier_for(queue_depth)``
+measured when the batch is cut — a deep backlog serves cheaper tiers
+(serve/policy.py) and emits ``serve_shed`` telemetry instead of letting
+requests age into timeouts. Requests that DO exceed their deadline while
+queued fail fast with :class:`ServeTimeoutError` before any compute is
+spent on them.
+
+Determinism for tests: construct with ``start=False`` and an injectable
+``clock``, enqueue with ``submit``, and drive batches synchronously with
+``pump()`` — the same code path the worker thread runs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..obs import get_emitter
+from ..renderer.gate import check_baked_bounds
+from .policy import TIER_IMPL, DegradationPolicy
+
+
+class ServeTimeoutError(TimeoutError):
+    """The request exceeded its deadline while queued (never rendered)."""
+
+
+class ServeFuture:
+    """Completion handle for one submitted request."""
+
+    def __init__(self, n_rays: int):
+        self.n_rays = n_rays
+        self._event = threading.Event()
+        self._result: dict | None = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, result: dict) -> None:
+        self._result = result
+        self._event.set()
+
+    def set_exception(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout: float | None = None) -> dict:
+        if not self._event.wait(timeout):
+            raise ServeTimeoutError(
+                f"no result within {timeout}s (request still queued?)"
+            )
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+@dataclass
+class _Pending:
+    rays: np.ndarray
+    future: ServeFuture
+    t_enqueued: float
+    n_rays: int = field(init=False)
+
+    def __post_init__(self):
+        self.n_rays = int(self.rays.shape[0])
+
+
+class MicroBatcher:
+    """Deadline-coalescing request queue in front of a RenderEngine."""
+
+    def __init__(self, engine, policy: DegradationPolicy | None = None,
+                 clock=time.monotonic, start: bool = True):
+        self.engine = engine
+        self.options = engine.options
+        self.policy = policy or DegradationPolicy(
+            thresholds=engine.options.shed_queue_depths
+        )
+        self.clock = clock
+        self._queue: deque[_Pending] = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        # counters (worker-thread owned after start; read-only elsewhere)
+        self.n_batches = 0
+        self.n_shed = 0
+        self.n_timeouts = 0
+        self.n_completed = 0
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._worker, name="serve-batcher", daemon=True
+            )
+            self._thread.start()
+
+    # -- submission -----------------------------------------------------------
+
+    def submit(self, rays, near, far) -> ServeFuture:
+        """Enqueue a [N, C] ray request; returns a future.
+
+        Bounds are validated HERE (BakedBoundsError raises to the caller
+        synchronously) so a bad request never occupies queue capacity."""
+        check_baked_bounds(self.engine.near, self.engine.far, near, far,
+                           surface="serve micro-batcher")
+        rays = np.asarray(rays, np.float32)
+        if rays.ndim != 2 or rays.shape[0] == 0:
+            raise ValueError(
+                f"rays must be a non-empty [N, C] array, got {rays.shape}"
+            )
+        pending = _Pending(rays, ServeFuture(rays.shape[0]), self.clock())
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("batcher is closed")
+            self._queue.append(pending)
+            self._cond.notify_all()
+        return pending.future
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker; with ``drain`` the queue renders first,
+        otherwise queued futures fail with ServeTimeoutError."""
+        with self._cond:
+            self._stop = True
+            if not drain:
+                while self._queue:
+                    p = self._queue.popleft()
+                    p.future.set_exception(
+                        ServeTimeoutError("batcher closed before render")
+                    )
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+
+    # -- batching core --------------------------------------------------------
+
+    def _cut_batch(self) -> tuple[list[_Pending], int] | None:
+        """Block until a batch edge fires; pop and return (batch, depth
+        left behind). None only on close with an empty queue."""
+        with self._cond:
+            while not self._queue and not self._stop:
+                self._cond.wait()
+            if not self._queue:
+                return None
+            max_rays = self.options.max_batch_rays
+            while not self._stop:
+                total = sum(p.n_rays for p in self._queue)
+                if total >= max_rays:
+                    break  # max-batch edge
+                remaining = self.options.max_delay_s - (
+                    self.clock() - self._queue[0].t_enqueued
+                )
+                if remaining <= 0:
+                    break  # max-delay edge
+                self._cond.wait(timeout=remaining)
+            # pop whole requests up to the ray budget (always >= 1, so an
+            # oversize single request still renders — the engine splits it)
+            batch: list[_Pending] = []
+            total = 0
+            while self._queue and (
+                not batch or total + self._queue[0].n_rays <= max_rays
+            ):
+                p = self._queue.popleft()
+                batch.append(p)
+                total += p.n_rays
+            return batch, len(self._queue)
+
+    def pump(self) -> int:
+        """Cut and render one batch synchronously (the test/manual-drive
+        surface; the worker thread is a loop of exactly this). Returns the
+        number of requests completed (0 when queue empty and closed)."""
+        cut = self._cut_batch()
+        if cut is None:
+            return 0
+        batch, depth = cut
+        return self._render_batch(batch, depth)
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop and not self._queue:
+                    return
+            if self.pump() == 0 and self._stop:
+                return
+
+    def _render_batch(self, batch: list[_Pending], queue_depth: int) -> int:
+        emitter = get_emitter()
+        now = self.clock()
+
+        # fail queued-past-deadline requests before spending compute
+        live: list[_Pending] = []
+        for p in batch:
+            waited = now - p.t_enqueued
+            if waited > self.options.request_timeout_s:
+                self.n_timeouts += 1
+                p.future.set_exception(ServeTimeoutError(
+                    f"request waited {waited:.3f}s in queue "
+                    f"(timeout {self.options.request_timeout_s}s)"
+                ))
+                emitter.emit(
+                    "serve_request", latency_s=waited, n_rays=p.n_rays,
+                    tier="none", status="timeout", queue_s=waited,
+                )
+            else:
+                live.append(p)
+        if not live:
+            return 0
+
+        tier = self.policy.tier_for(queue_depth)
+        family, stride = TIER_IMPL[tier]
+        if tier != "full":
+            self.n_shed += 1
+            emitter.emit(
+                "serve_shed", tier=tier, queue_depth=queue_depth,
+                n_requests=len(live),
+                n_rays=sum(p.n_rays for p in live),
+            )
+
+        # assemble: per-request tier striding, one flat engine call
+        segments = []
+        offset = 0
+        for p in live:
+            strided = p.rays[::stride]
+            segments.append((offset, strided.shape[0]))
+            offset += strided.shape[0]
+        flat = (
+            live[0].rays[::stride] if len(live) == 1
+            else np.concatenate([p.rays[::stride] for p in live], axis=0)
+        )
+
+        t0 = self.clock()
+        try:
+            out, info = self.engine.render_flat(flat, family)
+        except Exception as err:  # scatter the failure; don't kill the loop
+            for p in live:
+                p.future.set_exception(err)
+            return 0
+        render_s = self.clock() - t0
+
+        self.n_batches += 1
+        emitter.emit(
+            "serve_batch",
+            n_requests=len(live),
+            n_rays=int(flat.shape[0]),
+            occupancy=float(info["occupancy"]),
+            tier=tier,
+            render_s=float(render_s),
+            queue_depth=queue_depth,
+            bucket_rays=int(info["bucket_rays"]),
+        )
+
+        t_done = self.clock()
+        for p, (start, length) in zip(live, segments):
+            sliced = {k: v[start:start + length] for k, v in out.items()}
+            if stride > 1:
+                sliced = {
+                    k: np.repeat(v, stride, axis=0)[:p.n_rays]
+                    for k, v in sliced.items()
+                }
+            sliced["tier"] = tier
+            self.n_completed += 1
+            self.engine.n_requests += 1
+            emitter.emit(
+                "serve_request",
+                latency_s=t_done - p.t_enqueued,
+                n_rays=p.n_rays,
+                tier=tier,
+                status="ok",
+                queue_s=t0 - p.t_enqueued,
+            )
+            p.future.set_result(sliced)
+        return len(live)
+
+    def stats(self) -> dict:
+        return {
+            "queue_depth": self.queue_depth(),
+            "n_batches": self.n_batches,
+            "n_completed": self.n_completed,
+            "n_shed": self.n_shed,
+            "n_timeouts": self.n_timeouts,
+        }

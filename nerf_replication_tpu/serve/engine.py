@@ -1,0 +1,420 @@
+"""Render-serving engine: one checkpoint, a few executables, any request.
+
+Every batch render surface before this subsystem (run.py, render_video.py,
+the gate) pays compile cost per invocation and renders one request at a
+time. The engine inverts that: it loads the checkpoint + baked occupancy
+grid ONCE and pre-warms a small set of **shape-bucketed** jit executables —
+ray-chunk sizes are pinned (the static-shape design of
+renderer/packed_march.py and renderer/accelerated.py), so an arbitrary
+request shape pads into the smallest bucket that holds it and can never
+retrace. With NerfAcc-style occupancy sampling making per-ray FLOPs cheap,
+dispatch/batching dominates serving latency; the bucket set is the whole
+executable inventory, compiled before the first request arrives.
+
+Three executable families exist per bucket — ``full`` / ``reduced_k`` /
+``coarse`` (serve/policy.py's degradation ladder; ``half_res`` reuses
+``coarse`` with host-side ray striding) — so shedding load under backlog
+switches executables, never compiles one.
+
+Numerics contract: for the ``full`` tier the per-bucket executable is the
+SAME program ``Renderer.render_accelerated`` builds — identical chunking
+(``lax.map`` over ``[chunk, 6]`` rows), identical static bounds — so a
+padded-bucket render is bitwise-equal to the unbatched path on the real
+rows (tests/test_serve.py proves it).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from ..obs import CompileTracker, get_emitter
+from ..renderer.gate import check_baked_bounds
+from .cache import PoseCache
+from .policy import FAMILIES, TIER_IMPL
+
+
+@dataclass(frozen=True)
+class ServeOptions:
+    """Engine/batcher configuration (cfg.serve; docs/serving.md)."""
+
+    buckets: tuple[int, ...] = (4096, 16384)
+    max_batch_rays: int = 16384
+    max_delay_s: float = 0.005
+    request_timeout_s: float = 30.0
+    cache_entries: int = 64
+    pose_decimals: int = 3
+    warmup: bool = True
+    shed_queue_depths: tuple[int, ...] = (4, 8, 16)
+
+    @classmethod
+    def from_cfg(cls, cfg) -> "ServeOptions":
+        s = cfg.get("serve", {})
+        return cls(
+            buckets=tuple(int(b) for b in s.get("buckets", (4096, 16384))),
+            max_batch_rays=int(s.get("max_batch_rays", 16384)),
+            max_delay_s=float(s.get("max_delay_ms", 5.0)) / 1e3,
+            request_timeout_s=float(s.get("request_timeout_s", 30.0)),
+            cache_entries=int(s.get("cache_entries", 64)),
+            pose_decimals=int(s.get("pose_decimals", 3)),
+            warmup=bool(s.get("warmup", True)),
+            shed_queue_depths=tuple(
+                int(d) for d in s.get("shed_queue_depths", (4, 8, 16))
+            ),
+        )
+
+
+def _normalize_buckets(buckets, chunk: int) -> tuple[int, ...]:
+    """Ascending unique bucket sizes, each a multiple of the render chunk
+    (the executables ``lax.map`` over [chunk, C] rows, so a bucket that
+    isn't a multiple would silently grow a new chunk shape)."""
+    norm = {max(chunk, -(-int(b) // chunk) * chunk) for b in buckets}
+    return tuple(sorted(norm))
+
+
+class RenderEngine:
+    """Checkpoint-resident render server core.
+
+    Pure compute + bookkeeping: thread-safety for concurrent requests is
+    the MicroBatcher's job (one worker thread owns the dispatch); direct
+    ``render_request`` calls are single-caller surfaces (render_video, the
+    eval CLIs).
+
+    ``grid``/``bbox`` present selects the occupancy-accelerated march
+    (eval march budget); absent falls back to the chunked volume renderer
+    — same degradation ladder either way.
+    """
+
+    def __init__(self, cfg, network, params, near, far, grid=None, bbox=None,
+                 tracker: CompileTracker | None = None,
+                 warmup_families: tuple[str, ...] = FAMILIES):
+        import jax.numpy as jnp
+
+        from ..renderer.accelerated import MarchOptions
+        from ..renderer.volume import RenderOptions
+
+        self.network = network
+        self.params = params
+        self.near = float(near)
+        self.far = float(far)
+        self.options = ServeOptions.from_cfg(cfg)
+        self.use_grid = grid is not None
+        self.grid = None if grid is None else jnp.asarray(grid)
+        self.bbox = None if bbox is None else jnp.asarray(bbox)
+        # the full tier is EXACTLY the eval budget the one-shot surfaces
+        # use (Renderer.march_options / eval_options) — parity by
+        # construction, not by keeping two configs in sync
+        self.march_options = MarchOptions.eval_from_cfg(cfg)
+        self.eval_options = RenderOptions.from_cfg(cfg, train=False)
+        self.chunk = (
+            self.march_options.chunk_size if self.use_grid
+            else self.eval_options.chunk_size
+        )
+        self.buckets = _normalize_buckets(self.options.buckets, self.chunk)
+        self.tracker = tracker or CompileTracker()
+        self.cache = PoseCache(
+            capacity=self.options.cache_entries,
+            decimals=self.options.pose_decimals,
+        )
+        self._fns: dict[tuple[int, str], object] = {}
+        # serving counters (host-side; read via stats())
+        self.n_requests = 0
+        self.n_rays_rendered = 0
+        self.n_pad_rays = 0
+        self.n_truncated = 0
+        self.warmup_compiles = 0
+        # camera defaults for pose-only surfaces; engine_from_cfg fills it
+        self.default_camera: dict | None = None
+        if self.options.warmup:
+            self.warm_up(warmup_families)
+
+    # -- executable construction --------------------------------------------
+
+    def _family_march_options(self, family: str):
+        base = self.march_options
+        if family == "full":
+            return base
+        # reduced_k and coarse share the halved MLP budget; coarse
+        # additionally swaps the queried network (in _build_fn)
+        return replace(base, max_samples=max(1, base.max_samples // 2))
+
+    def _family_eval_options(self, family: str):
+        base = self.eval_options
+        if family == "full":
+            return base
+        if family == "reduced_k":
+            return replace(base, n_importance=base.n_importance // 2)
+        return replace(base, n_importance=0)  # coarse-only
+
+    def _build_fn(self, bucket: int, family: str):
+        import jax
+        import jax.numpy as jnp  # noqa: F401  (kept local: no import cost pre-jax)
+
+        from ..renderer.accelerated import march_rays_accelerated
+        from ..renderer.volume import render_rays
+
+        network = self.network
+        near, far = self.near, self.far
+        model = "coarse" if family == "coarse" else "fine"
+
+        if self.use_grid:
+            options = self._family_march_options(family)
+
+            @jax.jit
+            def fn(params, rays_p, grid, bbox):
+                apply_fn = lambda pts, vd, _m: network.apply(  # noqa: E731
+                    params, pts, vd, model=model
+                )
+                return jax.lax.map(
+                    lambda rc: march_rays_accelerated(
+                        apply_fn, rc, near, far, grid, bbox, options
+                    ),
+                    rays_p,
+                )
+
+            return fn
+
+        options = self._family_eval_options(family)
+
+        @jax.jit
+        def fn(params, rays_p):
+            apply_fn = lambda pts, vd, m: network.apply(  # noqa: E731
+                params, pts, vd, model=m
+            )
+            return jax.lax.map(
+                lambda rc: render_rays(apply_fn, rc, near, far, None, options),
+                rays_p,
+            )
+
+        return fn
+
+    def _get_fn(self, bucket: int, family: str):
+        key = (bucket, family)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self.tracker.wrap(
+                f"serve/{family}/b{bucket}", self._build_fn(bucket, family)
+            )
+            self._fns[key] = fn
+        return fn
+
+    def warm_up(self, families: tuple[str, ...] = FAMILIES) -> int:
+        """Compile every (bucket, family) executable before traffic.
+
+        Zero-direction rays are the renderer's own padding convention
+        (forced unoccupied in the occupancy sweep), so an all-zero bucket
+        is a valid warm-up input. Surfaces that only ever serve one tier
+        (render_video) pass ``families=("full",)`` to skip the degraded
+        executables. Returns the compile count paid."""
+        before = self.tracker.total_compiles()
+        zeros = {
+            b: np.zeros((b, 6), np.float32) for b in self.buckets
+        }
+        for bucket in self.buckets:
+            for family in families:
+                out = self._dispatch(zeros[bucket], bucket, family)
+                for v in out.values():
+                    np.asarray(v)  # block: compile now, not on request one
+        self.warmup_compiles += self.tracker.total_compiles() - before
+        return self.warmup_compiles
+
+    # -- rendering -----------------------------------------------------------
+
+    def _dispatch(self, rays_b: np.ndarray, bucket: int, family: str) -> dict:
+        """One executable call on exactly ``bucket`` rays (already padded)."""
+        chunks = rays_b.reshape(bucket // self.chunk, self.chunk,
+                                rays_b.shape[-1])
+        fn = self._get_fn(bucket, family)
+        if self.use_grid:
+            return fn(self.params, chunks, self.grid, self.bbox)
+        return fn(self.params, chunks)
+
+    def _render_bucket(self, rays: np.ndarray, bucket: int,
+                       family: str) -> dict:
+        n = rays.shape[0]
+        rays_b = np.pad(rays, ((0, bucket - n), (0, 0)))
+        out = self._dispatch(rays_b, bucket, family)
+        out = {
+            k: np.asarray(v).reshape((-1,) + v.shape[2:])[:n]
+            for k, v in out.items()
+        }
+        trunc = out.pop("truncated", None)
+        if trunc is not None:
+            self.n_truncated += int(np.sum(trunc))
+        return out
+
+    def bucket_for(self, n_rays: int) -> int:
+        """Smallest bucket holding ``n_rays`` (largest for oversize tails —
+        callers split)."""
+        for b in self.buckets:
+            if n_rays <= b:
+                return b
+        return self.buckets[-1]
+
+    def render_flat(self, rays, family: str = "full") -> tuple[dict, dict]:
+        """Render a flat [N, C] ray array through the bucketed executables.
+
+        Oversize requests stream through repeated largest-bucket calls; the
+        tail lands in the smallest bucket that holds it. Returns
+        ``(outputs, info)`` — outputs are host numpy [N, ...] arrays, info
+        reports the padded-ray accounting the occupancy telemetry needs.
+        """
+        rays = np.asarray(rays, np.float32)
+        if rays.ndim != 2:
+            raise ValueError(f"rays must be [N, C], got shape {rays.shape}")
+        n = rays.shape[0]
+        largest = self.buckets[-1]
+        pieces, used = [], []
+        i = 0
+        while n - i > largest:
+            pieces.append(self._render_bucket(rays[i:i + largest], largest,
+                                              family))
+            used.append(largest)
+            i += largest
+        bucket = self.bucket_for(n - i)
+        pieces.append(self._render_bucket(rays[i:], bucket, family))
+        used.append(bucket)
+
+        out = pieces[0] if len(pieces) == 1 else {
+            k: np.concatenate([p[k] for p in pieces], axis=0)
+            for k in pieces[0]
+        }
+        bucket_rays = int(sum(used))
+        self.n_rays_rendered += n
+        self.n_pad_rays += bucket_rays - n
+        info = {
+            "n_rays": n,
+            "bucket_rays": bucket_rays,
+            "buckets": used,
+            "occupancy": n / bucket_rays if bucket_rays else 0.0,
+        }
+        return out, info
+
+    def render_request(self, rays, near, far, tier: str = "full",
+                       emit: bool = True) -> dict:
+        """Render one request at ``tier``; bounds must match the baked ones.
+
+        ``half_res`` renders every 2nd ray and nearest-neighbor expands the
+        outputs back to the request length, so callers always get [N, ...]
+        arrays regardless of tier. The served tier rides in the returned
+        dict under ``"tier"``."""
+        check_baked_bounds(self.near, self.far, near, far,
+                           surface="serve engine")
+        family, stride = TIER_IMPL[tier]
+        rays = np.asarray(rays, np.float32)
+        n = rays.shape[0]
+        t0 = time.perf_counter()
+        out, info = self.render_flat(rays[::stride], family)
+        if stride > 1:
+            out = {
+                k: np.repeat(v, stride, axis=0)[:n] for k, v in out.items()
+            }
+        latency = time.perf_counter() - t0
+        self.n_requests += 1
+        if emit:
+            get_emitter().emit(
+                "serve_request",
+                latency_s=latency,
+                n_rays=n,
+                tier=tier,
+                status="ok",
+                n_buckets=len(info["buckets"]),
+                bucket_rays=info["bucket_rays"],
+            )
+        out["tier"] = tier
+        return out
+
+    def render_view(self, c2w, H: int, W: int, focal: float,
+                    tier: str = "full", via=None) -> tuple[np.ndarray, dict]:
+        """Pose -> uint8 [H, W, 3] image through the pose LRU cache.
+
+        ``via(rays, near, far) -> out dict`` overrides the render path —
+        the HTTP entrypoint passes the micro-batcher's submitting closure
+        so concurrent views coalesce; default is a direct engine render at
+        ``tier``."""
+        key = self.cache.key(c2w, H, W, focal)
+        t0 = time.perf_counter()
+        cached = self.cache.get(key)
+        if cached is not None:
+            image, served_tier = cached
+            get_emitter().emit(
+                "serve_request",
+                latency_s=time.perf_counter() - t0,
+                n_rays=H * W,
+                tier=served_tier,
+                status="ok",
+                cache_hit=True,
+            )
+            return image, {"tier": served_tier, "cache_hit": True}
+
+        from ..datasets.rays import get_rays_np
+
+        rays_o, rays_d = get_rays_np(H, W, float(focal), np.asarray(c2w))
+        rays = np.concatenate([rays_o, rays_d], -1).reshape(-1, 6)
+        if via is not None:
+            out = via(rays, self.near, self.far)
+        else:
+            out = self.render_request(rays, self.near, self.far, tier=tier,
+                                      emit=True)
+        served_tier = out.get("tier", tier)
+        rgb_key = "rgb_map_f" if "rgb_map_f" in out else "rgb_map_c"
+        rgb = np.clip(np.asarray(out[rgb_key]).reshape(H, W, 3), 0.0, 1.0)
+        image = (rgb * 255).astype(np.uint8)
+        self.cache.put(key, (image, served_tier))
+        return image, {"tier": served_tier, "cache_hit": False}
+
+    # -- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "buckets": list(self.buckets),
+            "chunk": self.chunk,
+            "use_grid": self.use_grid,
+            "near": self.near,
+            "far": self.far,
+            "n_requests": self.n_requests,
+            "n_rays_rendered": self.n_rays_rendered,
+            "n_pad_rays": self.n_pad_rays,
+            "n_truncated": self.n_truncated,
+            "compiles": self.tracker.counts(),
+            "total_compiles": self.tracker.total_compiles(),
+            "warmup_compiles": self.warmup_compiles,
+            "cache": self.cache.stats(),
+        }
+
+
+def engine_from_cfg(cfg, cfg_file: str | None = None) -> RenderEngine:
+    """Boot a serving engine from a trained experiment's config.
+
+    Checkpoint weights via the shared eval bootstrap; near/far baked from
+    the test dataset; the occupancy grid loaded when
+    ``task_arg.accelerated_renderer`` is set and a baked artifact exists
+    (missing grid falls back to the chunked volume path, matching the
+    one-shot surfaces)."""
+    from ..datasets import make_dataset
+    from ..renderer.occupancy import default_grid_path, load_occupancy_grid
+    from ..utils.setup import load_trained_network
+
+    network, params, _ = load_trained_network(cfg)
+    test_ds = make_dataset(cfg, "test")
+    grid = bbox = None
+    if bool(cfg.task_arg.get("accelerated_renderer", False)):
+        import os
+
+        path = default_grid_path(cfg_file or "config")
+        if os.path.exists(path):
+            grid, bbox = load_occupancy_grid(path)
+        else:
+            print(f"occupancy grid not found at {path}; "
+                  "serving through the chunked volume path")
+    engine = RenderEngine(
+        cfg, network, params, near=test_ds.near, far=test_ds.far,
+        grid=grid, bbox=bbox,
+    )
+    # camera defaults for pose-only requests (the HTTP surface)
+    engine.default_camera = {
+        "H": int(test_ds.H), "W": int(test_ds.W), "focal": float(test_ds.focal),
+    }
+    return engine

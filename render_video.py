@@ -22,12 +22,15 @@ RADIUS = 4.0
 
 def spiral_frames(renderer, params, H, W, focal, near, far, n_frames=N_FRAMES,
                   phi_deg=PHI_DEG, radius=RADIUS, progress=True,
-                  render_fn=None):
+                  render_fn=None, engine=None):
     """Render the 360° spiral as a list of uint8 [H, W, 3] frames.
 
-    ``render_fn`` overrides the per-frame renderer (e.g. the shared gate's
-    sequence-parallel path on a pod); defaults to the occupancy-accelerated
-    single-device march."""
+    ``engine`` routes every frame through a warm serve-engine session
+    (nerf_replication_tpu/serve): all frames reuse the same bucketed
+    executable and per-frame ``serve_request`` telemetry is emitted.
+    ``render_fn`` instead overrides the per-frame renderer (the shared
+    gate's sequence-parallel path on a pod); with neither, the
+    occupancy-accelerated single-device march."""
     from nerf_replication_tpu.datasets.rays import get_rays_np, pose_spherical
 
     if render_fn is None:
@@ -40,6 +43,10 @@ def spiral_frames(renderer, params, H, W, focal, near, far, n_frames=N_FRAMES,
     frames = []
     for theta in thetas:
         c2w = pose_spherical(float(theta), phi_deg, radius)
+        if engine is not None:
+            image, _ = engine.render_view(c2w, H, W, focal)
+            frames.append(image)
+            continue
         rays_o, rays_d = get_rays_np(H, W, focal, c2w)
         rays = np.concatenate([rays_o, rays_d], -1).reshape(-1, 6)
         batch = {"rays": rays, "near": np.float32(near), "far": np.float32(far)}
@@ -52,7 +59,12 @@ def spiral_frames(renderer, params, H, W, focal, near, far, n_frames=N_FRAMES,
 
 
 def render_360_video(cfg, args=None):
+    import time
+
+    import jax
+
     from nerf_replication_tpu.datasets import make_dataset
+    from nerf_replication_tpu.obs import init_run
     from nerf_replication_tpu.renderer import make_renderer
     from nerf_replication_tpu.renderer.occupancy import default_grid_path
     from nerf_replication_tpu.utils.setup import load_trained_network
@@ -66,19 +78,63 @@ def render_360_video(cfg, args=None):
         renderer.load_occupancy_grid(default_grid_path(args.cfg_file))
 
     test_ds = make_dataset(cfg, "test")
-    # the shared whole-image gate: single-device by default, sequence-
-    # parallel over the mesh under ``eval.sharded: true`` (renderer/gate.py)
-    from nerf_replication_tpu.renderer.gate import full_image_render_fn
-
-    render_fn = full_image_render_fn(
-        cfg, network, renderer, test_ds, use_grid=use_grid
+    n_frames = int(cfg.task_arg.get("video_frames", N_FRAMES))
+    sharded = (
+        bool(cfg.get("eval", {}).get("sharded", False))
+        and jax.device_count() > 1
     )
+
+    emitter = init_run(cfg, component="render_video")
+    engine = render_fn = None
+    if sharded:
+        # pods render through the shared sequence-parallel gate; the serve
+        # engine is a single-device surface (renderer/gate.py)
+        from nerf_replication_tpu.renderer.gate import full_image_render_fn
+
+        render_fn = full_image_render_fn(
+            cfg, network, renderer, test_ds, use_grid=use_grid
+        )
+    else:
+        # serve-engine session: one warm bucketed executable renders every
+        # spiral frame; compile rows + per-frame serve_request telemetry
+        # land in the run's stream (emitter is live BEFORE warm-up so the
+        # warm-up compiles are on the record)
+        from nerf_replication_tpu.serve import RenderEngine
+
+        engine = RenderEngine(
+            cfg, network, params,
+            near=test_ds.near, far=test_ds.far,
+            grid=renderer.occupancy_grid if use_grid else None,
+            bbox=renderer.grid_bbox if use_grid else None,
+            warmup_families=("full",),  # the spiral never serves degraded
+        )
+    t0 = time.perf_counter()
     frames = spiral_frames(
         renderer, params, test_ds.H, test_ds.W, test_ds.focal,
         test_ds.near, test_ds.far,
-        n_frames=int(cfg.task_arg.get("video_frames", N_FRAMES)),
+        n_frames=n_frames,
         render_fn=render_fn,
+        engine=engine,
     )
+    wall = time.perf_counter() - t0
+    fps = len(frames) / wall if wall else 0.0
+    if engine is not None:
+        stats = engine.stats()
+        print(
+            f"rendered {len(frames)} frames at {fps:.2f} fps through "
+            f"{len(stats['compiles'])} warm executables "
+            f"({stats['total_compiles']} compiles total, "
+            f"{stats['n_truncated']} truncated rays)"
+        )
+    emitter.emit(
+        "eval",
+        prefix="video",
+        metrics={},
+        n_images=len(frames),
+        mean_net_time_s=wall / len(frames) if frames else 0.0,
+        fps=fps,
+    )
+    emitter.close()
     os.makedirs(cfg.result_dir, exist_ok=True)
     out_path = _write_video(os.path.join(cfg.result_dir, "video"), frames)
     print(f"video saved to {out_path}")

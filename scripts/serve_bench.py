@@ -1,0 +1,291 @@
+"""Closed/open-loop load generator for the render-serving engine.
+
+Boots a self-contained tiny scene + randomly-initialized network (no
+checkpoint or dataset download needed), a synthetic occupancy grid, and a
+full engine + micro-batcher stack, then drives a MIXED-SHAPE request
+stream at it:
+
+* **closed loop** — one outstanding request at a time (a single
+  well-behaved client): measures the floor latency including the
+  batcher's max-delay deadline.
+* **open loop** — requests arrive on a fixed-rate pacer regardless of
+  completions (heavy-traffic shape): measures coalescing, batch
+  occupancy, and the degradation policy under real backlog.
+
+Every run appends one summary row per mode to ``BENCH_SERVE.jsonl``
+(family ``serve_mode`` — scripts/check_telemetry_schema.py validates it)
+and writes full ``serve_request``/``serve_batch``/``serve_shed`` telemetry
+through the obs emitter, so ``scripts/tlm_report.py`` can break the run
+down. The headline acceptance number is ``compiles_steady``: the obs
+CompileTracker count accumulated AFTER warmup across the whole mixed-shape
+stream — it must be zero (the shape buckets absorb every request shape).
+
+    python scripts/serve_bench.py --backend cpu
+    python scripts/serve_bench.py --backend cpu --mode open --rate 200
+    python scripts/tlm_report.py data/record/serve_bench
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+NEAR, FAR = 2.0, 6.0
+
+
+def _bench_cfg(scene_root: str, args):
+    """A miniature lego-schema config sized for the bench backend."""
+    from nerf_replication_tpu.config import make_cfg
+
+    return make_cfg(
+        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        [
+            "scene", "procedural",
+            "exp_name", "serve_bench",
+            "train_dataset.data_root", scene_root,
+            "test_dataset.data_root", scene_root,
+            "train_dataset.H", "16", "train_dataset.W", "16",
+            "test_dataset.H", "16", "test_dataset.W", "16",
+            "task_arg.N_samples", "24",
+            "task_arg.N_importance", "24",
+            "network.nerf.W", "64",
+            "network.nerf.D", "3",
+            "network.nerf.skips", "[1]",
+            "network.xyz_encoder.freq", "6",
+            "network.dir_encoder.freq", "2",
+            "task_arg.render_step_size", "0.25",
+            "task_arg.max_march_samples", "16",
+            "task_arg.march_chunk_size", str(args.chunk),
+            "serve.buckets", str(list(args.buckets)),
+            "serve.max_batch_rays", str(args.max_batch_rays),
+            "serve.max_delay_ms", str(args.max_delay_ms),
+            "serve.request_timeout_s", "30.0",
+            "serve.shed_queue_depths", str(list(args.shed_depths)),
+            "record_dir", args.record_dir,
+        ],
+    )
+
+
+def _build_stack(args):
+    """(engine, batcher) on a procedural scene with a synthetic box grid."""
+    import numpy as np
+
+    import jax
+
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+    from nerf_replication_tpu.models import init_params_for, make_network
+    from nerf_replication_tpu.serve import MicroBatcher, RenderEngine
+
+    scene_root = os.path.join(args.workdir, "scene")
+    if not os.path.exists(os.path.join(scene_root, "transforms_train.json")):
+        generate_scene(scene_root, scene="procedural", H=16, W=16,
+                       n_train=4, n_test=1)
+    cfg = _bench_cfg(scene_root, args)
+    network = make_network(cfg)
+    params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True  # occupied box mid-scene
+
+    from nerf_replication_tpu.obs import init_run
+
+    # explicit path: parse_cfg specializes cfg.record_dir per experiment;
+    # the bench wants its telemetry exactly where --record-dir says
+    init_run(cfg, component="serve_bench",
+             path=os.path.join(args.record_dir, "telemetry.jsonl"))
+    t0 = time.perf_counter()
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox)
+    warmup_s = time.perf_counter() - t0
+    batcher = MicroBatcher(engine)
+    return cfg, engine, batcher, warmup_s
+
+
+def _request_stream(rng, n_requests: int, min_rays: int, max_rays: int):
+    """Mixed-shape ray batches: random counts, random view jitter."""
+    import numpy as np
+
+    sizes = rng.integers(min_rays, max_rays + 1, size=n_requests)
+    for n in sizes:
+        d = np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (int(n), 3))
+        o = np.tile([0.0, 0.0, 4.0], (int(n), 1))
+        yield np.concatenate([o, d], -1).astype(np.float32)
+
+
+def _percentile(values, q):
+    ordered = sorted(values)
+    if not ordered:
+        return None
+    idx = min(len(ordered) - 1,
+              max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[idx]
+
+
+def _run_closed(batcher, rng, args) -> dict:
+    lats = []
+    t_start = time.perf_counter()
+    for rays in _request_stream(rng, args.requests, args.min_rays,
+                                args.max_rays):
+        t0 = time.perf_counter()
+        batcher.submit(rays, NEAR, FAR).result(timeout=60.0)
+        lats.append(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_start
+    return {"latencies_s": lats, "wall_s": wall}
+
+
+def _run_open(batcher, rng, args) -> dict:
+    """Fixed-rate arrivals; latency measured submit -> result per request."""
+    import threading
+
+    futures, stamps = [], []
+    interval = 1.0 / max(args.rate, 1e-6)
+
+    def submitter():
+        next_t = time.perf_counter()
+        for rays in _request_stream(rng, args.requests, args.min_rays,
+                                    args.max_rays):
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            stamps.append(time.perf_counter())
+            futures.append(batcher.submit(rays, NEAR, FAR))
+            next_t += interval
+
+    t_start = time.perf_counter()
+    th = threading.Thread(target=submitter)
+    th.start()
+    th.join()
+    lats, timeouts = [], 0
+    for t0, f in zip(stamps, futures):
+        try:
+            f.result(timeout=60.0)
+            lats.append(time.perf_counter() - t0)
+        except TimeoutError:
+            timeouts += 1
+    wall = time.perf_counter() - t_start
+    return {"latencies_s": lats, "wall_s": wall, "timeouts": timeouts}
+
+
+def _snapshot(engine, batcher) -> dict:
+    """Counter snapshot so per-mode rows report deltas, not totals (one
+    engine/batcher serves every mode of a run)."""
+    return {
+        "rendered": engine.n_rays_rendered,
+        "pad": engine.n_pad_rays,
+        "shed": batcher.n_shed,
+        "timeouts": batcher.n_timeouts,
+        "batches": batcher.n_batches,
+    }
+
+
+def _summary_row(mode: str, run: dict, engine, batcher, args,
+                 compiles_steady: int, warmup_s: float,
+                 before: dict) -> dict:
+    lats = run["latencies_s"]
+    rendered = engine.n_rays_rendered - before["rendered"]
+    padded = rendered + engine.n_pad_rays - before["pad"]
+    return {
+        "serve_mode": mode,
+        "n_requests": len(lats),
+        "p50_ms": (_percentile(lats, 50) or 0.0) * 1e3,
+        "p95_ms": (_percentile(lats, 95) or 0.0) * 1e3,
+        "p99_ms": (_percentile(lats, 99) or 0.0) * 1e3,
+        "rps": len(lats) / run["wall_s"] if run["wall_s"] else 0.0,
+        "occupancy": rendered / padded if padded else 0.0,
+        "shed": batcher.n_shed - before["shed"],
+        "timeouts": batcher.n_timeouts - before["timeouts"],
+        "n_batches": batcher.n_batches - before["batches"],
+        "compiles_warmup": engine.warmup_compiles,
+        "compiles_steady": compiles_steady,
+        "warmup_s": warmup_s,
+        "backend": args.backend,
+        "buckets": list(engine.buckets),
+        "max_batch_rays": args.max_batch_rays,
+        "max_delay_ms": args.max_delay_ms,
+        "rate": args.rate if mode == "open" else None,
+        "seed": args.seed,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="serving-engine load generator")
+    p.add_argument("--backend", default="cpu",
+                   help="platform pin ('cpu', 'cpu:8', 'tpu'; '' = inherit)")
+    p.add_argument("--mode", default="both",
+                   choices=("closed", "open", "both"))
+    p.add_argument("--requests", type=int, default=80)
+    p.add_argument("--rate", type=float, default=100.0,
+                   help="open-loop arrivals per second")
+    p.add_argument("--min-rays", type=int, default=64)
+    p.add_argument("--max-rays", type=int, default=2048)
+    p.add_argument("--buckets", type=int, nargs="+", default=[512, 2048])
+    p.add_argument("--chunk", type=int, default=256)
+    p.add_argument("--max-batch-rays", type=int, default=4096)
+    p.add_argument("--max-delay-ms", type=float, default=5.0)
+    p.add_argument("--shed-depths", type=int, nargs="+", default=[8, 32, 96])
+    p.add_argument("--workdir", default=os.path.join(_REPO, "data",
+                                                     "serve_bench"))
+    p.add_argument("--record-dir", default=os.path.join(_REPO, "data",
+                                                        "record",
+                                                        "serve_bench"))
+    p.add_argument("--out", default=os.path.join(_REPO, "BENCH_SERVE.jsonl"))
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero if any post-warmup recompile happened")
+    args = p.parse_args(argv)
+
+    if args.backend:
+        from nerf_replication_tpu.utils.platform import (
+            force_platform,
+            parse_platform_pin,
+        )
+
+        force_platform(*parse_platform_pin(args.backend))
+
+    import numpy as np
+
+    from nerf_replication_tpu.obs import append_jsonl, get_emitter
+
+    cfg, engine, batcher, warmup_s = _build_stack(args)
+    print(f"engine warm: buckets {list(engine.buckets)}, "
+          f"{engine.warmup_compiles} executables in {warmup_s:.1f}s")
+
+    modes = ("closed", "open") if args.mode == "both" else (args.mode,)
+    failed = False
+    try:
+        for mode in modes:
+            rng = np.random.default_rng(args.seed)
+            before = _snapshot(engine, batcher)
+            steady_base = engine.tracker.total_compiles()
+            run = (_run_closed if mode == "closed" else _run_open)(
+                batcher, rng, args
+            )
+            compiles_steady = engine.tracker.total_compiles() - steady_base
+            row = _summary_row(mode, run, engine, batcher, args,
+                               compiles_steady, warmup_s, before)
+            append_jsonl(args.out, row)
+            print(
+                f"{mode}: n={row['n_requests']} p50={row['p50_ms']:.1f}ms "
+                f"p95={row['p95_ms']:.1f}ms p99={row['p99_ms']:.1f}ms "
+                f"rps={row['rps']:.1f} occupancy={row['occupancy']:.2f} "
+                f"shed={row['shed']} timeouts={row['timeouts']} "
+                f"recompiles_after_warmup={compiles_steady}"
+            )
+            if compiles_steady:
+                print(f"WARNING: {compiles_steady} post-warmup recompiles "
+                      "(shape escaped the buckets)")
+                failed = True
+    finally:
+        batcher.close()
+        get_emitter().close()
+    print(f"rows appended to {args.out}; telemetry in {args.record_dir}")
+    return 1 if (failed and args.strict) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -136,6 +136,38 @@ def summarize(rows: list[dict]) -> dict:
     block = [r["block_s"] for r in steps if r.get("block_s") is not None]
     summary["dispatch_p50_s"] = _percentile(dispatch, 50) if dispatch else None
     summary["block_p50_s"] = _percentile(block, 50) if block else None
+
+    # serving rows (nerf_replication_tpu/serve): request-latency tail,
+    # batch occupancy, shed/timeout counts, cache hit rate — keys present
+    # only when the run actually served (training runs stay unchanged)
+    serve_reqs = [r for r in rows if r.get("kind") == "serve_request"]
+    if serve_reqs:
+        ok = [r for r in serve_reqs if r.get("status", "ok") == "ok"]
+        lats = [float(r["latency_s"]) for r in ok if "latency_s" in r]
+        batches = [r for r in rows if r.get("kind") == "serve_batch"]
+        occ = [float(r["occupancy"]) for r in batches if "occupancy" in r]
+        tiers: dict = {}
+        for r in ok:
+            tier = r.get("tier", "full")
+            tiers[tier] = tiers.get(tier, 0) + 1
+        summary["serve_requests"] = len(serve_reqs)
+        summary["serve_latency_p50_s"] = _percentile(lats, 50) if lats else None
+        summary["serve_latency_p95_s"] = _percentile(lats, 95) if lats else None
+        summary["serve_latency_p99_s"] = _percentile(lats, 99) if lats else None
+        summary["serve_batches"] = len(batches)
+        summary["serve_batch_occupancy"] = (
+            sum(occ) / len(occ) if occ else None
+        )
+        summary["serve_shed_count"] = sum(
+            1 for r in rows if r.get("kind") == "serve_shed"
+        )
+        summary["serve_timeout_count"] = sum(
+            1 for r in serve_reqs if r.get("status") == "timeout"
+        )
+        summary["serve_cache_hit_rate"] = (
+            sum(1 for r in ok if r.get("cache_hit")) / len(ok) if ok else None
+        )
+        summary["serve_tiers"] = tiers
     return summary
 
 
@@ -171,6 +203,24 @@ def print_summary(summary: dict, label: str = "") -> None:
     psnr = summary["final_psnr"]
     print(f"  final psnr:    {psnr:.3f}" if psnr is not None
           else "  final psnr:    n/a")
+    if summary.get("serve_requests"):
+        print(f"  serve:         {summary['serve_requests']} requests in "
+              f"{summary['serve_batches']} batches")
+        print(f"    latency:     p50 {_fmt_s(summary['serve_latency_p50_s'])}"
+              f"  p95 {_fmt_s(summary['serve_latency_p95_s'])}"
+              f"  p99 {_fmt_s(summary['serve_latency_p99_s'])}")
+        occ = summary.get("serve_batch_occupancy")
+        print(f"    occupancy:   "
+              + (f"{occ * 100:.1f}%" if occ is not None else "n/a")
+              + f"  shed: {summary['serve_shed_count']}"
+              f"  timeouts: {summary['serve_timeout_count']}")
+        hit = summary.get("serve_cache_hit_rate")
+        tiers = " ".join(
+            f"{k}:{v}" for k, v in sorted(summary["serve_tiers"].items())
+        )
+        print(f"    cache hits:  "
+              + (f"{hit * 100:.1f}%" if hit is not None else "n/a")
+              + f"  tiers: {tiers or 'n/a'}")
 
 
 def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:

@@ -1,0 +1,534 @@
+"""Serving subsystem (nerf_replication_tpu/serve): bucketed executables
+bitwise-match the unbatched renderer, mixed shapes never retrace, the
+micro-batcher fires on both deadline edges and scatters per request,
+degradation tiers activate deterministically under synthetic queue depth,
+the pose cache hits/misses/evicts, and the HTTP + bench + report surfaces
+round-trip. All CPU, tiny fake network — no real training."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.datasets.rays import pose_spherical
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.obs import init_run, validate_row
+from nerf_replication_tpu.obs.emit import Emitter
+from nerf_replication_tpu.renderer.gate import (
+    BakedBoundsError,
+    check_baked_bounds,
+)
+from nerf_replication_tpu.renderer.volume import make_renderer
+from nerf_replication_tpu.serve import (
+    DegradationPolicy,
+    MicroBatcher,
+    PoseCache,
+    RenderEngine,
+    ServeTimeoutError,
+)
+
+NEAR, FAR = 2.0, 6.0
+
+
+class FakeClock:
+    """Injectable monotonic clock for deterministic deadline tests."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _rays(n: int, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.concatenate(
+        [
+            np.tile([0.0, 0.0, 4.0], (n, 1)),
+            np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3)),
+        ],
+        -1,
+    ).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_serve"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4, n_test=1)
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "64",
+         "serve.buckets", "[128, 256]",
+         "serve.max_batch_rays", "256",
+         "serve.max_delay_ms", "40.0",
+         "serve.request_timeout_s", "5.0",
+         "serve.cache_entries", "4",
+         "serve.pose_decimals", "3",
+         "serve.shed_queue_depths", "[2, 4, 6]"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox)
+    return cfg, network, params, grid, bbox, engine
+
+
+# -- engine: buckets, parity, retraces ---------------------------------------
+
+
+def test_bucketed_render_bitwise_matches_render_accelerated(setup):
+    """The acceptance contract: a request padded into a bucket composites
+    BITWISE-identically to the one-shot Renderer.render_accelerated path
+    on the real rows — padding must be invisible, not just close."""
+    cfg, network, params, grid, bbox, engine = setup
+    renderer = make_renderer(cfg, network)
+    renderer.occupancy_grid = jnp.asarray(grid)
+    renderer.grid_bbox = jnp.asarray(bbox)
+    for n in (37, 100, 128, 200, 256):
+        rays = _rays(n)
+        ref = renderer.render_accelerated(
+            params,
+            {"rays": jnp.asarray(rays), "near": np.float32(NEAR),
+             "far": np.float32(FAR)},
+        )
+        out = engine.render_request(rays, NEAR, FAR, tier="full", emit=False)
+        for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+            assert np.array_equal(np.asarray(ref[k]), out[k]), (k, n)
+
+
+def test_mixed_shapes_never_retrace_after_warmup(setup):
+    """Every request shape pads into a pre-warmed bucket: the obs
+    CompileTracker total must not move across a mixed stream covering
+    bucket edges, oversize splits, and every tier."""
+    cfg, network, params, grid, bbox, engine = setup
+    assert engine.warmup_compiles > 0
+    before = engine.tracker.total_compiles()
+    for n in (1, 63, 64, 65, 127, 128, 129, 255, 256, 300, 513, 777):
+        rays = _rays(min(n, 256))
+        rays = np.tile(rays, (-(-n // rays.shape[0]), 1))[:n]
+        for tier in ("full", "reduced_k", "coarse", "half_res"):
+            out = engine.render_request(rays, NEAR, FAR, tier=tier,
+                                        emit=False)
+            assert out["rgb_map_f"].shape == (n, 3)
+    assert engine.tracker.total_compiles() == before
+
+
+def test_bucket_selection_and_oversize_split(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    assert engine.buckets == (128, 256)
+    assert engine.bucket_for(1) == 128
+    assert engine.bucket_for(128) == 128
+    assert engine.bucket_for(129) == 256
+    out, info = engine.render_flat(_rays(600), "full")
+    # 600 = 256 + 256 + 88 -> two largest buckets + the 128 tail bucket
+    assert info["buckets"] == [256, 256, 128]
+    assert info["bucket_rays"] == 640
+    assert out["rgb_map_f"].shape == (600, 3)
+    assert 0.0 < info["occupancy"] <= 1.0
+
+
+def test_half_res_tier_is_strided_coarse_expanded_back(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    rays = _rays(101)
+    half = engine.render_request(rays, NEAR, FAR, tier="half_res", emit=False)
+    coarse = engine.render_request(rays[::2], NEAR, FAR, tier="coarse",
+                                   emit=False)
+    assert half["rgb_map_f"].shape == (101, 3)
+    np.testing.assert_array_equal(
+        half["rgb_map_f"], np.repeat(coarse["rgb_map_f"], 2, axis=0)[:101]
+    )
+
+
+# -- baked-bounds error (gate satellite) -------------------------------------
+
+
+def test_check_baked_bounds_f32_tolerant_and_names_both_sides():
+    # equal bounds that aren't exactly f32-representable must pass
+    check_baked_bounds(0.1, 0.3, np.float32(0.1), np.float32(0.3))
+    with pytest.raises(BakedBoundsError) as err:
+        check_baked_bounds(2.0, 6.0, 2.0, 7.5, surface="unit test")
+    msg = str(err.value)
+    # ONE error naming both the baked and the requested bounds
+    assert "unit test" in msg
+    assert "baked bounds near=2 far=6" in msg
+    assert "requested bounds near=2 far=7.5" in msg
+    # backward compatible: existing handlers catch ValueError
+    assert isinstance(err.value, ValueError)
+
+
+def test_engine_and_batcher_reject_mismatched_bounds(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    with pytest.raises(BakedBoundsError, match="serve engine"):
+        engine.render_request(_rays(8), NEAR, FAR + 1.0)
+    batcher = MicroBatcher(engine, start=False)
+    with pytest.raises(BakedBoundsError, match="micro-batcher"):
+        batcher.submit(_rays(8), NEAR + 0.5, FAR)
+    assert batcher.queue_depth() == 0  # bad requests never occupy the queue
+
+
+# -- degradation policy ------------------------------------------------------
+
+
+def test_policy_tiers_deterministic():
+    policy = DegradationPolicy(thresholds=(2, 4, 6))
+    assert policy.tier_for(0) == "full"
+    assert policy.tier_for(1) == "full"
+    assert policy.tier_for(2) == "reduced_k"
+    assert policy.tier_for(4) == "coarse"
+    assert policy.tier_for(6) == "half_res"
+    assert policy.tier_for(1000) == "half_res"  # saturates, never IndexError
+    with pytest.raises(ValueError, match="ascending"):
+        DegradationPolicy(thresholds=(4, 2))
+
+
+def test_degradation_under_synthetic_queue_depth(setup):
+    """Backlog at drain time selects the tier: leave N requests queued
+    behind the cut batch and the batch serves at the policy's tier for
+    depth N — recorded in each response."""
+    cfg, network, params, grid, bbox, engine = setup
+    for backlog, expected in ((0, "full"), (2, "reduced_k"),
+                              (4, "coarse"), (6, "half_res")):
+        clock = FakeClock()
+        batcher = MicroBatcher(engine, clock=clock, start=False)
+        futures = [batcher.submit(_rays(256), NEAR, FAR)]  # fills max_batch
+        for _ in range(backlog):
+            futures.append(batcher.submit(_rays(256), NEAR, FAR))
+        batcher.pump()
+        out = futures[0].result(timeout=1.0)
+        assert out["tier"] == expected, backlog
+        assert out["rgb_map_f"].shape == (256, 3)
+        assert np.isfinite(out["rgb_map_f"]).all()
+        assert (batcher.n_shed == 0) == (expected == "full")
+
+
+# -- micro-batcher edges -----------------------------------------------------
+
+
+def test_max_batch_edge_fires_without_waiting(setup):
+    """Pending rays >= max_batch_rays cuts a batch immediately (fake clock
+    never advances, so the delay edge cannot be the trigger) and takes
+    whole requests up to the ray budget."""
+    cfg, network, params, grid, bbox, engine = setup
+    clock = FakeClock()
+    batcher = MicroBatcher(engine, clock=clock, start=False)
+    f1 = batcher.submit(_rays(128), NEAR, FAR)
+    f2 = batcher.submit(_rays(128), NEAR, FAR)
+    f3 = batcher.submit(_rays(128), NEAR, FAR)
+    completed = batcher.pump()
+    assert completed == 2  # 128+128 fills the 256 budget; f3 stays queued
+    assert f1.done() and f2.done() and not f3.done()
+    assert batcher.queue_depth() == 1
+    assert batcher.n_batches == 1
+    # each request got ITS slice back
+    r1 = f1.result(timeout=1.0)
+    solo = engine.render_request(_rays(128), NEAR, FAR, emit=False)
+    np.testing.assert_array_equal(r1["rgb_map_f"], solo["rgb_map_f"])
+    clock.advance(1.0)  # f3 alone can only fire on the delay edge
+    batcher.pump()
+    assert f3.done() and batcher.queue_depth() == 0
+
+
+def test_max_delay_edge_fires_for_a_lone_request(setup):
+    """A single small request must not wait for max_batch: the worker
+    thread serves it once max_delay (40 ms here) expires."""
+    cfg, network, params, grid, bbox, engine = setup
+    batcher = MicroBatcher(engine)  # real clock + worker thread
+    try:
+        t0 = time.perf_counter()
+        out = batcher.submit(_rays(16), NEAR, FAR).result(timeout=10.0)
+        elapsed = time.perf_counter() - t0
+        assert out["tier"] == "full"
+        assert out["rgb_map_f"].shape == (16, 3)
+        assert elapsed >= 0.03  # the delay deadline, not instant dispatch
+        assert batcher.n_batches == 1
+    finally:
+        batcher.close()
+
+
+def test_concurrent_requests_coalesce_into_one_batch(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    batcher = MicroBatcher(engine)
+    try:
+        f1 = batcher.submit(_rays(32), NEAR, FAR)
+        f2 = batcher.submit(_rays(48), NEAR, FAR)
+        r1, r2 = f1.result(timeout=10.0), f2.result(timeout=10.0)
+        assert batcher.n_batches == 1  # both rode the same 40 ms window
+        assert r1["rgb_map_f"].shape == (32, 3)
+        assert r2["rgb_map_f"].shape == (48, 3)
+    finally:
+        batcher.close()
+
+
+def test_request_timeout_fails_fast_without_rendering(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    clock = FakeClock()
+    batcher = MicroBatcher(engine, clock=clock, start=False)
+    stale = batcher.submit(_rays(8), NEAR, FAR)
+    clock.advance(6.0)  # past request_timeout_s=5 — also past max_delay
+    fresh = batcher.submit(_rays(8), NEAR, FAR)
+    rendered_before = engine.n_rays_rendered
+    batcher.pump()
+    with pytest.raises(ServeTimeoutError, match="waited"):
+        stale.result(timeout=1.0)
+    assert fresh.result(timeout=1.0)["rgb_map_f"].shape == (8, 3)
+    assert batcher.n_timeouts == 1
+    # the expired request's rays were never dispatched
+    assert engine.n_rays_rendered - rendered_before == 8
+
+
+# -- pose cache --------------------------------------------------------------
+
+
+def test_pose_cache_hit_miss_eviction():
+    cache = PoseCache(capacity=2, decimals=3)
+    poses = [pose_spherical(t, -30.0, 4.0) for t in (0.0, 40.0, 80.0)]
+    keys = [cache.key(p, 16, 16, 20.0) for p in poses]
+    assert cache.get(keys[0]) is None  # miss
+    cache.put(keys[0], "a")
+    cache.put(keys[1], "b")
+    assert cache.get(keys[0]) == "a"  # hit refreshes recency
+    cache.put(keys[2], "c")           # evicts LRU = keys[1]
+    assert cache.get(keys[1]) is None
+    assert cache.get(keys[0]) == "a" and cache.get(keys[2]) == "c"
+    s = cache.stats()
+    assert s["evictions"] == 1 and s["hits"] == 3 and s["misses"] == 2
+    # quantization: sub-decimal pose jitter lands on the same key
+    jittered = poses[0] + np.float32(1e-6)
+    assert cache.key(jittered, 16, 16, 20.0) == keys[0]
+    # different intrinsics are a different view
+    assert cache.key(poses[0], 32, 32, 20.0) != keys[0]
+    # capacity 0 disables
+    off = PoseCache(capacity=0)
+    off.put(b"k", "v")
+    assert off.get(b"k") is None and len(off) == 0
+
+
+def test_render_view_caches_repeated_poses(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    c2w = pose_spherical(30.0, -30.0, 4.0)
+    requests_before = engine.n_requests
+    img1, info1 = engine.render_view(c2w, 16, 16, 20.0)
+    assert not info1["cache_hit"]
+    img2, info2 = engine.render_view(c2w + np.float32(1e-6), 16, 16, 20.0)
+    assert info2["cache_hit"]
+    np.testing.assert_array_equal(img1, img2)
+    assert img1.dtype == np.uint8 and img1.shape == (16, 16, 3)
+    # the hit never touched the render path
+    assert engine.n_requests == requests_before + 1
+
+
+# -- telemetry ---------------------------------------------------------------
+
+
+def test_serve_rows_validate_against_schema(setup, tmp_path):
+    cfg, network, params, grid, bbox, engine = setup
+    path = str(tmp_path / "telemetry.jsonl")
+    emitter = init_run(cfg, component="serve_test", path=path)
+    try:
+        clock = FakeClock()
+        batcher = MicroBatcher(engine, clock=clock, start=False)
+        futures = [batcher.submit(_rays(256), NEAR, FAR) for _ in range(4)]
+        batcher.pump()  # sheds: depth 3 behind the cut >= threshold 2
+        while batcher.queue_depth():
+            clock.advance(1.0)
+            batcher.pump()
+        for f in futures:
+            f.result(timeout=1.0)
+        engine.render_request(_rays(10), NEAR, FAR, emit=True)
+    finally:
+        emitter.close()
+        init_run(cfg, component="noop", path=str(tmp_path / "t2.jsonl")).close()
+    rows = [json.loads(line) for line in open(path)]
+    kinds = {r["kind"] for r in rows}
+    assert {"serve_request", "serve_batch", "serve_shed"} <= kinds
+    for r in rows:
+        assert validate_row(r) == [], r
+    batch = next(r for r in rows if r["kind"] == "serve_batch")
+    assert 0.0 < batch["occupancy"] <= 1.0
+    shed = next(r for r in rows if r["kind"] == "serve_shed")
+    assert shed["tier"] in ("reduced_k", "coarse", "half_res")
+
+
+def test_tlm_report_summarizes_serve_rows(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import tlm_report
+
+    path = str(tmp_path / "telemetry.jsonl")
+    with Emitter(path, chief=True) as em:
+        em.emit("run_meta", run_id=em.run_id, component="serve",
+                config_hash="x", process_index=0, process_count=1,
+                device_count=1, local_device_count=1, platform="cpu")
+        for ms, tier in ((10, "full"), (20, "full"), (30, "reduced_k"),
+                         (500, "full")):
+            em.emit("serve_request", latency_s=ms / 1e3, n_rays=64,
+                    tier=tier, status="ok")
+        em.emit("serve_request", latency_s=9.0, n_rays=64, tier="none",
+                status="timeout")
+        em.emit("serve_batch", n_requests=3, n_rays=192, occupancy=0.75,
+                tier="full")
+        em.emit("serve_shed", tier="reduced_k", queue_depth=5)
+    summary = tlm_report.summarize(tlm_report.load_rows(path))
+    assert summary["serve_requests"] == 5
+    assert summary["serve_latency_p50_s"] == pytest.approx(0.03)
+    assert summary["serve_latency_p99_s"] == pytest.approx(0.5)
+    assert summary["serve_batch_occupancy"] == pytest.approx(0.75)
+    assert summary["serve_shed_count"] == 1
+    assert summary["serve_timeout_count"] == 1
+    assert summary["serve_tiers"] == {"full": 3, "reduced_k": 1}
+    # runs without serve rows keep the legacy summary shape
+    with Emitter(str(tmp_path / "t2.jsonl"), chief=True) as em:
+        em.emit("run_meta", run_id=em.run_id, component="train",
+                config_hash="x", process_index=0, process_count=1,
+                device_count=1, local_device_count=1, platform="cpu")
+    plain = tlm_report.summarize(tlm_report.load_rows(str(tmp_path / "t2.jsonl")))
+    assert "serve_requests" not in plain
+
+
+def test_serve_bench_rows_validate_as_bench_family():
+    from nerf_replication_tpu.obs.schema import validate_bench_row
+
+    row = {"serve_mode": "closed", "n_requests": 80, "p50_ms": 12.0,
+           "p95_ms": 30.0, "occupancy": 0.8, "compiles_steady": 0}
+    assert validate_bench_row(row) == []
+    assert validate_bench_row({"serve_mode": "open"})  # missing fields
+
+
+# -- HTTP entrypoint ---------------------------------------------------------
+
+
+def test_http_render_and_stats_roundtrip(setup):
+    import base64
+    import http.client
+
+    import serve as serve_cli
+
+    cfg, network, params, grid, bbox, engine = setup
+    engine.default_camera = {"H": 16, "W": 16, "focal": 20.0}
+    batcher = MicroBatcher(engine)
+    server = serve_cli.make_server(engine, batcher, port=0)
+    port = server.server_address[1]
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+
+        def post(body):
+            conn.request("POST", "/render", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+
+        status, out = post({"theta": 120.0, "phi": -30.0, "radius": 4.0})
+        assert status == 200
+        assert out["h"] == 16 and out["w"] == 16 and not out["cache_hit"]
+        rgb = np.frombuffer(base64.b64decode(out["rgb_b64"]), np.uint8)
+        assert rgb.size == 16 * 16 * 3
+
+        status, again = post({"theta": 120.0, "phi": -30.0, "radius": 4.0})
+        assert status == 200 and again["cache_hit"]
+        assert again["rgb_b64"] == out["rgb_b64"]
+
+        status, err = post({"phi": -30.0})  # no pose at all
+        assert status == 400 and "theta" in err["error"]
+
+        conn.request("GET", "/stats")
+        resp = conn.getresponse()
+        stats = json.loads(resp.read())
+        assert resp.status == 200
+        assert stats["batcher"]["n_completed"] >= 1
+        assert stats["total_compiles"] == stats["warmup_compiles"]
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        batcher.close()
+
+
+# -- render_video through the engine -----------------------------------------
+
+
+def test_render_video_routes_through_engine_session(setup, tmp_path):
+    """Spiral frames render through one warm serve-engine session: video
+    written, fps eval row + per-frame serve_request rows in telemetry, no
+    compile beyond the session's own warmup."""
+    import render_video
+
+    cfg, network, params, grid, bbox, _engine = setup
+    cfg = cfg.clone()
+    cfg.defrost()
+    cfg.task_arg.video_frames = 3
+    cfg.result_dir = str(tmp_path / "result")
+    cfg.record_dir = str(tmp_path / "record")
+    cfg.trained_model_dir = str(tmp_path / "nockpt")  # random init is fine
+    cfg.freeze()
+    out_path = render_video.render_360_video(cfg, args=None)
+    assert os.path.exists(out_path)
+    rows = [json.loads(line)
+            for line in open(os.path.join(cfg.record_dir, "telemetry.jsonl"))]
+    kinds = [r["kind"] for r in rows]
+    assert kinds.count("serve_request") == 3  # one per frame
+    evals = [r for r in rows if r["kind"] == "eval"]
+    assert evals and evals[-1]["prefix"] == "video"
+    assert evals[-1]["n_images"] == 3 and evals[-1]["fps"] > 0
+    # the session's executables compiled once, inside THIS run's telemetry
+    assert any(r["kind"] == "compile" for r in rows)
+
+
+# -- load generator (slow; excluded from tier-1) -----------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.serve_load
+def test_serve_bench_end_to_end(tmp_path):
+    """The acceptance run: a mixed-shape closed+open stream on the cpu
+    backend shows ZERO recompiles after warmup, and the BENCH_SERVE rows
+    pass the schema checker."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import check_telemetry_schema
+    import serve_bench
+
+    out = str(tmp_path / "BENCH_SERVE.jsonl")
+    rc = serve_bench.main([
+        "--backend", "",  # the test harness already pinned cpu
+        "--mode", "both", "--requests", "30", "--rate", "200",
+        "--min-rays", "32", "--max-rays", "600",
+        "--buckets", "128", "512", "--chunk", "64",
+        "--max-batch-rays", "1024", "--max-delay-ms", "3.0",
+        "--workdir", str(tmp_path / "work"),
+        "--record-dir", str(tmp_path / "record"),
+        "--out", out,
+        "--strict",
+    ])
+    assert rc == 0
+    rows = [json.loads(line) for line in open(out)]
+    assert {r["serve_mode"] for r in rows} == {"closed", "open"}
+    for r in rows:
+        assert r["compiles_steady"] == 0
+        assert r["n_requests"] == 30
+        assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
+    assert check_telemetry_schema.check_file(out) == []
+    telem = os.path.join(str(tmp_path / "record"), "telemetry.jsonl")
+    assert check_telemetry_schema.check_file(telem) == []

@@ -1,0 +1,56 @@
+"""graftlint: JAX-aware static analysis + runtime sanitizer for this repo.
+
+PR 1 made recompile storms, host-dispatch stalls, and HBM creep observable
+at runtime; this package catches them at review time. An AST engine
+(``core``) runs six codebase-tuned rules (``rules``: host-sync, retrace,
+donate, rng, side-effect, config-key) over the package and entrypoints,
+gated through a committed baseline of accepted legacy findings
+(``baseline``, ``graftlint_baseline.json``) so only NEW hazards fail.
+``scripts/graftlint.py`` is the CLI; tier-1 runs it via
+tests/test_analysis.py. The engine is jax-free by design — only the
+runtime ``sanitizer`` imports jax, lazily.
+
+See docs/static_analysis.md for the rule catalog, suppression syntax
+(``# graftlint: ok(rule: reason)``, ``# graftlint: hot``), and the
+baseline workflow.
+"""
+
+from .baseline import (
+    BASELINE_FILENAME,
+    diff_baseline,
+    load_baseline,
+    save_baseline,
+    to_baseline,
+    validate_baseline_data,
+)
+from .core import (
+    DEFAULT_SCAN,
+    RULE_IDS,
+    RULES,
+    Finding,
+    lint_paths,
+    lint_source,
+)
+from .reporters import render_json, render_text, rule_counts
+from .sanitizer import SanitizerError, SanitizerProbe, sanitizer
+
+__all__ = [
+    "BASELINE_FILENAME",
+    "DEFAULT_SCAN",
+    "Finding",
+    "RULES",
+    "RULE_IDS",
+    "SanitizerError",
+    "SanitizerProbe",
+    "diff_baseline",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "render_json",
+    "render_text",
+    "rule_counts",
+    "sanitizer",
+    "save_baseline",
+    "to_baseline",
+    "validate_baseline_data",
+]

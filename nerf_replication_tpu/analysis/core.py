@@ -1,0 +1,505 @@
+"""graftlint engine core: findings, suppressions, module context, registry.
+
+The engine is deliberately jax-free (pure stdlib ``ast`` + ``tokenize``)
+so the CI gate and the schema checker can run it anywhere — the same
+constraint ``scripts/check_telemetry_schema.py`` lives under. Rules are
+visitor-style checkers registered in :data:`RULES`; each receives a
+:class:`ModuleContext` (one parsed file plus its hot-path/jit analysis)
+or, for project-wide rules, the whole :class:`ProjectContext`.
+
+Two kinds of "hotness" drive the JAX-specific rules (docs/static_analysis.md):
+
+* **traced** — code that runs *inside* a jit trace: functions decorated
+  with ``jax.jit`` / ``partial(jax.jit, ...)``, functions wrapped by a
+  ``jax.jit(f)`` call in the same module, functions handed to
+  ``jax.lax.map`` / ``scan`` / ``vmap`` / ``grad`` from traced code, plus
+  everything reachable from those through the intra-module call graph.
+  Host syncs here are trace-time constants or errors; side effects leak
+  tracers.
+* **dispatch-hot** — host code on a per-step/per-request path, marked
+  ``# graftlint: hot`` on (or above) its ``def`` line, plus everything it
+  calls. Device pulls here (``np.asarray`` on executable outputs) stall
+  the dispatch pipeline.
+
+Suppressions are inline comments::
+
+    x = np.asarray(y)  # graftlint: ok(host-sync: scatter back to callers)
+    # graftlint: ok(rng)          <- on its own line: applies to the NEXT line
+    # graftlint: skip-file        <- first 10 lines: skips the whole file
+
+``ok()`` with no rule list suppresses every rule on that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+# rule id -> one-line description (registry filled by rules.py import)
+RULES: dict[str, "Rule"] = {}
+
+# R1..R6 short names used in findings, suppressions, and the baseline
+RULE_IDS = (
+    "host-sync",    # R1
+    "retrace",      # R2
+    "donate",       # R3
+    "rng",          # R4
+    "side-effect",  # R5
+    "config-key",   # R6
+)
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ok(?:\(([^)]*)\))?")
+_HOT_RE = re.compile(r"#\s*graftlint:\s*hot\b")
+_SKIP_FILE_RE = re.compile(r"#\s*graftlint:\s*skip-file\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``snippet`` (the stripped source line) rather than
+    the line number is the stable part of its identity — see baseline.py."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+class Rule:
+    """A registered checker. Subclasses set ``rule_id``/``doc`` and
+    implement ``check`` (per-module) or ``check_project`` (whole scan)."""
+
+    rule_id: str = ""
+    doc: str = ""
+    project_wide = False
+
+    def check(self, module: "ModuleContext") -> list[Finding]:
+        return []
+
+    def check_project(self, project: "ProjectContext") -> list[Finding]:
+        return []
+
+
+def register(rule_cls: type[Rule]) -> type[Rule]:
+    RULES[rule_cls.rule_id] = rule_cls()
+    return rule_cls
+
+
+# --------------------------------------------------------------------------
+# per-module analysis
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class FunctionInfo:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    qualname: str
+    name: str
+    cls: str | None  # enclosing class name, for self.method resolution
+    traced: bool = False  # runs inside a jit trace
+    hot: bool = False  # host-side per-step/per-request path
+    calls: set[str] = field(default_factory=set)  # callee names (bare / Cls.m)
+    local_names: set[str] = field(default_factory=set)
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """``jax.lax.map`` -> ["jax", "lax", "map"]; [] when not a pure chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` (from jax import jit) as an expression."""
+    chain = _attr_chain(node)
+    return chain in (["jax", "jit"], ["jit"]) or (
+        len(chain) == 2 and chain[1] == "jit" and chain[0] in ("jax", "jaxlib")
+    )
+
+
+def jit_call_of(node: ast.AST) -> ast.Call | None:
+    """The ``jax.jit(...)`` Call under ``node`` when node IS a jit
+    construction: ``jax.jit(f, ...)`` or ``partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Call):
+        if is_jit_expr(node.func):
+            return node
+        chain = _attr_chain(node.func)
+        if chain and chain[-1] == "partial" and node.args:
+            if is_jit_expr(node.args[0]):
+                return node
+    return None
+
+
+def jit_static_kwargs(call: ast.Call) -> dict[str, ast.expr]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+_TRACE_TAKERS = {
+    # jax transforms whose callable argument runs traced
+    ("jax", "lax", "map"), ("lax", "map"),
+    ("jax", "lax", "scan"), ("lax", "scan"),
+    ("jax", "lax", "cond"), ("lax", "cond"),
+    ("jax", "lax", "while_loop"), ("lax", "while_loop"),
+    ("jax", "lax", "fori_loop"), ("lax", "fori_loop"),
+    ("jax", "vmap"), ("vmap",),
+    ("jax", "pmap"), ("pmap",),
+    ("jax", "grad"), ("grad",),
+    ("jax", "value_and_grad"), ("value_and_grad",),
+    ("jax", "checkpoint",), ("jax", "remat"),
+    ("shard_map",),
+}
+
+
+class ModuleContext:
+    """One parsed file plus everything the rules need to know about it."""
+
+    def __init__(self, path: str, source: str, rel_path: str | None = None):
+        self.path = path
+        self.rel_path = rel_path or path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.skip_file = any(
+            _SKIP_FILE_RE.search(line) for line in self.lines[:10]
+        )
+        # line -> set of suppressed rule ids ("*" = all)
+        self.suppressions: dict[int, set[str]] = {}
+        self.hot_marker_lines: set[int] = set()
+        self._scan_comments()
+        self.functions: dict[str, FunctionInfo] = {}
+        self._jit_wrapped_names: set[str] = set()
+        self._collect_functions()
+        self._propagate()
+
+    # -- comments ------------------------------------------------------------
+    def _scan_comments(self) -> None:
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                spec = (m.group(1) or "").strip()
+                rules = {"*"}
+                if spec:
+                    # "rule1, rule2: free-text reason" — reason after ':'
+                    rule_part = spec.split(":", 1)[0]
+                    rules = {
+                        r.strip() for r in rule_part.split(",") if r.strip()
+                    } or {"*"}
+                # a bare-comment line suppresses the next line instead
+                target = i + 1 if line.split("#", 1)[0].strip() == "" else i
+                self.suppressions.setdefault(target, set()).update(rules)
+            if _HOT_RE.search(line):
+                self.hot_marker_lines.add(i)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line, ())
+        return "*" in rules or rule in rules
+
+    # -- function table ------------------------------------------------------
+    def _collect_functions(self) -> None:
+        module_jit_args: set[str] = set()
+
+        class Collector(ast.NodeVisitor):
+            def __init__(collector):
+                collector.stack: list[str] = []
+                collector.cls_stack: list[str] = []
+                collector.traced_depth = 0
+
+            def visit_ClassDef(collector, node):
+                collector.cls_stack.append(node.name)
+                collector.generic_visit(node)
+                collector.cls_stack.pop()
+
+            def _handle_fn(collector, node):
+                qual = ".".join(collector.stack + [node.name])
+                traced = collector.traced_depth > 0
+                for dec in node.decorator_list:
+                    if is_jit_expr(dec) or jit_call_of(dec) is not None:
+                        traced = True
+                hot = (
+                    node.lineno in self.hot_marker_lines
+                    or (node.lineno - 1) in self.hot_marker_lines
+                    or any(
+                        d.lineno in self.hot_marker_lines
+                        for d in node.decorator_list
+                    )
+                )
+                info = FunctionInfo(
+                    node=node,
+                    qualname=qual,
+                    name=node.name,
+                    cls=collector.cls_stack[-1] if collector.cls_stack else None,
+                    traced=traced,
+                    hot=hot,
+                )
+                info.local_names = _local_names(node)
+                info.calls = _callee_names(node)
+                self.functions[qual] = info
+                collector.stack.append(node.name)
+                if traced:
+                    collector.traced_depth += 1
+                collector.generic_visit(node)
+                if traced:
+                    collector.traced_depth -= 1
+                collector.stack.pop()
+
+            visit_FunctionDef = _handle_fn
+            visit_AsyncFunctionDef = _handle_fn
+
+            def visit_Call(collector, node):
+                # jax.jit(f) / jax.jit(fn_name, ...): mark f traced
+                call = jit_call_of(node)
+                if call is not None:
+                    args = call.args
+                    # for partial(jax.jit, f) the wrapped fn is args[1]
+                    if args and is_jit_expr(args[0]):
+                        args = args[1:]
+                    if args and isinstance(args[0], ast.Name):
+                        module_jit_args.add(args[0].id)
+                # callables handed to trace-taking transforms run traced
+                chain = tuple(_attr_chain(node.func))
+                if chain in _TRACE_TAKERS:
+                    for a in node.args[:1]:
+                        if isinstance(a, ast.Name):
+                            module_jit_args.add(a.id)
+                collector.generic_visit(node)
+
+        Collector().visit(self.tree)
+        self._jit_wrapped_names = module_jit_args
+        for info in self.functions.values():
+            if info.name in module_jit_args:
+                info.traced = True
+
+    def _propagate(self) -> None:
+        """Flood ``traced``/``hot`` along the intra-module call graph."""
+        by_name: dict[str, list[FunctionInfo]] = {}
+        for info in self.functions.values():
+            by_name.setdefault(info.name, []).append(info)
+            if info.cls:
+                by_name.setdefault(f"{info.cls}.{info.name}", []).append(info)
+
+        for flag in ("traced", "hot"):
+            changed = True
+            while changed:
+                changed = False
+                for info in self.functions.values():
+                    if not getattr(info, flag):
+                        continue
+                    for callee in info.calls:
+                        targets = by_name.get(callee, [])
+                        if info.cls and "." not in callee:
+                            # a bare call inside a method prefers a sibling
+                            # method of the same class when one exists
+                            scoped = by_name.get(f"{info.cls}.{callee}")
+                            if scoped:
+                                targets = scoped
+                        for t in targets:
+                            if not getattr(t, flag):
+                                setattr(t, flag, True)
+                                changed = True
+
+    # -- lookup helpers ------------------------------------------------------
+    def enclosing_function(self, node_line: int) -> FunctionInfo | None:
+        """Innermost function whose body spans ``node_line``."""
+        best: FunctionInfo | None = None
+        best_span = None
+        for info in self.functions.values():
+            n = info.node
+            end = getattr(n, "end_lineno", n.lineno)
+            if n.lineno <= node_line <= end:
+                span = end - n.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = info, span
+        return best
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding | None:
+        line = getattr(node, "lineno", 1)
+        if self.is_suppressed(rule, line):
+            return None
+        return Finding(
+            rule=rule,
+            path=self.rel_path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=self.snippet(line),
+        )
+
+
+def _local_names(fn: ast.AST) -> set[str]:
+    """Names bound inside ``fn`` (params + assignments), own scope only."""
+    names: set[str] = set()
+    args = fn.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    ):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if node is fn:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # don't descend into nested scopes for assignment collection —
+            # but ast.walk already flattens; accept the over-approximation
+            # (it only ever makes rules QUIETER, never noisier)
+            pass
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _callee_names(fn: ast.AST) -> set[str]:
+    """Bare and ``self.``-qualified callee names referenced from ``fn``
+    (calls AND bare-name references, so callables passed to ``lax.map`` /
+    executors count as edges)."""
+    calls: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                calls.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                chain = _attr_chain(f)
+                if chain[:1] == ["self"] and len(chain) == 2:
+                    calls.add(chain[1])
+            # first-arg callables (lax.map(body, ...), executor.submit(fn))
+            for a in node.args[:1]:
+                if isinstance(a, ast.Name):
+                    calls.add(a.id)
+    return calls
+
+
+# --------------------------------------------------------------------------
+# project context
+# --------------------------------------------------------------------------
+
+ENTRYPOINTS = (
+    "train.py", "run.py", "serve.py", "render_video.py", "bench.py",
+    "occupancy_grid.py", "check_grid.py", "plot_loss.py",
+)
+
+DEFAULT_SCAN = ("nerf_replication_tpu", "scripts") + ENTRYPOINTS
+
+
+class ProjectContext:
+    """All parsed modules of one scan + repo-level config-key knowledge."""
+
+    def __init__(self, modules: list[ModuleContext], repo_root: str | None,
+                 config_keys: set[tuple[str, ...]] | None = None):
+        self.modules = modules
+        self.repo_root = repo_root
+        # known config key-paths, e.g. ("train", "lr"); every prefix of a
+        # known path is itself known. None => R6 key checks are skipped.
+        self.config_keys = config_keys
+        # filled lazily by the config rule
+        self.is_full_scan = repo_root is not None and any(
+            m.rel_path.replace(os.sep, "/").startswith(
+                "nerf_replication_tpu/config/"
+            )
+            for m in modules
+        ) and len(modules) >= 20
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "data", "logs")
+                ]
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files)
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py") and os.path.exists(p):
+            out.append(p)
+    return sorted(dict.fromkeys(out))
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    rules: tuple[str, ...] | None = None,
+    config_keys: set[tuple[str, ...]] | None = None,
+) -> list[Finding]:
+    """Lint one source string (the test-fixture surface)."""
+    module = ModuleContext(path, source)
+    project = ProjectContext([module], repo_root=None, config_keys=config_keys)
+    return _run_rules(project, rules)
+
+
+def lint_paths(
+    paths: list[str],
+    repo_root: str | None = None,
+    rules: tuple[str, ...] | None = None,
+    config_keys: set[tuple[str, ...]] | None = None,
+) -> tuple[list[Finding], list[str]]:
+    """Lint files/dirs. Returns ``(findings, errors)`` — errors are files
+    that failed to parse (reported, not fatal: a lint gate must not die on
+    one syntax error in an unrelated script)."""
+    modules: list[ModuleContext] = []
+    errors: list[str] = []
+    for f in iter_py_files(paths):
+        rel = os.path.relpath(f, repo_root) if repo_root else f
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            modules.append(ModuleContext(f, src, rel_path=rel))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{rel}: {type(e).__name__}: {e}")
+    if config_keys is None and repo_root is not None:
+        from .rules import collect_config_keys
+
+        config_keys = collect_config_keys(repo_root)
+    project = ProjectContext(modules, repo_root, config_keys=config_keys)
+    return _run_rules(project, rules), errors
+
+
+def _run_rules(
+    project: ProjectContext, rules: tuple[str, ...] | None
+) -> list[Finding]:
+    from . import rules as _rules  # noqa: F401  (populates RULES)
+
+    active = [
+        r for rid, r in RULES.items() if rules is None or rid in rules
+    ]
+    findings: list[Finding] = []
+    for module in project.modules:
+        if module.skip_file:
+            continue
+        for rule in active:
+            if not rule.project_wide:
+                findings.extend(rule.check(module))
+    for rule in active:
+        if rule.project_wide:
+            findings.extend(rule.check_project(project))
+    # nested loops / overlapping walks can surface the same hazard twice
+    findings = list(dict.fromkeys(findings))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,935 @@
+"""graftlint rules R1-R6 — JAX hazards tuned to this codebase's idioms.
+
+Each rule encodes one of the failure modes PR 1's telemetry made observable
+at runtime (obs/: CompileTracker retraces, dispatch-vs-block stalls, HBM
+creep) as a review-time check. docs/static_analysis.md carries the catalog
+with a worked example diff per rule.
+
+=====================  ==========================================================
+rule id                hazard
+=====================  ==========================================================
+``host-sync``   (R1)   ``.item()`` / ``float()`` / ``np.asarray`` on device
+                       values in traced or dispatch-hot code
+``retrace``     (R2)   jit built inside a loop; varying shapes / shape-derived
+                       scalars flowing into jit call sites without
+                       ``static_argnums`` or bucket padding
+``donate``      (R3)   train-step-shaped jit (state in, state out) without
+                       ``donate_argnums`` — doubles parameter+optimizer HBM
+``rng``         (R4)   hardcoded ``PRNGKey(const)`` in library code; a key
+                       consumed twice without an intervening ``split``
+``side-effect`` (R5)   ``print`` / ``global`` / closure-mutation inside a
+                       traced body — runs at trace time, leaks tracers
+``config-key``  (R6)   ``cfg.*`` accesses that no default/YAML defines, and
+                       default keys nothing reads
+=====================  ==========================================================
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import (
+    Finding,
+    FunctionInfo,
+    ModuleContext,
+    ProjectContext,
+    Rule,
+    _attr_chain,
+    jit_call_of,
+    jit_static_kwargs,
+    is_jit_expr,
+    register,
+)
+
+_NUMPY_NAMES = {"np", "numpy", "onp"}
+_JAX_ROOTS = {"jnp", "jax", "lax"}
+
+
+def _contains_jax_call(node: ast.expr) -> bool:
+    """True when the expression subtree calls into jnp/jax/lax — i.e. its
+    value is a device computation, not a trace-time python constant."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            chain = _attr_chain(sub.func)
+            if chain and chain[0] in _JAX_ROOTS:
+                return True
+    return False
+
+
+def _walk_scope(fn: ast.AST):
+    """Walk ``fn``'s own body without descending into nested function
+    scopes (their RNG/locals are separate runtime instances)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop(0)
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack[0:0] = list(ast.iter_child_nodes(node))
+
+
+def _iter_functions(
+    module: ModuleContext, traced: bool | None = None, hot: bool | None = None
+):
+    for info in module.functions.values():
+        if traced is not None and info.traced != traced:
+            continue
+        if hot is not None and info.hot != hot:
+            continue
+        yield info
+
+
+# --------------------------------------------------------------------------
+# R1 host-sync
+# --------------------------------------------------------------------------
+
+
+@register
+class HostSyncRule(Rule):
+    rule_id = "host-sync"
+    doc = (
+        "host synchronization on a jit-traced or dispatch-hot path: "
+        ".item(), float()/int(), np.asarray() on device values"
+    )
+
+    def check(self, module: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for info in module.functions.values():
+            if not (info.traced or info.hot):
+                continue
+            where = "jit-traced" if info.traced else "dispatch-hot"
+            for node in _walk_scope(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = self._classify(node, traced=info.traced)
+                if f is None:
+                    continue
+                call_desc, hint = f
+                finding = module.finding(
+                    self.rule_id,
+                    node,
+                    f"{call_desc} inside {where} `{info.qualname}` — {hint}",
+                )
+                if finding:
+                    findings.append(finding)
+        return findings
+
+    def _classify(self, node: ast.Call, traced: bool):
+        func = node.func
+        chain = _attr_chain(func)
+        # np.asarray / np.array / jax.device_get — a device pull (hot) or a
+        # trace-time constant-fold surprise (traced)
+        if chain[:1] and chain[0] in _NUMPY_NAMES and chain[-1] in (
+            "asarray", "array", "copy"
+        ):
+            return (
+                f"`{'.'.join(chain)}(...)`",
+                "pulls the buffer to host; hoist off the hot path, use "
+                "jax.block_until_ready for sync-only, or mark intentional "
+                "with `# graftlint: ok(host-sync: why)`",
+            )
+        if chain in (["jax", "device_get"], ["device_get"]):
+            return (
+                "`jax.device_get(...)`",
+                "device pull; hoist or mark intentional",
+            )
+        # .item() on anything
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "item"
+            and not node.args
+        ):
+            return (
+                "`.item()`",
+                "blocks on the device value; keep scalars on device or "
+                "sync once at the logging cadence",
+            )
+        # float()/int()/bool() casts only matter under trace (they force
+        # concretization) and only when the value is demonstrably a jax
+        # computation — `int(x.shape[0])` / `int(cfg.level_dim)` are
+        # trace-time constants and idiomatic
+        if traced and isinstance(func, ast.Name) and func.id in (
+            "float", "int", "bool"
+        ):
+            if node.args and _contains_jax_call(node.args[0]):
+                return (
+                    f"`{func.id}(...)` on a jax computation",
+                    "forces concretization of a traced value (works only on "
+                    "trace-time constants, errors on tracers); use jnp ops "
+                    "or hoist to the host side",
+                )
+        return None
+
+
+# --------------------------------------------------------------------------
+# R2 retrace
+# --------------------------------------------------------------------------
+
+
+@register
+class RetraceRule(Rule):
+    rule_id = "retrace"
+    doc = (
+        "retrace hazards: jax.jit constructed inside a loop; varying-shape "
+        "slices or shape-derived scalars flowing into jit call sites "
+        "without static_argnums/bucket padding"
+    )
+
+    def check(self, module: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        findings += self._jit_in_loop(module)
+        findings += self._varying_shapes(module)
+        return findings
+
+    def _jit_in_loop(self, module: ModuleContext) -> list[Finding]:
+        out: list[Finding] = []
+        for top in ast.walk(module.tree):
+            if not isinstance(top, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(top):
+                if node is top:
+                    continue
+                # a def inside the loop is its own (cached) construction
+                # site only if called immediately; flag the direct calls
+                call = jit_call_of(node) if isinstance(node, ast.Call) else None
+                if call is None and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    for dec in node.decorator_list:
+                        if is_jit_expr(dec) or jit_call_of(dec) is not None:
+                            call = dec  # type: ignore[assignment]
+                            break
+                if call is None:
+                    continue
+                f = module.finding(
+                    self.rule_id,
+                    node,
+                    "jax.jit constructed inside a loop — every iteration "
+                    "builds a fresh callable with an empty cache (a "
+                    "recompile per iteration); hoist the jit out of the "
+                    "loop or cache it keyed on its static config",
+                )
+                if f:
+                    out.append(f)
+        return out
+
+    def _jitted_callables(self, module: ModuleContext) -> dict[str, bool]:
+        """name -> has static_argnums/argnames, for names that are jit
+        executables in this module (assigned from jax.jit(...) or
+        jit-decorated defs)."""
+        jitted: dict[str, bool] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                call = jit_call_of(node.value)
+                if call is not None:
+                    has_static = any(
+                        k in ("static_argnums", "static_argnames")
+                        for k in jit_static_kwargs(call)
+                    )
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jitted[t.id] = has_static
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    call = jit_call_of(dec)
+                    if is_jit_expr(dec):
+                        jitted[node.name] = False
+                    elif call is not None:
+                        jitted[node.name] = any(
+                            k in ("static_argnums", "static_argnames")
+                            for k in jit_static_kwargs(call)
+                        )
+        return jitted
+
+    def _varying_shapes(self, module: ModuleContext) -> list[Finding]:
+        out: list[Finding] = []
+        jitted = self._jitted_callables(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = node.func.id if isinstance(node.func, ast.Name) else None
+            if name not in jitted:
+                continue
+            has_static = jitted[name]
+            for arg in node.args:
+                hazard = self._shape_hazard(arg, has_static)
+                if hazard is None:
+                    continue
+                f = module.finding(
+                    self.rule_id,
+                    arg,
+                    f"{hazard} flows into jit executable `{name}` — every "
+                    "distinct shape compiles a fresh executable; pad into "
+                    "a fixed bucket (cf. serve/engine.py buckets) or "
+                    "declare it static_argnums",
+                )
+                if f:
+                    out.append(f)
+        return out
+
+    def _shape_hazard(self, arg: ast.expr, has_static: bool) -> str | None:
+        # x[:n] / x[i:j] with non-constant bounds => data-dependent shape
+        if isinstance(arg, ast.Subscript) and isinstance(arg.slice, ast.Slice):
+            s = arg.slice
+            for bound in (s.lower, s.upper):
+                if bound is not None and not isinstance(bound, ast.Constant):
+                    return "a variable-length slice"
+        if has_static:
+            return None
+        # len(...) / x.shape[i] as a bare argument: a host scalar that is
+        # almost always about to be used as a dimension
+        if (
+            isinstance(arg, ast.Call)
+            and isinstance(arg.func, ast.Name)
+            and arg.func.id == "len"
+        ):
+            return "a `len(...)` host scalar"
+        if (
+            isinstance(arg, ast.Subscript)
+            and isinstance(arg.value, ast.Attribute)
+            and arg.value.attr == "shape"
+        ):
+            return "a `.shape[...]` host scalar"
+        return None
+
+
+# --------------------------------------------------------------------------
+# R3 donate
+# --------------------------------------------------------------------------
+
+_STATE_PARAM_NAMES = {"state", "train_state", "opt_state"}
+
+
+def _is_train_step_shaped(fn: ast.AST) -> bool:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return False
+    pos = list(args.posonlyargs) + list(args.args)
+    first = pos[0].arg if pos else ""
+    if first in ("self", "cls") and len(pos) > 1:
+        first = pos[1].arg
+    if first in _STATE_PARAM_NAMES:
+        return True
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "apply_gradients"
+        ):
+            return True
+    return False
+
+
+def _has_donate(call: ast.Call | None) -> bool:
+    if call is None:
+        return False  # bare @jax.jit has no kwargs at all
+    return any(
+        k in ("donate_argnums", "donate_argnames")
+        for k in jit_static_kwargs(call)
+    )
+
+
+@register
+class DonateRule(Rule):
+    rule_id = "donate"
+    doc = (
+        "train-step-shaped jit (state in / state out) without "
+        "donate_argnums: params + optimizer moments get double-buffered "
+        "in HBM every step"
+    )
+
+    _MSG = (
+        "train-step-shaped jit without donate_argnums — the old state "
+        "stays live across the update, doubling parameter+optimizer HBM; "
+        "donate the state argument (cf. train/trainer.py, parallel/step.py)"
+    )
+
+    def check(self, module: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        local_defs = {
+            info.name: info.node for info in module.functions.values()
+        }
+        for node in ast.walk(module.tree):
+            # decorated defs
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    call = jit_call_of(dec)
+                    if not (is_jit_expr(dec) or call is not None):
+                        continue
+                    if _is_train_step_shaped(node) and not _has_donate(call):
+                        f = module.finding(self.rule_id, dec, self._MSG)
+                        if f:
+                            findings.append(f)
+            # jax.jit(f, ...) call-form
+            elif isinstance(node, ast.Call):
+                call = jit_call_of(node)
+                if call is None or call is not node:
+                    continue
+                args = node.args
+                if args and is_jit_expr(args[0]):  # partial(jax.jit, f)
+                    args = args[1:]
+                if not args:
+                    continue
+                wrapped = args[0]
+                target = None
+                if isinstance(wrapped, ast.Lambda):
+                    target = wrapped
+                elif isinstance(wrapped, ast.Name):
+                    target = local_defs.get(wrapped.id)
+                if target is None:
+                    continue
+                if _is_train_step_shaped(target) and not _has_donate(node):
+                    f = module.finding(self.rule_id, node, self._MSG)
+                    if f:
+                        findings.append(f)
+        return findings
+
+
+# --------------------------------------------------------------------------
+# R4 rng
+# --------------------------------------------------------------------------
+
+# non-consuming jax.random calls: factories, and fold_in (deriving
+# per-(key, data) streams from one key is the DESIGNED pattern —
+# datasets/sampling.py) — using the parent key raw afterwards still pairs
+# with any later real consumption
+_KEY_FACTORIES = {"PRNGKey", "key", "key_data", "wrap_key_data", "fold_in"}
+
+
+def _children_with_arms(node: ast.AST):
+    """Children of ``node`` tagged with the branch arm they belong to
+    (if/else arms, try/except handlers) — None for non-branching fields."""
+    if isinstance(node, ast.If):
+        yield node.test, None
+        for c in node.body:
+            yield c, "if"
+        for c in node.orelse:
+            yield c, "else"
+        return
+    if isinstance(node, ast.Try):
+        for c in node.body:
+            yield c, "try"
+        for i, h in enumerate(node.handlers):
+            for c in h.body:
+                yield c, f"except{i}"
+        for c in node.orelse + node.finalbody:
+            yield c, None
+        return
+    if isinstance(node, ast.IfExp):
+        yield node.test, None
+        yield node.body, "if"
+        yield node.orelse, "else"
+        return
+    for c in ast.iter_child_nodes(node):
+        yield c, None
+
+
+def _exclusive_branches(b1: tuple, b2: tuple) -> bool:
+    """True when two branch paths sit in different arms of a common
+    branching statement (so control flow can never reach both)."""
+    arms1 = dict(b1)
+    return any(
+        nid in arms1 and arms1[nid] != arm for nid, arm in b2
+    )
+
+
+def _random_call(node: ast.Call) -> str | None:
+    """The jax.random function name when ``node`` is a jax.random call."""
+    chain = _attr_chain(node.func)
+    if len(chain) >= 2 and chain[-2] == "random" and chain[0] in (
+        "jax", "random", "jrandom", "jr"
+    ):
+        return chain[-1]
+    if len(chain) == 2 and chain[0] in ("jrandom", "jr"):
+        return chain[1]
+    return None
+
+
+@register
+class RngRule(Rule):
+    rule_id = "rng"
+    doc = (
+        "RNG hygiene: hardcoded PRNGKey(const) in library code; a key "
+        "consumed twice (or in a loop) without an intervening split"
+    )
+
+    # experiment/bench scripts pin keys for reproducibility on purpose;
+    # the hardcoded-seed check covers library code only
+    HARDCODED_EXEMPT_PREFIXES = ("scripts", "tests")
+
+    def check(self, module: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        findings += self._hardcoded(module)
+        for info in module.functions.values():
+            findings += self._reuse(module, info)
+        return findings
+
+    def _hardcoded(self, module: ModuleContext) -> list[Finding]:
+        rel = module.rel_path.replace(os.sep, "/")
+        if any(
+            rel.startswith(p + "/") or rel == p
+            for p in self.HARDCODED_EXEMPT_PREFIXES
+        ):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not (chain and chain[-1] in ("PRNGKey", "key")):
+                continue
+            if chain[-1] == "key" and chain[:-1] not in (
+                ["jax", "random"], ["random"], ["jrandom"], ["jr"]
+            ):
+                continue  # `.key` attributes that aren't jax.random.key
+            if node.args and isinstance(node.args[0], ast.Constant):
+                f = module.finding(
+                    self.rule_id,
+                    node,
+                    f"hardcoded `{'.'.join(chain)}"
+                    f"({node.args[0].value!r})` in library code — callers "
+                    "can never vary the stream; thread the config seed "
+                    "(cfg.seed) through instead",
+                )
+                if f:
+                    out.append(f)
+        return out
+
+    def _reuse(self, module: ModuleContext, info: FunctionInfo) -> list[Finding]:
+        # flow-light traversal: record each consumption/rebind with its
+        # branch path (which arm of which If/Try it sits in) so draws in
+        # mutually-exclusive branches never pair up as "reuse"
+        consumptions: list[tuple[int, str, ast.Call, tuple]] = []
+        rebinds: list[tuple[int, str, tuple]] = []
+        loops: list[tuple[int, int, set[str]]] = []  # (start, end, rebinds)
+
+        def visit(node: ast.AST, branch: tuple):
+            for child, arm in _children_with_arms(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue  # nested scope: separate runtime instance
+                sub_branch = branch + ((id(node), arm),) if arm else branch
+                if isinstance(child, (ast.For, ast.While)):
+                    body_rebinds = {
+                        n.id
+                        for n in ast.walk(child)
+                        if isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Store)
+                    }
+                    loops.append(
+                        (child.lineno,
+                         getattr(child, "end_lineno", child.lineno),
+                         body_rebinds)
+                    )
+                if isinstance(child, ast.Name) and isinstance(
+                    child.ctx, ast.Store
+                ):
+                    rebinds.append((child.lineno, child.id, sub_branch))
+                if isinstance(child, ast.Call):
+                    fn_name = _random_call(child)
+                    if fn_name is not None and fn_name not in _KEY_FACTORIES:
+                        if child.args and isinstance(child.args[0], ast.Name):
+                            consumptions.append(
+                                (child.lineno, child.args[0].id, child,
+                                 sub_branch)
+                            )
+                visit(child, sub_branch)
+
+        visit(info.node, ())
+
+        findings: list[Finding] = []
+        by_key: dict[str, list[tuple[int, ast.Call, tuple]]] = {}
+        for line, key, node, branch in consumptions:
+            by_key.setdefault(key, []).append((line, node, branch))
+        for key, events in by_key.items():
+            events.sort(key=lambda e: e[0])
+            for (l1, _n1, b1), (l2, n2, b2) in zip(events, events[1:]):
+                if _exclusive_branches(b1, b2):
+                    continue
+                # a rebind on l1's own line covers `key = fold_in(key, ..)`
+                # style self-renewal
+                if any(
+                    l1 <= rl <= l2 and rn == key
+                    and not _exclusive_branches(rb, b2)
+                    for rl, rn, rb in rebinds
+                ):
+                    continue
+                f = module.finding(
+                    self.rule_id,
+                    n2,
+                    f"key `{key}` consumed again (first used at line {l1}) "
+                    "without a split/rebind in between — both draws see the "
+                    "same stream; jax.random.split the key first",
+                )
+                if f:
+                    findings.append(f)
+        # single consumption inside a loop that never rebinds the key:
+        # every iteration draws the identical stream
+        for line, key, node, _branch in consumptions:
+            for lo, hi, body_rebinds in loops:
+                if lo <= line <= hi and key not in body_rebinds:
+                    f = module.finding(
+                        self.rule_id,
+                        node,
+                        f"key `{key}` consumed inside a loop without being "
+                        "split/folded per iteration — every iteration draws "
+                        "identical randomness",
+                    )
+                    if f:
+                        findings.append(f)
+                    break
+        return findings
+
+
+# --------------------------------------------------------------------------
+# R5 side-effect
+# --------------------------------------------------------------------------
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault"}
+
+
+@register
+class SideEffectRule(Rule):
+    rule_id = "side-effect"
+    doc = (
+        "side effects in jit-traced bodies: print, global mutation, "
+        "appending to closed-over containers — they run once at trace "
+        "time and can leak tracers"
+    )
+
+    def check(self, module: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for info in _iter_functions(module, traced=True):
+            locals_ = info.local_names
+            for node in _walk_scope(info.node):
+                msg = None
+                if isinstance(node, ast.Global):
+                    msg = (
+                        "`global` inside a jit-traced body — the mutation "
+                        "happens once at trace time, not per call"
+                    )
+                elif isinstance(node, ast.Call):
+                    chain = _attr_chain(node.func)
+                    if chain == ["print"]:
+                        msg = (
+                            "`print` inside a jit-traced body runs at trace "
+                            "time only (and prints tracers); use "
+                            "jax.debug.print for per-call output"
+                        )
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATORS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id not in locals_
+                    ):
+                        msg = (
+                            f"`{node.func.value.id}.{node.func.attr}(...)` "
+                            "mutates a closed-over container from a traced "
+                            "body — it fires once at trace time and leaks "
+                            "tracers into host state"
+                        )
+                if msg is None:
+                    continue
+                f = module.finding(self.rule_id, node, msg)
+                if f:
+                    findings.append(f)
+        return findings
+
+
+# --------------------------------------------------------------------------
+# R6 config-key
+# --------------------------------------------------------------------------
+
+# containers whose sub-keys are task-plugin/YAML-defined, not template
+# defaults — unknown keys under them are expected
+_DYNAMIC_CONTAINERS = {
+    "task_arg", "sampler_meta", "train_dataset", "test_dataset", "network",
+}
+
+# dict/ConfigNode methods that terminate a key chain
+_NODE_METHODS = {
+    "items", "keys", "values", "merge", "merge_from_list", "merge_from_file",
+    "freeze", "defrost", "clone", "dump", "to_dict", "is_frozen",
+    "setdefault", "pop", "update", "copy", "popitem", "clear",
+}
+
+
+def _dict_literal_paths(node: ast.expr, prefix: tuple[str, ...]):
+    """Key paths of a (possibly nested / ConfigNode-wrapped) dict literal."""
+    if isinstance(node, ast.Call) and node.args:
+        chain = _attr_chain(node.func)
+        if chain and chain[-1] == "ConfigNode":
+            node = node.args[0]
+    if not isinstance(node, ast.Dict):
+        return
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            path = prefix + (k.value,)
+            yield path, k.lineno
+            yield from _dict_literal_paths(v, path)
+
+
+def collect_config_keys(
+    repo_root: str, with_defaults: bool = False
+):
+    """Known config key-paths: template defaults (config/config.py
+    ``cfg.<k> = ...`` assignments, nested dict literals included) plus
+    every YAML under configs/. ``with_defaults`` also returns the
+    default-template leaf paths with their definition lines (for the
+    dead-key check)."""
+    known: set[tuple[str, ...]] = set()
+    default_leaves: dict[tuple[str, ...], int] = {}
+
+    cfg_py = os.path.join(
+        repo_root, "nerf_replication_tpu", "config", "config.py"
+    )
+    if os.path.exists(cfg_py):
+        with open(cfg_py, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=cfg_py)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            chain = _attr_chain(t)
+            if len(chain) >= 2 and chain[0] == "cfg":
+                path = tuple(chain[1:])
+                known.add(path)
+                nested = list(_dict_literal_paths(node.value, path))
+                for sub, line in nested:
+                    known.add(sub)
+                if nested:
+                    # leaves = nested paths with no deeper nested path
+                    for sub, line in nested:
+                        if not any(
+                            other[: len(sub)] == sub and other != sub
+                            for other, _ in nested
+                        ):
+                            default_leaves[sub] = line
+                else:
+                    default_leaves[path] = node.lineno
+
+    def _yaml_paths(data, prefix=()):
+        if isinstance(data, dict):
+            for k, v in data.items():
+                if isinstance(k, str):
+                    yield prefix + (k,)
+                    yield from _yaml_paths(v, prefix + (k,))
+
+    configs_dir = os.path.join(repo_root, "configs")
+    if os.path.isdir(configs_dir):
+        try:
+            import yaml
+        except ImportError:  # pragma: no cover - yaml ships with the repo
+            yaml = None
+        if yaml is not None:
+            for root, _dirs, files in os.walk(configs_dir):
+                for f in sorted(files):
+                    if not f.endswith((".yaml", ".yml")):
+                        continue
+                    try:
+                        with open(os.path.join(root, f), encoding="utf-8") as fh:
+                            data = yaml.safe_load(fh) or {}
+                    except Exception:
+                        continue
+                    known.update(_yaml_paths(data))
+
+    if with_defaults:
+        return known, default_leaves
+    return known
+
+
+def _cfg_access_path(node: ast.expr) -> tuple[str, ...] | None:
+    """Resolve ``cfg.a.b`` / ``self.cfg.get("a").b`` ... into a key path
+    rooted at the config; None when not a cfg access."""
+    parts: list[str] = []
+    cur = node
+    while True:
+        if isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        elif (
+            isinstance(cur, ast.Call)
+            and isinstance(cur.func, ast.Attribute)
+            and cur.func.attr == "get"
+            and cur.args
+            and isinstance(cur.args[0], ast.Constant)
+            and isinstance(cur.args[0].value, str)
+        ):
+            parts.append(cur.args[0].value)
+            cur = cur.func.value
+        else:
+            break
+    if isinstance(cur, ast.Name) and cur.id == "cfg":
+        pass
+    elif (
+        isinstance(cur, ast.Attribute)
+        and cur.attr == "cfg"
+        and isinstance(cur.value, ast.Name)
+        and cur.value.id == "self"
+    ):
+        pass
+    else:
+        return None
+    path = tuple(parts[::-1])
+    # truncate at the first dict/node method ("cfg.train.items" -> train)
+    for i, seg in enumerate(path):
+        if seg in _NODE_METHODS or seg == "get":
+            return path[:i]
+    return path
+
+
+@register
+class ConfigKeyRule(Rule):
+    rule_id = "config-key"
+    doc = (
+        "cfg.* accesses that neither the config template nor any YAML "
+        "defines (typos, silently-dead .get defaults), and template "
+        "default keys nothing in the repo reads"
+    )
+    project_wide = True
+
+    def check_project(self, project: ProjectContext) -> list[Finding]:
+        if project.config_keys is None:
+            return []
+        known = project.config_keys
+        top_level = {p[0] for p in known if len(p) == 1} | {
+            p[0] for p in known
+        }
+        findings: list[Finding] = []
+        accessed: set[tuple[str, ...]] = set()
+
+        for module in project.modules:
+            if module.skip_file:
+                continue
+            rel = module.rel_path.replace(os.sep, "/")
+            # the template/merge machinery reads keys too (parse_cfg
+            # consumes exp_name_tag/save_tag) — its accesses count as
+            # usage, but unknown-key findings there would be circular
+            flag_unknown = not rel.startswith("nerf_replication_tpu/config/")
+            for scope_node, scope_name in self._scopes(module):
+                accesses = []
+                for node in _walk_scope(scope_node):
+                    if isinstance(node, (ast.Attribute, ast.Call)):
+                        # an assignment TARGET (cfg.x = ...) defines, it
+                        # doesn't read — else default_cfg's own template
+                        # assignments would mark every key as used
+                        if isinstance(node, ast.Attribute) and isinstance(
+                            node.ctx, (ast.Store, ast.Del)
+                        ):
+                            continue
+                        path = _cfg_access_path(node)
+                        if path:
+                            accesses.append((path, node))
+                if not accesses:
+                    continue
+                # a scope's `cfg` is the ROOT config only if it touches at
+                # least one known top-level key — encoder/task sub-configs
+                # are also conventionally named `cfg`
+                is_root = any(p[0] in top_level for p, _ in accesses if p)
+                # keep only the outermost access per location (cfg.a.b also
+                # matches cfg.a; the longest path at a line wins)
+                best: dict[tuple[int, int], tuple[tuple[str, ...], ast.AST]] = {}
+                for path, node in accesses:
+                    loc = (node.lineno, node.col_offset)
+                    # prefer the access that STARTS earliest on the line
+                    # and is longest
+                    cur = None
+                    for (l, c), (p, n) in list(best.items()):
+                        if l == node.lineno and abs(c - node.col_offset) <= 1:
+                            cur = (l, c)
+                    if cur is not None:
+                        if len(path) > len(best[cur][0]):
+                            best[cur] = (path, node)
+                    else:
+                        best[loc] = (path, node)
+                for path, node in best.values():
+                    if not path:
+                        continue
+                    for i in range(1, len(path) + 1):
+                        accessed.add(path[:i])
+                    if not is_root or not flag_unknown:
+                        continue
+                    unknown = self._first_unknown(path, known)
+                    if unknown is None:
+                        continue
+                    f = module.finding(
+                        self.rule_id,
+                        node,
+                        f"config key `{'.'.join(path)}` is not defined by "
+                        "the template defaults (config/config.py) or any "
+                        "YAML under configs/ — a typo reads the .get "
+                        "fallback forever; add the key to default_cfg or "
+                        "fix the access",
+                    )
+                    if f:
+                        findings.append(f)
+
+        findings += self._dead_keys(project, accessed)
+        return findings
+
+    def _scopes(self, module: ModuleContext):
+        yield module.tree, "<module>"
+        for info in module.functions.values():
+            yield info.node, info.qualname
+
+    def _first_unknown(
+        self, path: tuple[str, ...], known: set[tuple[str, ...]]
+    ) -> int | None:
+        for i in range(1, len(path) + 1):
+            prefix = path[:i]
+            if prefix in known:
+                continue
+            # anything under a dynamic container is plugin-defined
+            if any(seg in _DYNAMIC_CONTAINERS for seg in prefix[:i]):
+                return None
+            # a known LEAF's sub-access (cfg.train.scheduler.milestones
+            # where scheduler is a dict default) — parent known, child not:
+            # only flag if the parent is itself unknown at top level
+            return i
+        return None
+
+    def _dead_keys(
+        self, project: ProjectContext, accessed: set[tuple[str, ...]]
+    ) -> list[Finding]:
+        if not project.is_full_scan or project.repo_root is None:
+            return []
+        _, default_leaves = collect_config_keys(
+            project.repo_root, with_defaults=True
+        )
+        cfg_module = next(
+            (
+                m for m in project.modules
+                if m.rel_path.replace(os.sep, "/").endswith(
+                    "nerf_replication_tpu/config/config.py"
+                )
+            ),
+            None,
+        )
+        if cfg_module is None:
+            return []
+        out: list[Finding] = []
+        for path, line in sorted(default_leaves.items()):
+            if any(seg in _DYNAMIC_CONTAINERS for seg in path):
+                continue
+            if any(path[:i] in accessed for i in range(1, len(path) + 1)):
+                continue
+            if cfg_module.is_suppressed(self.rule_id, line):
+                continue
+            out.append(
+                Finding(
+                    rule=self.rule_id,
+                    path=cfg_module.rel_path,
+                    line=line,
+                    col=0,
+                    message=(
+                        f"default config key `{'.'.join(path)}` is never "
+                        "read anywhere in the scanned tree — dead weight "
+                        "or a key the reader spells differently; delete "
+                        "it or mark `# graftlint: ok(config-key: why)`"
+                    ),
+                    snippet=cfg_module.snippet(line),
+                )
+            )
+        return out

@@ -1,0 +1,85 @@
+"""Runtime companion to the static pass: assert a code region is clean.
+
+``with sanitizer(tracker):`` brackets a steady-state region (warm train
+steps, a serving request stream) and raises :class:`SanitizerError` on
+exit if the region retraced — the PR-1 :class:`~..obs.hooks.CompileTracker`
+is the counter, so anything the tracker wraps (every built step/render
+executable) is covered. ``transfers="disallow"`` additionally arms
+``jax.transfer_guard`` for the region, so an implicit host↔device transfer
+(a numpy array sneaking into a warm executable, a stray device pull)
+raises AT the offending call with a precise XLA error instead of showing
+up later as a dispatch stall. Explicit ``jax.device_put`` /
+``jax.device_get`` remain allowed — the guard flags exactly the implicit
+transfers R1 hunts statically.
+
+Typical test usage (tests/test_analysis.py, tests/test_serve.py idiom)::
+
+    tracker = CompileTracker()
+    step = tracker.wrap("step", jax.jit(step_fn))
+    step(state, batch)                      # warm-up compile, outside
+    with sanitizer(tracker) as probe:
+        for _ in range(8):
+            state, _ = step(state, batch)   # any retrace here -> raises
+    assert probe.compiles == 0
+
+The guard level is per-thread (jax's own switch), so a sanitized test
+doesn't disturb concurrent engine threads.
+"""
+
+from __future__ import annotations
+
+from contextlib import ExitStack, contextmanager
+from dataclasses import dataclass, field
+
+
+class SanitizerError(AssertionError):
+    """A sanitized region retraced or transferred unexpectedly."""
+
+
+@dataclass
+class SanitizerProbe:
+    """What the region did; populated on (clean) exit."""
+
+    compiles: int = 0
+    allow_compiles: int = 0
+    compile_names: dict = field(default_factory=dict)
+
+
+@contextmanager
+def sanitizer(
+    tracker=None,
+    transfers: str | None = "disallow",
+    allow_compiles: int = 0,
+    name: str = "sanitizer",
+):
+    """Assert zero-retrace / zero-implicit-transfer over a region.
+
+    ``tracker``: a CompileTracker whose total_compiles() must not grow by
+    more than ``allow_compiles`` inside the region (None skips the check).
+    ``transfers``: jax.transfer_guard level for the region — "disallow"
+    (default), "log", or None/"allow" to leave transfers unguarded.
+    Yields a :class:`SanitizerProbe` filled in on exit.
+    """
+    import jax
+
+    probe = SanitizerProbe(allow_compiles=allow_compiles)
+    before_total = tracker.total_compiles() if tracker is not None else 0
+    before_counts = dict(tracker.counts()) if tracker is not None else {}
+    with ExitStack() as stack:
+        if transfers and transfers != "allow":
+            stack.enter_context(jax.transfer_guard(transfers))
+        yield probe
+    if tracker is not None:
+        probe.compiles = tracker.total_compiles() - before_total
+        probe.compile_names = {
+            k: v - before_counts.get(k, 0)
+            for k, v in tracker.counts().items()
+            if v - before_counts.get(k, 0) > 0
+        }
+        if probe.compiles > allow_compiles:
+            raise SanitizerError(
+                f"{name}: {probe.compiles} compile(s) inside a sanitized "
+                f"region (allowed {allow_compiles}) — retrace storm; "
+                f"offenders: {probe.compile_names} — pin shapes/dtypes or "
+                "pad into buckets (docs/static_analysis.md)"
+            )

@@ -37,9 +37,14 @@ def default_cfg() -> ConfigNode:
     cfg.exp_name = "default"
     cfg.exp_name_tag = ""
     cfg.save_tag = "default"
-    cfg.gpus = [0]  # accepted for config parity; device selection is JAX's
+    # accepted for config parity; device selection is JAX's
+    cfg.gpus = [0]  # graftlint: ok(config-key: parity-only, never read)
     cfg.resume = True
     cfg.pretrain = ""
+    # one seed feeds every stream: param init (utils/setup.py), the train
+    # base key (train/trainer.py, train/ngp.py), host RNG pinning
+    # (fix_random), and dataset generation (datasets/__init__.py)
+    cfg.seed = 0
     # fix_random pins the host-side RNGs (random/np.random — dataset
     # generation, procedural scenes); the device path is already
     # deterministic via explicit key threading. ≙ reference train.py:25-28.
@@ -55,8 +60,10 @@ def default_cfg() -> ConfigNode:
     cfg.clear_result = False
 
     # plugin registry keys — resolved through nerf_replication_tpu.registry
-    cfg.train_dataset_module = "nerf_replication_tpu.datasets.blender"
-    cfg.test_dataset_module = "nerf_replication_tpu.datasets.blender"
+    # (the dataset pair is read via a computed f"{split}_dataset_module"
+    # key in datasets/__init__.py, invisible to static key tracking)
+    cfg.train_dataset_module = "nerf_replication_tpu.datasets.blender"  # graftlint: ok(config-key: read via computed key)
+    cfg.test_dataset_module = "nerf_replication_tpu.datasets.blender"  # graftlint: ok(config-key: read via computed key)
     cfg.network_module = "nerf_replication_tpu.models.nerf.network"
     cfg.renderer_module = "nerf_replication_tpu.renderer.volume"
     cfg.loss_module = "nerf_replication_tpu.train.loss"
@@ -105,6 +112,11 @@ def default_cfg() -> ConfigNode:
             "sampler_meta": {},
         }
     )
+    # eval-render routing (renderer/gate.py, render_video.py): sharded
+    # sends full-image renders sequence-parallel over the mesh's data axis
+    # (a pod must not render 800² images on the chief chip alone);
+    # whole_img is the evaluator's full-image-metrics switch
+    cfg.eval = ConfigNode({"sharded": False, "whole_img": False})
 
     # output roots (specialized by parse_cfg into per-experiment dirs)
     cfg.trained_model_dir = "data/trained_model"
@@ -215,7 +227,8 @@ def parse_cfg(cfg: ConfigNode, slurm_local_rank: int = 0) -> None:
     cfg.trained_config_dir = os.path.join(cfg.trained_config_dir, exp)
     cfg.record_dir = os.path.join(cfg.record_dir, exp)
     cfg.result_dir = os.path.join(cfg.result_dir, exp, cfg.save_tag)
-    cfg.local_rank = slurm_local_rank
+    # set for reference parity; runtime rank checks use jax.process_index()
+    cfg.local_rank = slurm_local_rank  # graftlint: ok(config-key: parity-only, never read)
 
 
 def make_cfg(

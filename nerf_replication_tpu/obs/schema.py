@@ -102,6 +102,16 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"tier": (str,), "queue_depth": _NUM},
         {"n_requests": _NUM, "n_rays": _NUM},
     ),
+    # -- static analysis (nerf_replication_tpu/analysis) ---------------------
+    # one per scripts/graftlint.py run: finding counts split new-vs-baseline
+    # so the report can watch the baseline shrink (and flag a lint gate
+    # that started failing)
+    "lint_run": (
+        {"n_findings": _NUM, "n_new": _NUM, "n_baselined": _NUM,
+         "duration_s": _NUM},
+        {"rule_counts": (dict,), "n_files": _NUM, "exit_code": _NUM,
+         "baseline_path": (str,)},
+    ),
 }
 
 

@@ -64,7 +64,10 @@ def bake_occupancy_grid(params, network, cfg) -> np.ndarray:
 
         return jax.lax.map(body, pts_p)
 
-    occupied = np.asarray(sweep(params, jnp.asarray(pts_p)))
+    # audited (graftlint R1): the single designed sync of a ONE-SHOT bake —
+    # the whole sweep runs as one jitted lax.map and this pull lands the
+    # finished grid; nothing per-step ever re-enters this path
+    occupied = np.asarray(sweep(params, jnp.asarray(pts_p)))  # graftlint: ok(host-sync)
     occupied = occupied.reshape(-1)[:n_voxels]
     return occupied.reshape(resolution, resolution, resolution)
 

@@ -200,6 +200,7 @@ class MicroBatcher:
             if self.pump() == 0 and self._stop:
                 return
 
+    # graftlint: hot
     def _render_batch(self, batch: list[_Pending], queue_depth: int) -> int:
         emitter = get_emitter()
         now = self.clock()

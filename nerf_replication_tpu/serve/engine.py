@@ -200,6 +200,7 @@ class RenderEngine:
             self._fns[key] = fn
         return fn
 
+    # graftlint: hot
     def warm_up(self, families: tuple[str, ...] = FAMILIES) -> int:
         """Compile every (bucket, family) executable before traffic.
 
@@ -208,15 +209,20 @@ class RenderEngine:
         is a valid warm-up input. Surfaces that only ever serve one tier
         (render_video) pass ``families=("full",)`` to skip the degraded
         executables. Returns the compile count paid."""
+        import jax
+
         before = self.tracker.total_compiles()
         zeros = {
             b: np.zeros((b, 6), np.float32) for b in self.buckets
         }
         for bucket in self.buckets:
             for family in families:
-                out = self._dispatch(zeros[bucket], bucket, family)
-                for v in out.values():
-                    np.asarray(v)  # block: compile now, not on request one
+                # block so the compile lands now, not on request one —
+                # without pulling every warm-up buffer to host the way
+                # np.asarray would (graftlint R1 finding, fixed)
+                jax.block_until_ready(
+                    self._dispatch(zeros[bucket], bucket, family)
+                )
         self.warmup_compiles += self.tracker.total_compiles() - before
         return self.warmup_compiles
 
@@ -224,8 +230,14 @@ class RenderEngine:
 
     def _dispatch(self, rays_b: np.ndarray, bucket: int, family: str) -> dict:
         """One executable call on exactly ``bucket`` rays (already padded)."""
+        import jax
+
         chunks = rays_b.reshape(bucket // self.chunk, self.chunk,
                                 rays_b.shape[-1])
+        # the request rays' host->device copy is the one INTENDED transfer
+        # of the serving path; explicit device_put keeps the whole request
+        # stream clean under jax.transfer_guard / analysis.sanitizer()
+        chunks = jax.device_put(chunks)
         fn = self._get_fn(bucket, family)
         if self.use_grid:
             return fn(self.params, chunks, self.grid, self.bbox)
@@ -237,7 +249,8 @@ class RenderEngine:
         rays_b = np.pad(rays, ((0, bucket - n), (0, 0)))
         out = self._dispatch(rays_b, bucket, family)
         out = {
-            k: np.asarray(v).reshape((-1,) + v.shape[2:])[:n]
+            # intentional device pull: outputs ARE the response payload
+            k: np.asarray(v).reshape((-1,) + v.shape[2:])[:n]  # graftlint: ok(host-sync)
             for k, v in out.items()
         }
         trunc = out.pop("truncated", None)
@@ -261,7 +274,8 @@ class RenderEngine:
         ``(outputs, info)`` — outputs are host numpy [N, ...] arrays, info
         reports the padded-ray accounting the occupancy telemetry needs.
         """
-        rays = np.asarray(rays, np.float32)
+        # host-side input normalization (requests arrive as numpy/lists)
+        rays = np.asarray(rays, np.float32)  # graftlint: ok(host-sync)
         if rays.ndim != 2:
             raise ValueError(f"rays must be [N, C], got shape {rays.shape}")
         n = rays.shape[0]
@@ -292,6 +306,7 @@ class RenderEngine:
         }
         return out, info
 
+    # graftlint: hot
     def render_request(self, rays, near, far, tier: str = "full",
                        emit: bool = True) -> dict:
         """Render one request at ``tier``; bounds must match the baked ones.
@@ -303,7 +318,8 @@ class RenderEngine:
         check_baked_bounds(self.near, self.far, near, far,
                            surface="serve engine")
         family, stride = TIER_IMPL[tier]
-        rays = np.asarray(rays, np.float32)
+        # host-side input normalization (requests arrive as numpy/lists)
+        rays = np.asarray(rays, np.float32)  # graftlint: ok(host-sync)
         n = rays.shape[0]
         t0 = time.perf_counter()
         out, info = self.render_flat(rays[::stride], family)
@@ -326,6 +342,7 @@ class RenderEngine:
         out["tier"] = tier
         return out
 
+    # graftlint: hot
     def render_view(self, c2w, H: int, W: int, focal: float,
                     tier: str = "full", via=None) -> tuple[np.ndarray, dict]:
         """Pose -> uint8 [H, W, 3] image through the pose LRU cache.
@@ -351,7 +368,8 @@ class RenderEngine:
 
         from ..datasets.rays import get_rays_np
 
-        rays_o, rays_d = get_rays_np(H, W, float(focal), np.asarray(c2w))
+        # pose arrives as host data (HTTP json / python lists)
+        rays_o, rays_d = get_rays_np(H, W, float(focal), np.asarray(c2w))  # graftlint: ok(host-sync)
         rays = np.concatenate([rays_o, rays_d], -1).reshape(-1, 6)
         if via is not None:
             out = via(rays, self.near, self.far)
@@ -360,7 +378,8 @@ class RenderEngine:
                                       emit=True)
         served_tier = out.get("tier", tier)
         rgb_key = "rgb_map_f" if "rgb_map_f" in out else "rgb_map_c"
-        rgb = np.clip(np.asarray(out[rgb_key]).reshape(H, W, 3), 0.0, 1.0)
+        # image assembly IS the response; render_flat already scattered to host
+        rgb = np.clip(np.asarray(out[rgb_key]).reshape(H, W, 3), 0.0, 1.0)  # graftlint: ok(host-sync)
         image = (rgb * 255).astype(np.uint8)
         self.cache.put(key, (image, served_tier))
         return image, {"tier": served_tier, "cache_hit": False}

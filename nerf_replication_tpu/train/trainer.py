@@ -222,6 +222,7 @@ class Trainer:
         return self._step_fn(state, bank_rays, bank_rgbs, base_key)
 
     # -- epoch loops ---------------------------------------------------------
+    # graftlint: hot
     def train_epoch(
         self, state, epoch: int, bank, base_key, recorder: Recorder,
         schedule, index_pool=None, log=print,

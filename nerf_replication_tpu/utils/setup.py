@@ -52,12 +52,19 @@ def configure_runtime(cfg) -> None:
 
 def load_trained_network(cfg, verbose: bool = True):
     """Returns ``(network, params, epoch)`` with params from the trained
-    checkpoint (epoch selected by ``cfg.test.epoch``; -1 → latest)."""
+    checkpoint (epoch selected by ``cfg.test.epoch``; -1 → latest).
+
+    The init key threads ``cfg.seed`` (the values are overwritten by the
+    checkpoint load, but the param-tree STRUCTURE must come from the same
+    stream the trainer used — a hardcoded key here would silently diverge
+    from a seed-varied training run for any init whose shapes depend on
+    the draw)."""
     from ..models import init_params_for, make_network
     from ..train.checkpoint import load_network
 
     network = make_network(cfg)
-    params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
+    init_key = jax.random.PRNGKey(int(cfg.get("seed", 0)))
+    params = init_params_for(cfg)(network, init_key)
     params, epoch = load_network(
         cfg.trained_model_dir, params, epoch=int(cfg.test.get("epoch", -1))
     )

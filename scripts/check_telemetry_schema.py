@@ -13,8 +13,12 @@ telemetry schema (``obs/schema.py:ROW_KINDS``); every other JSONL is
 checked structurally against the known bench row families — so a bench
 script that drifts shape (the pre-PR-1 failure mode: three incompatible
 row families grew across ten scripts) fails here instead of silently
-producing a fourth. Exit code is nonzero on any invalid row; host-only
-(no JAX import).
+producing a fourth. The committed ``graftlint_baseline.json`` (the static
+analysis gate's accepted-findings set, docs/static_analysis.md) rides in
+the default set too, validated against analysis/baseline.py's schema — a
+hand-edited baseline that drops a required field fails here, not at the
+next lint run. Exit code is nonzero on any invalid row; host-only (no JAX
+import).
 """
 
 from __future__ import annotations
@@ -34,8 +38,22 @@ from nerf_replication_tpu.obs.schema import (  # noqa: E402
 )
 
 
+def check_baseline_file(path: str) -> list[str]:
+    """Errors for a graftlint baseline JSON (whole-file, not JSONL)."""
+    from nerf_replication_tpu.analysis.baseline import validate_baseline_data
+
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except ValueError as e:
+        return [f"{path}: unparseable JSON: {e}"]
+    return [f"{path}: {e}" for e in validate_baseline_data(data)]
+
+
 def check_file(path: str, max_report: int = 5) -> list[str]:
     """Errors for one file (truncated to ``max_report`` rows' worth)."""
+    if os.path.basename(path).startswith("graftlint_baseline"):
+        return check_baseline_file(path)
     telemetry = os.path.basename(path).startswith("telemetry")
     validate = validate_row if telemetry else validate_bench_row
     errors: list[str] = []
@@ -67,7 +85,7 @@ def check_file(path: str, max_report: int = 5) -> list[str]:
 def default_paths() -> list[str]:
     """The repo's committed JSONL measurement trails."""
     pats = ("BENCH_*.jsonl", "PROFILE_STEP.jsonl", "QUALITY*.jsonl",
-            "SCALE_CHECK.jsonl")
+            "SCALE_CHECK.jsonl", "graftlint_baseline.json")
     paths: list[str] = []
     for pat in pats:
         paths.extend(sorted(glob.glob(os.path.join(_REPO, pat))))

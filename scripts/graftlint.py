@@ -1,0 +1,153 @@
+"""graftlint CLI: the repo's JAX-aware static-analysis gate.
+
+    python scripts/graftlint.py                      # full default scan
+    python scripts/graftlint.py nerf_replication_tpu/serve
+    python scripts/graftlint.py --format json
+    python scripts/graftlint.py --write-baseline     # regenerate baseline
+    python scripts/graftlint.py --no-baseline        # raw findings, no gate
+
+Exit code is nonzero exactly when there are NEW findings — ones absent
+from the committed ``graftlint_baseline.json`` — so CI (tier-1's
+tests/test_analysis.py lint gate) fails on a fresh hazard while accepted
+legacy findings ride in the baseline until someone fixes them. Rule
+catalog + suppression syntax: docs/static_analysis.md.
+
+Every run appends one schema-valid ``lint_run`` telemetry row
+(obs/schema.py) to ``logs/graftlint/telemetry.jsonl`` (``--telemetry`` to
+redirect, ``--no-telemetry`` to skip); ``scripts/tlm_report.py``
+summarizes them next to the training/serving rows. Host-only: no JAX
+import anywhere on this path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from nerf_replication_tpu.analysis import (  # noqa: E402
+    BASELINE_FILENAME,
+    DEFAULT_SCAN,
+    diff_baseline,
+    lint_paths,
+    load_baseline,
+    render_json,
+    render_text,
+    rule_counts,
+    save_baseline,
+)
+
+DEFAULT_TELEMETRY = os.path.join("logs", "graftlint", "telemetry.jsonl")
+
+
+def emit_lint_run(path: str, *, n_findings: int, n_new: int, n_baselined: int,
+                  duration_s: float, counts: dict, n_files: int,
+                  exit_code: int, baseline_path: str) -> None:
+    from nerf_replication_tpu.obs.emit import Emitter
+
+    emitter = Emitter(path, chief=True)
+    try:
+        emitter.emit(
+            "lint_run",
+            n_findings=n_findings,
+            n_new=n_new,
+            n_baselined=n_baselined,
+            duration_s=duration_s,
+            rule_counts=counts,
+            n_files=n_files,
+            exit_code=exit_code,
+            baseline_path=baseline_path,
+        )
+    finally:
+        emitter.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="JAX-aware static analysis gate (rules R1-R6; "
+                    "docs/static_analysis.md)"
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to lint (default: package + scripts + entrypoints)",
+    )
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help=f"baseline file (default: <repo>/{BASELINE_FILENAME})",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline: every finding is new (and fails)",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept the current findings as the new baseline and exit 0",
+    )
+    p.add_argument(
+        "--rules", default=None, metavar="R1,R2",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--telemetry", default=None, metavar="JSONL",
+        help=f"lint_run telemetry sink (default: <repo>/{DEFAULT_TELEMETRY})",
+    )
+    p.add_argument("--no-telemetry", action="store_true")
+    args = p.parse_args(argv)
+
+    t0 = time.perf_counter()
+    scan = args.paths or [
+        os.path.join(_REPO, p) for p in DEFAULT_SCAN
+    ]
+    rules = tuple(r.strip() for r in args.rules.split(",")) if args.rules \
+        else None
+    findings, errors = lint_paths(scan, repo_root=_REPO, rules=rules)
+
+    baseline_path = args.baseline or os.path.join(_REPO, BASELINE_FILENAME)
+    if args.write_baseline:
+        save_baseline(baseline_path, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to {baseline_path}"
+        )
+        return 0
+
+    baseline: set = set()
+    if not args.no_baseline and os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+    new, accepted, n_fixed = diff_baseline(findings, baseline)
+    duration = time.perf_counter() - t0
+    exit_code = 1 if (new or errors) else 0
+
+    if args.format == "json":
+        print(render_json(new, accepted, n_fixed, errors=errors,
+                          duration_s=duration))
+    else:
+        print(render_text(new, accepted, n_fixed, errors=errors))
+
+    if not args.no_telemetry:
+        telem = args.telemetry or os.path.join(_REPO, DEFAULT_TELEMETRY)
+        try:
+            emit_lint_run(
+                telem,
+                n_findings=len(findings),
+                n_new=len(new),
+                n_baselined=len(accepted),
+                duration_s=duration,
+                counts=rule_counts(findings),
+                n_files=len({f.path for f in findings}) if findings else 0,
+                exit_code=exit_code,
+                baseline_path=os.path.relpath(baseline_path, _REPO),
+            )
+        except OSError as e:  # telemetry must never break the gate
+            print(f"warning: lint_run telemetry not written: {e}",
+                  file=sys.stderr)
+
+    return exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

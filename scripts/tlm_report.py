@@ -168,6 +168,18 @@ def summarize(rows: list[dict]) -> dict:
             sum(1 for r in ok if r.get("cache_hit")) / len(ok) if ok else None
         )
         summary["serve_tiers"] = tiers
+
+    # static-analysis rows (scripts/graftlint.py): the latest run's
+    # new-vs-baselined split and rule mix — keys present only when the
+    # stream carries lint_run rows (logs/graftlint/telemetry.jsonl)
+    lints = [r for r in rows if r.get("kind") == "lint_run"]
+    if lints:
+        last = lints[-1]
+        summary["lint_runs"] = len(lints)
+        summary["lint_new"] = last.get("n_new")
+        summary["lint_baselined"] = last.get("n_baselined")
+        summary["lint_rule_counts"] = last.get("rule_counts") or {}
+        summary["lint_duration_s"] = last.get("duration_s")
     return summary
 
 
@@ -221,6 +233,17 @@ def print_summary(summary: dict, label: str = "") -> None:
         print(f"    cache hits:  "
               + (f"{hit * 100:.1f}%" if hit is not None else "n/a")
               + f"  tiers: {tiers or 'n/a'}")
+    if summary.get("lint_runs"):
+        rule_mix = " ".join(
+            f"{k}:{v}"
+            for k, v in sorted((summary["lint_rule_counts"] or {}).items())
+        )
+        dur = summary.get("lint_duration_s")
+        print(f"  graftlint:     {summary['lint_new']} new / "
+              f"{summary['lint_baselined']} baselined "
+              f"({summary['lint_runs']} run(s)"
+              + (f", last {dur:.2f}s" if dur is not None else "")
+              + (f"; {rule_mix}" if rule_mix else "") + ")")
 
 
 def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
@@ -248,6 +271,9 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     a, b = base.get("final_psnr"), cand.get("final_psnr")
     if a is not None and b is not None and b < a - 0.1:
         flags.append(f"final psnr dropped {a:.3f} -> {b:.3f}")
+    a, b = base.get("lint_new"), cand.get("lint_new")
+    if a is not None and b is not None and b > a:
+        flags.append(f"graftlint new findings grew {a} -> {b}")
     return flags
 
 

@@ -1,0 +1,540 @@
+"""graftlint engine + CLI gate + runtime sanitizer coverage.
+
+Three layers (docs/static_analysis.md):
+
+* per-rule fixtures — one positive and one negative snippet per rule
+  R1-R6, plus suppression and baseline-diff behavior on the same snippets;
+* the repo gate — the committed tree lints CLEAN against the committed
+  ``graftlint_baseline.json`` through the real CLI entry (this is tier-1's
+  lint gate: a new hazard anywhere in the package fails this test), and a
+  seeded hazard makes the same entry exit nonzero;
+* the runtime sanitizer — zero-retrace and implicit-transfer assertions
+  over warm jitted calls (CompileTracker + jax.transfer_guard).
+
+The engine layer is jax-free; only the sanitizer tests touch jax.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _REPO)
+
+from nerf_replication_tpu.analysis import (  # noqa: E402
+    Finding,
+    diff_baseline,
+    lint_source,
+    load_baseline,
+    save_baseline,
+    validate_baseline_data,
+)
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def lint(src, **kw):
+    return lint_source(src, path="fixture.py", **kw)
+
+
+# --------------------------------------------------------------------------
+# R1 host-sync
+# --------------------------------------------------------------------------
+
+
+def test_host_sync_in_jitted_body_flagged():
+    src = (
+        "import jax\nimport numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x) + 1\n"
+    )
+    assert "host-sync" in _rules_of(lint(src))
+
+
+def test_host_sync_item_and_float_on_jax_value_flagged():
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = x.item()\n"
+        "    b = float(jnp.sum(x))\n"
+        "    return a + b\n"
+    )
+    f = lint(src)
+    assert sum(1 for x in f if x.rule == "host-sync") == 2
+
+
+def test_host_sync_reachable_from_jit_flagged():
+    """Hazard in a helper the jitted body calls — call-graph reachability."""
+    src = (
+        "import jax\nimport numpy as np\n"
+        "def helper(x):\n"
+        "    return np.asarray(x)\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return helper(x)\n"
+    )
+    assert "host-sync" in _rules_of(lint(src))
+
+
+def test_host_sync_hot_marker_covers_dispatch_path():
+    src = (
+        "import numpy as np\n"
+        "# graftlint: hot\n"
+        "def per_request(fn, rays):\n"
+        "    return np.asarray(fn(rays))\n"
+    )
+    assert "host-sync" in _rules_of(lint(src))
+
+
+def test_host_sync_negative_plain_host_code():
+    """np.asarray in unmarked host code (setup, datasets) is fine; so is
+    int() on trace-time constants inside jit."""
+    src = (
+        "import jax\nimport numpy as np\n"
+        "def load(path):\n"
+        "    return np.asarray([1, 2])\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    n = int(x.shape[0])\n"
+        "    return x * n\n"
+    )
+    assert "host-sync" not in _rules_of(lint(src))
+
+
+# --------------------------------------------------------------------------
+# R2 retrace
+# --------------------------------------------------------------------------
+
+
+def test_retrace_jit_in_loop_flagged():
+    src = (
+        "import jax\n"
+        "def bench(xs):\n"
+        "    for x in xs:\n"
+        "        f = jax.jit(lambda a: a + 1)\n"
+        "        f(x)\n"
+    )
+    assert "retrace" in _rules_of(lint(src))
+
+
+def test_retrace_varying_slice_into_jit_flagged():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x + 1\n"
+        "def serve(x, n):\n"
+        "    return f(x[:n])\n"
+    )
+    assert "retrace" in _rules_of(lint(src))
+
+
+def test_retrace_negative_hoisted_jit_and_padded_call():
+    src = (
+        "import jax\nimport numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x + 1\n"
+        "def serve(x):\n"
+        "    padded = np.pad(x, ((0, 4096 - x.shape[0]), (0, 0)))\n"
+        "    return f(padded)\n"
+        "def bench(xs):\n"
+        "    for x in xs:\n"
+        "        f(x)\n"
+    )
+    assert "retrace" not in _rules_of(lint(src))
+
+
+# --------------------------------------------------------------------------
+# R3 donate
+# --------------------------------------------------------------------------
+
+
+def test_donate_missing_on_train_step_flagged():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(state, batch):\n"
+        "    grads = batch\n"
+        "    return state.apply_gradients(grads=grads)\n"
+    )
+    assert "donate" in _rules_of(lint(src))
+
+
+def test_donate_call_form_lambda_flagged():
+    src = (
+        "import jax\n"
+        "opt = jax.jit(lambda state, g: state.apply_gradients(grads=g))\n"
+    )
+    assert "donate" in _rules_of(lint(src))
+
+
+def test_donate_negative_when_donated_or_not_step_shaped():
+    src = (
+        "import jax\nfrom functools import partial\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(state, batch):\n"
+        "    return state.apply_gradients(grads=batch)\n"
+        "@jax.jit\n"
+        "def render(params, rays):\n"
+        "    return rays * 2\n"
+    )
+    assert "donate" not in _rules_of(lint(src))
+
+
+# --------------------------------------------------------------------------
+# R4 rng
+# --------------------------------------------------------------------------
+
+
+def test_rng_hardcoded_key_flagged_in_library_path():
+    src = "import jax\nkey = jax.random.PRNGKey(0)\n"
+    found = lint_source(src, path="nerf_replication_tpu/foo.py")
+    assert "rng" in _rules_of(found)
+
+
+def test_rng_hardcoded_key_exempt_in_scripts():
+    src = "import jax\nkey = jax.random.PRNGKey(0)\n"
+    found = lint_source(src, path="scripts/bench_foo.py")
+    assert "rng" not in _rules_of(found)
+
+
+def test_rng_key_reuse_flagged():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    b = jax.random.uniform(key, (3,))\n"
+        "    return a + b\n"
+    )
+    assert "rng" in _rules_of(lint(src))
+
+
+def test_rng_use_after_split_flagged():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    k1, k2 = jax.random.split(key)\n"
+        "    return jax.random.normal(key, (3,))\n"
+    )
+    assert "rng" in _rules_of(lint(src))
+
+
+def test_rng_loop_without_fold_flagged():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    out = []\n"
+        "    for i in range(4):\n"
+        "        out.append(jax.random.normal(key, (3,)))\n"
+        "    return out\n"
+    )
+    assert "rng" in _rules_of(lint(src))
+
+
+def test_rng_negative_split_branches_and_fold():
+    """split-then-consume, if/else arms, and fold_in derivation are the
+    blessed patterns (datasets/sampling.py) — none may flag."""
+    src = (
+        "import jax\n"
+        "def f(key, pool):\n"
+        "    key = jax.random.fold_in(key, 7)\n"
+        "    k1, k2 = jax.random.split(key)\n"
+        "    a = jax.random.normal(k1, (3,))\n"
+        "    if pool is None:\n"
+        "        b = jax.random.uniform(k2, (3,))\n"
+        "    else:\n"
+        "        b = jax.random.randint(k2, (3,), 0, 9)\n"
+        "    return a + b\n"
+    )
+    assert "rng" not in _rules_of(lint(src))
+
+
+# --------------------------------------------------------------------------
+# R5 side-effect
+# --------------------------------------------------------------------------
+
+
+def test_side_effect_print_and_closure_append_flagged():
+    src = (
+        "import jax\n"
+        "acc = []\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    print(x)\n"
+        "    acc.append(x)\n"
+        "    return x\n"
+    )
+    found = [f for f in lint(src) if f.rule == "side-effect"]
+    assert len(found) == 2
+
+
+def test_side_effect_negative_local_append_and_debug_print():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    parts = []\n"
+        "    parts.append(x)\n"
+        "    jax.debug.print('x={x}', x=x)\n"
+        "    return parts[0]\n"
+    )
+    assert "side-effect" not in _rules_of(lint(src))
+
+
+# --------------------------------------------------------------------------
+# R6 config-key
+# --------------------------------------------------------------------------
+
+_KNOWN = {("train",), ("train", "lr"), ("task_arg",), ("seed",)}
+
+
+def test_config_key_unknown_flagged():
+    src = (
+        "def setup(cfg):\n"
+        "    lr = cfg.train.lr\n"
+        "    return cfg.get('definitely_not_a_key', 1)\n"
+    )
+    found = lint(src, config_keys=_KNOWN)
+    assert "config-key" in _rules_of(found)
+
+
+def test_config_key_negative_known_dynamic_and_subconfig():
+    src = (
+        # root cfg: known keys + task_arg sub-keys are plugin territory
+        "def setup(cfg):\n"
+        "    lr = cfg.train.lr\n"
+        "    n = cfg.task_arg.get('N_rays', 1024)\n"
+        "    return lr, n\n"
+        # encoder sub-config also named cfg: no known top-level key is
+        # touched, so the scope is NOT treated as the root config
+        "def encoder(cfg):\n"
+        "    return cfg.get('num_levels', 16)\n"
+    )
+    assert "config-key" not in _rules_of(lint(src, config_keys=_KNOWN))
+
+
+# --------------------------------------------------------------------------
+# suppression + baseline workflow
+# --------------------------------------------------------------------------
+
+_HAZARD = (
+    "import jax\nimport numpy as np\n"
+    "@jax.jit\n"
+    "def f(x):\n"
+    "    return np.asarray(x)\n"
+)
+
+
+def test_inline_suppression_silences_rule():
+    src = _HAZARD.replace(
+        "    return np.asarray(x)\n",
+        "    return np.asarray(x)  # graftlint: ok(host-sync: fixture)\n",
+    )
+    assert "host-sync" not in _rules_of(lint(src))
+
+
+def test_suppression_is_rule_scoped():
+    src = _HAZARD.replace(
+        "    return np.asarray(x)\n",
+        "    return np.asarray(x)  # graftlint: ok(rng)\n",
+    )
+    assert "host-sync" in _rules_of(lint(src))
+
+
+def test_standalone_suppression_covers_next_line():
+    src = _HAZARD.replace(
+        "    return np.asarray(x)\n",
+        "    # graftlint: ok(host-sync)\n    return np.asarray(x)\n",
+    )
+    assert "host-sync" not in _rules_of(lint(src))
+
+
+def test_skip_file_pragma():
+    assert lint("# graftlint: skip-file\n" + _HAZARD) == []
+
+
+def test_baseline_roundtrip_and_diff(tmp_path):
+    findings = lint(_HAZARD)
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, findings)
+    baseline = load_baseline(path)
+
+    # same findings: nothing new
+    new, accepted, n_fixed = diff_baseline(findings, baseline)
+    assert new == [] and len(accepted) == len(findings) and n_fixed == 0
+
+    # a fresh finding on top of the baselined one is NEW; line numbers
+    # moving must NOT resurrect baselined findings
+    shifted = lint("\n# a comment shifting every line\n" + _HAZARD)
+    new, accepted, _ = diff_baseline(shifted, baseline)
+    assert new == [] and accepted
+
+    extra = shifted + [
+        Finding("rng", "fixture.py", 99, 0, "msg", "key = PRNGKey(0)")
+    ]
+    new, _, _ = diff_baseline(extra, baseline)
+    assert [f.rule for f in new] == ["rng"]
+
+    # fixing the hazard shows up as baseline shrink
+    new, accepted, n_fixed = diff_baseline([], baseline)
+    assert new == [] and accepted == [] and n_fixed == len(baseline)
+
+
+def test_baseline_schema_validation(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, lint(_HAZARD))
+    with open(path) as f:
+        data = json.load(f)
+    assert validate_baseline_data(data) == []
+    del data["findings"][0]["snippet"]
+    assert validate_baseline_data(data)
+    assert validate_baseline_data({"version": 1}) != []
+
+
+# --------------------------------------------------------------------------
+# the repo gate (tier-1's lint registration) + CLI behavior
+# --------------------------------------------------------------------------
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_cli", os.path.join(_REPO, "scripts", "graftlint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_lints_clean_against_committed_baseline(capsys):
+    """THE gate: package + scripts + entrypoints produce zero findings
+    beyond graftlint_baseline.json. A new hazard anywhere fails here."""
+    cli = _load_cli()
+    rc = cli.main(["--no-telemetry"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"graftlint found new hazards:\n{out}"
+
+
+def test_cli_exits_nonzero_on_seeded_hazard(tmp_path, capsys):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(_HAZARD)
+    cli = _load_cli()
+    rc = cli.main([str(bad), "--no-telemetry"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "host-sync" in out
+
+
+def test_cli_json_format_and_telemetry(tmp_path, capsys):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(_HAZARD)
+    telem = tmp_path / "telemetry.jsonl"
+    cli = _load_cli()
+    rc = cli.main(
+        [str(bad), "--format", "json", "--telemetry", str(telem)]
+    )
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["n_new"] == 1 and report["new"][0]["rule"] == "host-sync"
+
+    # the emitted lint_run row is schema-valid
+    from nerf_replication_tpu.obs.schema import validate_row
+
+    rows = [
+        json.loads(line) for line in telem.read_text().splitlines() if line
+    ]
+    assert len(rows) == 1 and rows[0]["kind"] == "lint_run"
+    assert validate_row(rows[0]) == []
+    assert rows[0]["n_new"] == 1 and rows[0]["exit_code"] == 1
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(_HAZARD)
+    baseline = tmp_path / "baseline.json"
+    cli = _load_cli()
+    assert cli.main(
+        [str(bad), "--baseline", str(baseline), "--write-baseline"]
+    ) == 0
+    capsys.readouterr()
+    assert cli.main(
+        [str(bad), "--baseline", str(baseline), "--no-telemetry"]
+    ) == 0
+    assert "1 baselined" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------------
+# runtime sanitizer
+# --------------------------------------------------------------------------
+
+
+def test_sanitizer_passes_warm_steady_state():
+    import jax
+    import jax.numpy as jnp
+
+    from nerf_replication_tpu.analysis import sanitizer
+    from nerf_replication_tpu.obs import CompileTracker
+
+    tracker = CompileTracker()
+    step = tracker.wrap("san_step", jax.jit(lambda x: x * 2))
+    x = jnp.ones((8,))
+    jax.block_until_ready(step(x))  # warm-up compile outside the region
+    with sanitizer(tracker) as probe:
+        for _ in range(4):
+            x = step(x)
+        jax.block_until_ready(x)
+    assert probe.compiles == 0
+
+
+def test_sanitizer_raises_on_retrace():
+    import jax
+    import jax.numpy as jnp
+
+    from nerf_replication_tpu.analysis import SanitizerError, sanitizer
+    from nerf_replication_tpu.obs import CompileTracker
+
+    tracker = CompileTracker()
+    step = tracker.wrap("san_retrace", jax.jit(lambda x: x + 1))
+    jax.block_until_ready(step(jnp.ones((8,))))
+    with pytest.raises(SanitizerError, match="san_retrace"):
+        with sanitizer(tracker, transfers=None):
+            step(jnp.ones((16,)))  # new shape => retrace inside the region
+
+
+def test_sanitizer_blocks_implicit_transfer():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nerf_replication_tpu.analysis import sanitizer
+
+    f = jax.jit(lambda x: x * 2)
+    x_dev = jnp.ones((8,))
+    jax.block_until_ready(f(x_dev))
+    with sanitizer(None, transfers="disallow"):
+        jax.block_until_ready(f(x_dev))  # warm, device-resident: clean
+    with pytest.raises(Exception, match="[Dd]isallowed"):
+        with sanitizer(None, transfers="disallow"):
+            f(np.ones((8,), np.float32))  # numpy sneaks in: implicit h2d
+
+
+def test_sanitizer_allow_compiles_budget():
+    import jax
+    import jax.numpy as jnp
+
+    from nerf_replication_tpu.analysis import sanitizer
+    from nerf_replication_tpu.obs import CompileTracker
+
+    tracker = CompileTracker()
+    step = tracker.wrap("san_budget", jax.jit(lambda x: x - 1))
+    with sanitizer(tracker, transfers=None, allow_compiles=1) as probe:
+        jax.block_until_ready(step(jnp.ones((4,))))  # first-call compile
+    assert probe.compiles == 1
+    assert probe.compile_names == {"san_budget": 1}

@@ -2,8 +2,8 @@
 
 PR 1 made recompile storms, host-dispatch stalls, and HBM creep observable
 at runtime; this package catches them at review time. An AST engine
-(``core``) runs six codebase-tuned rules (``rules``: host-sync, retrace,
-donate, rng, side-effect, config-key) over the package and entrypoints,
+(``core``) runs seven codebase-tuned rules (``rules``: host-sync, retrace,
+donate, rng, side-effect, config-key, aot) over the package and entrypoints,
 gated through a committed baseline of accepted legacy findings
 (``baseline``, ``graftlint_baseline.json``) so only NEW hazards fail.
 ``scripts/graftlint.py`` is the CLI; tier-1 runs it via

@@ -1,4 +1,4 @@
-"""graftlint rules R1-R6 — JAX hazards tuned to this codebase's idioms.
+"""graftlint rules R1-R7 — JAX hazards tuned to this codebase's idioms.
 
 Each rule encodes one of the failure modes PR 1's telemetry made observable
 at runtime (obs/: CompileTracker retraces, dispatch-vs-block stalls, HBM
@@ -21,6 +21,9 @@ rule id                hazard
                        traced body — runs at trace time, leaks tracers
 ``config-key``  (R6)   ``cfg.*`` accesses that no default/YAML defines, and
                        default keys nothing reads
+``aot``         (R7)   library-code ``jax.jit`` not routed through the AOT
+                       registry (compile/registry.py) — first caller pays
+                       the compile inline at dispatch time
 =====================  ==========================================================
 """
 
@@ -933,3 +936,107 @@ class ConfigKeyRule(Rule):
                 )
             )
         return out
+
+
+# --------------------------------------------------------------------------
+# R7 aot
+# --------------------------------------------------------------------------
+
+
+@register
+class AotRule(Rule):
+    rule_id = "aot"
+    doc = (
+        "library-code jax.jit not routed through the AOT registry "
+        "(compile/registry.py): the first caller pays the compile inline "
+        "at dispatch time instead of during warm-up, and the executable "
+        "never reaches the serialized-artifact cache"
+    )
+
+    # only package code owes the registry a signature; scripts, tests and
+    # the CLI entrypoints are one-shot processes where lazy jit is fine,
+    # and the registry itself obviously builds executables directly
+    LIB_PREFIX = "nerf_replication_tpu/"
+    EXEMPT_PREFIXES = (
+        "nerf_replication_tpu/compile/",
+        "nerf_replication_tpu/analysis/",
+    )
+
+    _MSG = (
+        "jax.jit constructed in library code without AOT-registry routing "
+        "— the first call pays the compile inline at dispatch time; hand "
+        "the callable to AOTRegistry.register (compile/registry.py) so it "
+        "is built during warm-up (and can be served from the artifact "
+        "cache), or mark intentional with `# graftlint: ok(aot: why)`"
+    )
+
+    def check(self, module: ModuleContext) -> list[Finding]:
+        rel = module.rel_path.replace(os.sep, "/")
+        if not rel.startswith(self.LIB_PREFIX):
+            return []
+        if any(rel.startswith(p) for p in self.EXEMPT_PREFIXES):
+            return []
+        routed_names, routed_nodes = self._register_routing(module)
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            site: ast.AST | None = None
+            owner: str | None = None
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if is_jit_expr(dec) or jit_call_of(dec) is not None:
+                        site, owner = dec, node.name
+                        break
+            elif isinstance(node, ast.Call):
+                if jit_call_of(node) is node:
+                    site = node
+            if site is None or id(site) in routed_nodes:
+                continue
+            if self._routed(module, node, site, owner, routed_names):
+                continue
+            f = module.finding(self.rule_id, site, self._MSG)
+            if f:
+                findings.append(f)
+        return findings
+
+    def _register_routing(self, module: ModuleContext):
+        """Names and jit-Call nodes that flow into ``*.register(...)``
+        calls on an aot/registry object anywhere in the module. A builder
+        whose NAME is handed to the registry (``aot.register("k",
+        self._build_step(...), sig)``) routes every jit it constructs."""
+        names: set[str] = set()
+        nodes: set[int] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] != "register":
+                continue
+            if not any(seg in ("aot", "registry") for seg in chain[:-1]):
+                continue
+            for arg in node.args + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+                    elif isinstance(sub, ast.Attribute):
+                        names.add(sub.attr)
+                    if isinstance(sub, ast.Call) and jit_call_of(sub) is sub:
+                        nodes.add(id(sub))
+        return names, nodes
+
+    def _routed(
+        self,
+        module: ModuleContext,
+        node: ast.AST,
+        site: ast.AST,
+        owner: str | None,
+        routed_names: set[str],
+    ) -> bool:
+        if not routed_names:
+            return False
+        if owner is not None and owner in routed_names:
+            return True
+        line = getattr(site, "lineno", getattr(node, "lineno", 1))
+        info = module.enclosing_function(line)
+        if info is None:
+            return False
+        return any(seg in routed_names for seg in info.qualname.split("."))

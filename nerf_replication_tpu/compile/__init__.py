@@ -1,0 +1,42 @@
+"""AOT compile + warm-start subsystem (docs/compilation.md).
+
+Three layers kill the warmup tax the round-5 bench measured (177 s of a
+420 s window on compile + grid warm-up):
+
+* :mod:`.registry` — every jitted entrypoint registers its abstract
+  signature and is lowered+compiled up front, concurrently on host
+  threads, instead of lazily on first dispatch.
+* :mod:`.artifacts` — AOT executables serialize to a repo-anchored store
+  keyed by (name, signature, jax version, backend, config hash); a second
+  process deserializes and performs zero builds.
+* NGP/eval warm-start lives with its state: the live occupancy grid rides
+  in the checkpoint bundle and the trainer's phase counters in a sidecar
+  (train/checkpoint.py, train/ngp.py), so a resumed run re-enters the
+  carved phase directly.
+"""
+
+from .artifacts import (
+    artifact_key,
+    artifact_path,
+    default_artifact_dir,
+    load_artifact,
+    save_artifact,
+)
+from .registry import (
+    AOTRegistry,
+    PrecompiledFn,
+    abstract_like,
+    registry_from_cfg,
+)
+
+__all__ = [
+    "AOTRegistry",
+    "PrecompiledFn",
+    "abstract_like",
+    "artifact_key",
+    "artifact_path",
+    "default_artifact_dir",
+    "load_artifact",
+    "registry_from_cfg",
+    "save_artifact",
+]

@@ -1,0 +1,114 @@
+"""Serialized AOT executables: the disk half of the warm-start story.
+
+An AOT-compiled executable (``jax.jit(...).lower().compile()``) can be
+serialized via ``jax.experimental.serialize_executable`` and reloaded by a
+later process, skipping tracing AND compilation entirely — measured on this
+backend, a deserialized executable dispatches with zero builds
+(CompileTracker count 0). Artifacts are keyed by everything that could
+invalidate them:
+
+* the entry's registered name (program identity),
+* the abstract input signature (shapes/dtypes/pytree structure),
+* ``jax.__version__`` and the backend platform (an XLA upgrade or a
+  cpu→tpu move must miss, never deserialize a stale executable),
+* an optional extra tag (the run's config hash).
+
+Not every executable serializes: the payload pickles the input/output
+pytree *treedefs*, and optax optimizer states close over local functions
+that cannot pickle. ``save_artifact`` therefore degrades silently to "no
+artifact" (the persistent XLA compilation cache still makes the rebuild
+cheap) — serve-engine executables, whose trees are plain dicts, round-trip
+fine and are the case the zero-build serve restart depends on.
+
+Layout: one ``<key>.aot`` file per artifact under the artifact dir
+(default: ``<repo>/data/jax_cache/aot``, riding next to the persistent XLA
+cache from ``utils/platform.enable_compilation_cache``). Writes are atomic
+(tmp + ``os.replace``) so a killed process can never leave a torn artifact
+for the next one to trip over.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+
+def default_artifact_dir() -> str:
+    """Repo-anchored artifact dir (next to the persistent XLA cache)."""
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    return os.path.join(repo_root, "data", "jax_cache", "aot")
+
+
+def _signature(tree) -> str:
+    """Stable fingerprint of a pytree of abstract values (or arrays)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        parts.append(f"{shape}:{dtype}")
+    return "|".join(parts)
+
+
+def artifact_key(name: str, abstract_args, extra: str = "") -> str:
+    """Cache key for one registered entrypoint (see module docstring)."""
+    import jax
+
+    backend = "unknown"
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        pass
+    payload = "\x1f".join(
+        [name, _signature(abstract_args), jax.__version__, backend, extra]
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:32]
+
+
+def artifact_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, f"{key}.aot")
+
+
+def save_artifact(cache_dir: str, key: str, compiled) -> bool:
+    """Persist one compiled executable; False when it cannot serialize
+    (unpicklable treedefs, backend without executable serialization)."""
+    try:
+        from jax.experimental import serialize_executable
+
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        blob = pickle.dumps((payload, in_tree, out_tree))
+    except Exception:
+        return False
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        path = artifact_path(cache_dir, key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False  # read-only FS etc.: degrade to no artifact
+
+
+def load_artifact(cache_dir: str, key: str):
+    """Deserialize one executable, or None (missing/stale/torn artifact —
+    every failure mode degrades to the normal compile path)."""
+    path = artifact_path(cache_dir, key)
+    if not os.path.exists(path):
+        return None
+    try:
+        from jax.experimental import serialize_executable
+
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.loads(f.read())
+        return serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree
+        )
+    except Exception:
+        return None

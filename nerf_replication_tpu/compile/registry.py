@@ -1,0 +1,259 @@
+"""AOT compile registry: every jitted entrypoint built up front, not on
+first dispatch.
+
+The round-5 numbers put the bottleneck at the *ramp*, not the hot loop:
+177 s of a 420 s NGP bench window went to compile + warm-up, and every
+lazily-built executable (train step, scan burst, NGP warm/march variants,
+eval render, serve buckets) pays its compile exactly where it hurts — on
+the first dispatch, serially, inside the timed window. The registry
+inverts that:
+
+* callers :meth:`~AOTRegistry.register` each jitted entrypoint with its
+  **abstract** input signature (``abstract_like`` of the real call args),
+* :meth:`~AOTRegistry.compile_all` lowers + compiles every entry
+  concurrently on host threads (XLA compilation releases the GIL), so
+  compiles overlap dataset loading / checkpoint I/O / each other,
+* :meth:`~AOTRegistry.take` hands the caller a :class:`PrecompiledFn` —
+  blocking only on *that* entry — which the trainer/engine installs in
+  place of the lazy ``jax.jit`` wrapper. A ``PrecompiledFn`` can never
+  retrace (it IS one executable) and reports a constant lowering-cache
+  size, so CompileTracker counts zero builds on dispatch; the build that
+  DID happen is accounted exactly once at compile time via
+  ``CompileTracker.note_compile`` (one ``compile`` telemetry row).
+
+Entries registered with ``serialize=True`` additionally round-trip through
+the artifact store (:mod:`.artifacts`): a later process deserializes the
+executable from disk and performs **zero** builds — the serve engine's
+warm restart. Entries whose trees cannot pickle (optax states) skip the
+disk leg silently; the persistent XLA cache still covers them.
+
+Every failure mode degrades to the lazy path: a registration whose
+lower/compile raises records the error and ``take`` returns None, so the
+caller's ordinary ``jax.jit`` build runs instead — the registry can make
+startup faster, never break it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from .artifacts import (
+    artifact_key,
+    default_artifact_dir,
+    load_artifact,
+    save_artifact,
+)
+
+
+def abstract_like(tree):
+    """Pytree of ShapeDtypeStructs matching ``tree``'s arrays — the
+    abstract signature ``register`` wants, derived from the exact objects
+    the caller will later pass so the compiled executable always
+    structure-matches the dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    def _abstract(a):
+        if isinstance(a, jax.ShapeDtypeStruct):
+            return a  # already abstract (mixed trees are fine)
+        # carry the leaf's sharding: a mesh-sharded state/bank must lower
+        # to the layout the real dispatch will pass, or the compiled
+        # executable rejects its own inputs
+        sharding = getattr(a, "sharding", None)
+        return jax.ShapeDtypeStruct(
+            jnp.shape(a), jnp.result_type(a), sharding=sharding
+        )
+
+    return jax.tree.map(_abstract, tree)
+
+
+class PrecompiledFn:
+    """Drop-in callable around one AOT-compiled (or deserialized)
+    executable. ``_cache_size`` is the CompileTracker probe: a constant 0
+    tells the tracker no lowering cache can ever grow here — dispatching a
+    precompiled executable is never a build."""
+
+    __slots__ = ("name", "fn", "source", "build_s")
+
+    def __init__(self, name: str, fn, source: str, build_s: float = 0.0):
+        self.name = name
+        self.fn = fn
+        self.source = source  # "compiled" | "disk"
+        self.build_s = build_s
+
+    def _cache_size(self) -> int:
+        return 0
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+
+@dataclass
+class _Entry:
+    name: str
+    jitted: object  # the jax.jit-wrapped callable to lower
+    abstract_args: tuple
+    serialize: bool = False
+    result: PrecompiledFn | None = None
+    error: str | None = None
+    wall_s: float = 0.0
+    future: object = field(default=None, repr=False)
+
+
+class AOTRegistry:
+    """Named AOT entrypoints for one process (see module docstring).
+
+    ``tracker`` (a CompileTracker) receives one ``note_compile`` per entry
+    actually built — disk-loaded entries count zero, which is exactly the
+    invariant the cold-start bench asserts.
+    """
+
+    def __init__(self, cache_dir: str | None = None, config_hash: str = "",
+                 tracker=None, enabled: bool = True,
+                 artifacts: bool = True):
+        self.cache_dir = cache_dir or default_artifact_dir()
+        self.config_hash = config_hash
+        self.tracker = tracker
+        self.enabled = enabled
+        self.artifacts = artifacts
+        self._entries: dict[str, _Entry] = {}
+        self._pool: ThreadPoolExecutor | None = None
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, name: str, jitted, abstract_args: tuple,
+                 serialize: bool = False) -> None:
+        """Declare one jitted entrypoint. Re-registering a name replaces
+        the entry (a rebuilt step fn with new static config)."""
+        self._entries[name] = _Entry(
+            name=name, jitted=jitted, abstract_args=tuple(abstract_args),
+            serialize=serialize,
+        )
+
+    def names(self) -> list[str]:
+        return list(self._entries)
+
+    # -- compilation ---------------------------------------------------------
+
+    def _build_one(self, entry: _Entry) -> None:
+        t0 = time.perf_counter()
+        key = artifact_key(entry.name, entry.abstract_args,
+                           extra=self.config_hash)
+        if entry.serialize and self.artifacts:
+            loaded = load_artifact(self.cache_dir, key)
+            if loaded is not None:
+                entry.wall_s = time.perf_counter() - t0
+                entry.result = PrecompiledFn(
+                    entry.name, loaded, "disk", entry.wall_s
+                )
+                return
+        try:
+            compiled = entry.jitted.lower(*entry.abstract_args).compile()
+        except Exception as exc:  # degrade to the caller's lazy build
+            entry.error = f"{type(exc).__name__}: {exc}"
+            return
+        entry.wall_s = time.perf_counter() - t0
+        entry.result = PrecompiledFn(
+            entry.name, compiled, "compiled", entry.wall_s
+        )
+        if self.tracker is not None:
+            self.tracker.note_compile(entry.name, entry.wall_s)
+        if entry.serialize and self.artifacts:
+            save_artifact(self.cache_dir, key, compiled)
+
+    def compile_all(self, wait: bool = True,
+                    max_workers: int | None = None) -> None:
+        """Lower + compile every pending entry on host threads.
+
+        ``wait=False`` returns immediately with compiles in flight —
+        callers overlap dataset loading / checkpoint I/O and pick results
+        up per-entry via :meth:`take` (which blocks only on its entry)."""
+        if not self.enabled:
+            return
+        pending = [
+            e for e in self._entries.values()
+            if e.result is None and e.error is None and e.future is None
+        ]
+        if not pending:
+            return
+        if max_workers is None:
+            max_workers = min(len(pending), max(2, (os.cpu_count() or 4) // 2))
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="aot-compile"
+            )
+        for entry in pending:
+            entry.future = self._pool.submit(self._build_one, entry)
+        if wait:
+            self.wait()
+
+    def wait(self) -> None:
+        """Block until every in-flight compile has landed."""
+        for entry in self._entries.values():
+            if entry.future is not None:
+                entry.future.result()
+                entry.future = None
+
+    def take(self, name: str) -> PrecompiledFn | None:
+        """The precompiled executable for ``name`` (blocking on its
+        in-flight compile), or None — unknown name, disabled registry, or
+        a failed build — in which case the caller's lazy path runs."""
+        if not self.enabled:
+            return None
+        entry = self._entries.get(name)
+        if entry is None:
+            return None
+        if entry.future is not None:
+            entry.future.result()
+            entry.future = None
+        return entry.result
+
+    # -- introspection -------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Inventory for telemetry/stats: per-source counts + build wall."""
+        sources: dict[str, int] = {}
+        errors = []
+        wall = 0.0
+        for e in self._entries.values():
+            if e.result is not None:
+                sources[e.result.source] = sources.get(e.result.source, 0) + 1
+                wall += e.wall_s
+            elif e.error is not None:
+                errors.append(e.name)
+        return {
+            "entries": len(self._entries),
+            "sources": sources,
+            "wall_s": round(wall, 3),
+            "errors": errors,
+        }
+
+    def warm_source(self) -> str:
+        """``disk`` when every resolved entry deserialized from an
+        artifact (the zero-build restart), else ``compiled``."""
+        resolved = [
+            e.result.source for e in self._entries.values()
+            if e.result is not None
+        ]
+        if resolved and all(s == "disk" for s in resolved):
+            return "disk"
+        return "compiled"
+
+
+def registry_from_cfg(cfg, tracker=None) -> AOTRegistry | None:
+    """The config-gated registry (``cfg.compile``): None when AOT is
+    switched off, so call sites keep their lazy-jit behavior untouched."""
+    from ..obs.emit import config_hash
+
+    c = cfg.get("compile", {})
+    if not bool(c.get("aot", True)):
+        return None
+    return AOTRegistry(
+        cache_dir=c.get("dir", "") or None,
+        config_hash=config_hash(cfg),
+        tracker=tracker,
+        artifacts=bool(c.get("artifacts", True)),
+    )

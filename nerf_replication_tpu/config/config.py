@@ -163,6 +163,21 @@ def default_cfg() -> ConfigNode:
         }
     )
 
+    # AOT compile registry (nerf_replication_tpu/compile, docs/compilation.md):
+    # aot routes every registered jitted entrypoint through
+    # lower().compile() up front on host threads (overlapping dataset /
+    # checkpoint I/O) instead of building on first dispatch; artifacts
+    # additionally serializes picklable executables (serve buckets, NGP
+    # eval renders) to dir — "" anchors <repo>/data/jax_cache/aot — so a
+    # second process deserializes instead of compiling at all
+    cfg.compile = ConfigNode(
+        {
+            "aot": True,
+            "artifacts": True,
+            "dir": "",
+        }
+    )
+
     return cfg
 
 

@@ -107,6 +107,27 @@ class CompileTracker:
             self._fns[name] = tracked
         return tracked
 
+    def note_compile(self, name: str, wall_s: float) -> None:
+        """Account one build that happened OUTSIDE a wrapped call — the
+        AOT registry compiling an entrypoint up front (compile/registry).
+        The later ``wrap`` of the precompiled fn under the same name
+        carries this count forward, so ``counts()`` stays the run's honest
+        inventory whether an executable was built lazily or ahead of
+        time; precompiled dispatches themselves can never re-count (their
+        lowering-cache probe is a constant)."""
+        tracked = self._fns.get(name)
+        if tracked is None:
+            tracked = self._fns[name] = _TrackedFn(name, fn=None)
+        tracked.n_compiles += 1
+        get_emitter().emit(
+            "compile",
+            name=name,
+            n_compiles=tracked.n_compiles,
+            wall_s=wall_s,
+            call_index=tracked.n_calls,
+            steady_p50_s=tracked.steady_p50(),
+        )
+
     def counts(self) -> dict[str, int]:
         return {name: t.n_compiles for name, t in self._fns.items()}
 

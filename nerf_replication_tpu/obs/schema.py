@@ -72,7 +72,11 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
     ),
     "compile": (
         {"name": (str,), "n_compiles": _NUM, "wall_s": _NUM},
-        {"call_index": _NUM, "steady_p50_s": _OPT_NUM, "step": _OPT_NUM},
+        # cap_old/cap_new: packed-eval stream cap escalation (train/ngp.py
+        # render_image) — the rebuild rides a compile row so
+        # `tlm_report --diff` flags an escalating run as a regression
+        {"call_index": _NUM, "steady_p50_s": _OPT_NUM, "step": _OPT_NUM,
+         "cap_old": _NUM, "cap_new": _NUM},
     ),
     "memory": (
         {"devices": (list,)},
@@ -178,6 +182,11 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # scripts/serve_bench.py summary rows (BENCH_SERVE.jsonl): one row per
     # closed/open-loop run of the serving load generator
     "serve_mode": ("n_requests", "p50_ms"),
+    # scripts/bench_cold_start.py rows (BENCH_COLDSTART.jsonl): one row per
+    # child process measuring start→first-step / start→first-response under
+    # a cold vs warm compile cache. NOTE: these rows must not carry any
+    # earlier discriminator key above (bench_family is first-match).
+    "coldstart": ("mode", "wall_s"),
 }
 
 

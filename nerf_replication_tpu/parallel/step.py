@@ -32,6 +32,7 @@ from ..train.step_core import sampled_grad_step, scan_k_steps
 from .collectives import tree_pmean
 from .mesh import DATA_AXIS
 from .sharding import data_sharding, tree_shardings
+from ..utils.platform import donation_argnums
 
 
 def build_dp_step(
@@ -96,7 +97,7 @@ def build_dp_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(smap, donate_argnums=(0,))
+    return jax.jit(smap, donate_argnums=donation_argnums(0))
 
 
 def build_gspmd_step(
@@ -214,7 +215,7 @@ def build_gspmd_step(
 
         return scan_k_steps(body, state, k_steps)
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jax.jit(step, donate_argnums=donation_argnums(0))
 
 
 def shard_train_state(state, mesh: Mesh):

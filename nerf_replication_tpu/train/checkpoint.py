@@ -79,7 +79,7 @@ def remove_param_layers(params, layers):
 
 def _bundle(state, epoch: int, recorder_state: dict | None):
     rs = recorder_state or {}
-    return {
+    bundle = {
         "params": state.params,
         "opt_state": state.opt_state,
         "step": np.asarray(state.step),
@@ -90,16 +90,42 @@ def _bundle(state, epoch: int, recorder_state: dict | None):
             "epoch": np.asarray(int(rs.get("epoch", 0))),
         },
     }
+    # NGP warm-start: the live occupancy grid is STATE (a resumed run that
+    # re-warms it from scratch re-pays 100+ s of grid discovery and
+    # re-enters the warm phase — docs/compilation.md). Save and restore
+    # both derive their template from the caller's state object, so the
+    # schema stays matched per state type: legacy TrainStates never see
+    # the key, NGPTrainStates always do.
+    grid = getattr(state, "grid_ema", None)
+    if grid is not None:
+        bundle["grid_ema"] = grid
+    return bundle
 
 
 def _recorder_sidecar(model_dir: str, name: str) -> str:
     return os.path.join(model_dir, f"{name}_recorder.json")
 
 
+def _phase_sidecar(model_dir: str, name: str) -> str:
+    return os.path.join(model_dir, f"{name}_phase.json")
+
+
 def save_model(model_dir: str, state, epoch: int, recorder_state=None,
-               latest: bool = False) -> str:
-    """Save a checkpoint bundle; prune numbered checkpoints to KEEP_EPOCHS."""
+               latest: bool = False, phase_state=None) -> str:
+    """Save a checkpoint bundle; prune numbered checkpoints to KEEP_EPOCHS.
+
+    ``phase_state``: the NGP trainer's host-side warm/carve phase counters
+    (``NGPTrainer.phase_state()``) — a small JSON sidecar like the
+    recorder's, so a resumed run re-enters the exact phase it left instead
+    of re-estimating it from occupancy."""
     import json
+
+    # The NGP step executables donate their input state: the dispatch in
+    # flight writes its output IN PLACE into the aliased buffers. A save
+    # issued before that dispatch lands can snapshot a torn bundle (stale
+    # step alongside half-written grid rows), so force the sync here —
+    # saving is a host round-trip anyway.
+    state = jax.block_until_ready(state)
 
     os.makedirs(model_dir, exist_ok=True)
     name = "latest" if latest else str(epoch)
@@ -120,6 +146,12 @@ def save_model(model_dir: str, state, epoch: int, recorder_state=None,
         with open(tmp, "w") as f:
             json.dump(recorder_state, f)
         os.replace(tmp, sidecar)
+    if phase_state:
+        sidecar = _phase_sidecar(model_dir, name)
+        tmp = sidecar + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(phase_state, f)
+        os.replace(tmp, sidecar)
 
     if not latest:
         numbered = sorted(
@@ -127,9 +159,10 @@ def save_model(model_dir: str, state, epoch: int, recorder_state=None,
         )
         for old in numbered[:-KEEP_EPOCHS]:
             shutil.rmtree(os.path.join(model_dir, str(old)), ignore_errors=True)
-            sidecar = _recorder_sidecar(model_dir, str(old))
-            if os.path.exists(sidecar):
-                os.remove(sidecar)
+            for sidecar in (_recorder_sidecar(model_dir, str(old)),
+                            _phase_sidecar(model_dir, str(old))):
+                if os.path.exists(sidecar):
+                    os.remove(sidecar)
     return path
 
 
@@ -157,12 +190,24 @@ def load_model(model_dir: str, state, epoch: int = -1):
 
     ckptr = ocp.StandardCheckpointer()
     template = _bundle(state, 0, {})
-    restored = ckptr.restore(_abs(target), target=template)
+    try:
+        restored = ckptr.restore(_abs(target), target=template)
+    except Exception:
+        if "grid_ema" not in template:
+            raise
+        # legacy NGP checkpoint (saved before the grid rode the bundle):
+        # restore what it has; the grid keeps the caller's warm start
+        template.pop("grid_ema")
+        restored = ckptr.restore(_abs(target), target=template)
     new_state = state.replace(
         params=restored["params"],
         opt_state=restored["opt_state"],
         step=int(restored["step"]),
     )
+    if "grid_ema" in restored:
+        # NGP: the live occupancy grid resumes with the params (warm-start
+        # — see _bundle); only present when the caller's state carries it
+        new_state = new_state.replace(grid_ema=restored["grid_ema"])
     recorder = {k: int(v) for k, v in restored["recorder"].items()}
     # the sidecar carries the full recorder state (SmoothedValue
     # totals/counts); merge it over the bundle's fixed {step, epoch}
@@ -176,6 +221,29 @@ def load_model(model_dir: str, state, epoch: int = -1):
         except (OSError, ValueError):
             pass  # stale/torn sidecar: resume with step/epoch only
     return new_state, int(restored["epoch"]) + 1, recorder
+
+
+def load_phase_state(model_dir: str, epoch: int = -1) -> dict | None:
+    """The NGP phase sidecar matching what ``load_model`` would resume
+    (``latest`` unless a numbered epoch is pinned), or None — a missing or
+    torn sidecar degrades to the trainer's occupancy-based estimate."""
+    if os.path.isdir(os.path.join(model_dir, "latest")) and epoch == -1:
+        name = "latest"
+    else:
+        epochs = _available_epochs(model_dir)
+        if not epochs:
+            return None
+        name = str(epoch if epoch != -1 and epoch in epochs else epochs[-1])
+    sidecar = _phase_sidecar(model_dir, name)
+    if not os.path.exists(sidecar):
+        return None
+    import json
+
+    try:
+        with open(sidecar) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def load_network(model_dir: str, params, epoch: int = -1):

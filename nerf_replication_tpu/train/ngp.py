@@ -60,8 +60,15 @@ import numpy as np
 from flax.training.train_state import TrainState
 
 from ..datasets.sampling import sample_rays, sample_step_key
-from ..obs import CompileTracker, ProfileWindow, init_run, sample_memory
+from ..obs import (
+    CompileTracker,
+    ProfileWindow,
+    get_emitter,
+    init_run,
+    sample_memory,
+)
 from ..renderer.accelerated import MarchOptions, march_rays_accelerated
+from ..utils.platform import donation_argnums
 from .loss import mse, mse_to_psnr
 from .optim import make_optimizer
 
@@ -98,9 +105,18 @@ class NGPTrainer:
         # stream budget in mean samples/ray.
         self.packed_march = bool(ta.get("ngp_packed_march", False))
         self.packed_cap_avg = int(ta.get("ngp_packed_cap_avg", 32))
+        # eval stream cap PRESET to what dense-phase evals actually need
+        # (1024 per the stage-3c trail — battery stage 3c died rebuilding
+        # the eval executable once per escalation). The escalate loop in
+        # render_image stays as the safety net and now telemeters each
+        # firing, so a full run compiling more than one eval executable is
+        # a visible regression, not a silent stall.
         self.packed_cap_avg_eval = int(
-            ta.get("ngp_packed_cap_avg_eval", 4 * self.packed_cap_avg)
+            ta.get(
+                "ngp_packed_cap_avg_eval", max(1024, 4 * self.packed_cap_avg)
+            )
         )
+        self._eval_cap_escalations = 0
         self.grid_res = int(ta.get("ngp_grid_res", 64))
         # density threshold follows the EVAL bake's convention
         # (task_arg.occupancy_grid_threshold, σ=1.0 in the lego family)
@@ -165,6 +181,10 @@ class NGPTrainer:
         # switches are exactly where silent recompiles hide
         self.tracker = CompileTracker()
         self.profile = ProfileWindow.from_cfg(cfg)
+        # AOT compile registry (compile/registry.py): fit_ngp wires one so
+        # step/render executables build up front on host threads; None
+        # keeps the lazy-jit path (direct NGPTrainer users, unit tests)
+        self.aot = None
 
     # -- state ---------------------------------------------------------------
     def make_state(self, key):
@@ -189,6 +209,83 @@ class NGPTrainer:
             apply_fn=self.network.apply, params=params, tx=tx,
             grid_ema=ema0,
         )
+
+    # -- warm/carve phase persistence ---------------------------------------
+    def phase_state(self) -> dict:
+        """Host-side phase counters for the checkpoint sidecar
+        (train/checkpoint.save_model): what a resumed trainer needs to
+        re-enter the EXACT phase — the occupancy-based estimate in
+        multi_step only approximates cumulative warm steps."""
+        if self._host_step is None:
+            return {}
+        return {
+            "host_step": int(self._host_step),
+            "last_occ": float(self._last_occ),
+            "warm_steps_total": int(self._warm_steps_total),
+            "bursts": int(self._bursts),
+            "trunc_warned": bool(self._trunc_warned),
+        }
+
+    def restore_phase(self, phase: dict | None,
+                      expect_step: int | None = None) -> bool:
+        """Adopt persisted phase counters; False (→ the occupancy
+        heuristic runs instead) on a missing sidecar or one that doesn't
+        match the restored bundle's step (a torn save pair must not pin
+        the trainer to a phase the grid isn't in)."""
+        if not phase or "warm_steps_total" not in phase:
+            return False
+        if expect_step is not None and int(phase.get("host_step", -1)) != int(
+            expect_step
+        ):
+            return False
+        self._host_step = int(phase["host_step"])
+        self._last_occ = float(phase.get("last_occ", 1.0))
+        self._warm_steps_total = int(phase["warm_steps_total"])
+        self._bursts = int(phase.get("bursts", 0))
+        self._trunc_warned = bool(phase.get("trunc_warned", False))
+        return True
+
+    # -- AOT registration (compile/registry.py) ------------------------------
+    def aot_register_steps(self, state, bank, base_key) -> None:
+        """Register both phase variants of the scan-burst executable so
+        the carve-phase program compiles concurrently with warm-phase
+        training instead of serially at the phase switch (the round-5
+        warmup tax). Clamped boundary bursts still build lazily."""
+        if self.aot is None:
+            return
+        from ..compile import abstract_like
+
+        args = abstract_like((state, bank[0], bank[1], base_key))
+        k = self.scan_steps
+        for warm in (True, False):
+            name = f"ngp_step_k{k}_{'warm' if warm else 'march'}"
+            self.aot.register(name, self._jit_step(k, warm=warm), args)
+        self.aot.compile_all(wait=False)
+
+    def aot_register_render(self, state, n_rays_image: int) -> None:
+        """Pre-build the packed/accelerated eval executable for one test
+        image's ray count at the preset cap — the first val no longer
+        blocks on its compile, and a warm process deserializes it."""
+        if self.aot is None:
+            return
+        from ..compile import abstract_like
+        from ..renderer.volume import _pad_to_chunks
+
+        rays = jnp.zeros((int(n_rays_image), 6), jnp.float32)
+        rays_p, _, n_chunks, chunk = _pad_to_chunks(
+            rays, self.eval_march.chunk_size
+        )
+        grid_sds = jax.ShapeDtypeStruct((self.grid_res,) * 3, jnp.bool_)
+        name = (
+            f"ngp_render_{n_chunks}x{chunk}_cap{self.packed_cap_avg_eval}"
+        )
+        self.aot.register(
+            name,
+            self._build_render(n_chunks, chunk),
+            abstract_like((state.params, rays_p, grid_sds)),
+            serialize=True,
+        )
+        self.aot.compile_all(wait=False)
 
     # -- jitted step ---------------------------------------------------------
     def _build_step(self, axis_name: str | None = None, warm: bool = False):
@@ -413,11 +510,11 @@ class NGPTrainer:
                 out_specs=(P(), P()),
                 check_vma=False,
             )
-            return jax.jit(smap, donate_argnums=(0,))
+            return jax.jit(smap, donate_argnums=donation_argnums(0))
 
         one_step = self._build_step(warm=warm)
 
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=donation_argnums(0))
         def step_fn(state, bank_rays, bank_rgbs, base_key):
             return scan_k_steps(
                 lambda st: one_step(st, bank_rays, bank_rgbs, base_key),
@@ -474,9 +571,10 @@ class NGPTrainer:
             k = min(k, self.warmup_steps - self._host_step)
         fn = self._step_fns.get((k, warm))
         if fn is None:
+            name = f"ngp_step_k{k}_{'warm' if warm else 'march'}"
+            pre = self.aot.take(name) if self.aot is not None else None
             fn = self._step_fns[(k, warm)] = self.tracker.wrap(
-                f"ngp_step_k{k}_{'warm' if warm else 'march'}",
-                self._jit_step(k, warm=warm),
+                name, pre if pre is not None else self._jit_step(k, warm=warm)
             )
         self._host_step += k
         if warm:
@@ -542,6 +640,37 @@ class NGPTrainer:
             ))
         return result
 
+    def _build_render(self, n_chunks: int, chunk: int):
+        """The jitted full-image eval executable for one padded shape at
+        the CURRENT eval cap (closed over jit-static) — shared by the
+        lazy path below and the AOT registration above."""
+        network, near, far = self.network, self.near, self.far
+        bbox, options = self.bbox, self.eval_march
+        packed, cap_eval = self.packed_march, self.packed_cap_avg_eval
+
+        @jax.jit
+        def render(params, rays_p, grid):
+            apply_fn = lambda pts, dirs, model: network.apply(  # noqa: E731
+                {"params": params}, pts, dirs, model=model
+            )
+
+            def body(chunk_rays):
+                if packed:
+                    from ..renderer.packed_march import march_rays_packed
+
+                    out = march_rays_packed(
+                        apply_fn, chunk_rays, near, far, grid, bbox,
+                        options, cap_avg=cap_eval,
+                    )
+                    return out
+                return march_rays_accelerated(
+                    apply_fn, chunk_rays, near, far, grid, bbox, options
+                )
+
+            return jax.lax.map(body, rays_p)
+
+        return render
+
     def render_image(self, state, batch: dict) -> dict:
         """Full-image eval through the accelerated march with the live grid
         (the chunked coarse+fine path is meaningless here: NGP training
@@ -560,38 +689,26 @@ class NGPTrainer:
             render = self._render_fns.get(key)
             if render is not None:
                 return render
-            network, near, far = self.network, self.near, self.far
-            bbox, options = self.bbox, self.eval_march
-            packed, cap_eval = self.packed_march, self.packed_cap_avg_eval
-
-            @jax.jit
-            def render(params, rays_p, grid):
-                apply_fn = lambda pts, dirs, model: network.apply(  # noqa: E731
-                    {"params": params}, pts, dirs, model=model
+            if self.aot is not None:
+                # pre-built (or deserialized) by aot_register_render
+                name = (
+                    f"ngp_render_{n_chunks}x{chunk}"
+                    f"_cap{self.packed_cap_avg_eval}"
                 )
-
-                def body(chunk_rays):
-                    if packed:
-                        from ..renderer.packed_march import march_rays_packed
-
-                        out = march_rays_packed(
-                            apply_fn, chunk_rays, near, far, grid, bbox,
-                            options, cap_avg=cap_eval,
-                        )
-                        return out
-                    return march_rays_accelerated(
-                        apply_fn, chunk_rays, near, far, grid, bbox, options
-                    )
-
-                return jax.lax.map(body, rays_p)
-
+                pre = self.aot.take(name)
+                if pre is not None:
+                    self._render_fns[key] = pre
+                    return pre
+            render = self._build_render(n_chunks, chunk)
             self._render_fns[key] = render
             return render
 
         # a dense-phase grid can overflow the packed stream cap (dropped
         # far samples → silently understated eval PSNR): escalate the cap
         # and re-render, bounded; the raised cap persists on the trainer
-        # so later evals start right. Each new cap is one extra compile.
+        # so later evals start right. Each escalation rebuilds the eval
+        # executable — telemetered as a `compile` row (cap_old/cap_new)
+        # so tlm_report --diff flags a run whose preset cap is too low.
         for attempt in range(4):
             out = _render_fn()(state.params, rays_p, grid)
             overflow = out.pop("overflow_frac", None)
@@ -606,7 +723,17 @@ class NGPTrainer:
             self._render_fns.pop(
                 (n_chunks, chunk, self.packed_cap_avg_eval), None
             )
+            cap_old = self.packed_cap_avg_eval
             self.packed_cap_avg_eval *= 2
+            self._eval_cap_escalations += 1
+            get_emitter().emit(
+                "compile",
+                name="ngp_render_eval_cap",
+                n_compiles=self._eval_cap_escalations,
+                wall_s=0.0,  # the rebuild lands on the re-render below
+                cap_old=cap_old,
+                cap_new=self.packed_cap_avg_eval,
+            )
             print(
                 f"ngp render_image: packed stream overflow "
                 f"{max_of:.1%} — escalating ngp_packed_cap_avg_eval to "
@@ -660,9 +787,15 @@ def fit_ngp(cfg, network=None, log=print):
     from ..datasets import make_dataset
     from ..evaluators import make_evaluator
     from ..parallel.collectives import barrier
+    from ..compile import registry_from_cfg
     from ..parallel.mesh import is_chief, multihost_init
     from ..utils.setup import configure_runtime
-    from .checkpoint import load_model, save_model, save_trained_config
+    from .checkpoint import (
+        load_model,
+        load_phase_state,
+        save_model,
+        save_trained_config,
+    )
     from .recorder import make_recorder
 
     multihost_init(cfg)
@@ -688,6 +821,7 @@ def fit_ngp(cfg, network=None, log=print):
         network = make_network(cfg)
 
     trainer = NGPTrainer(cfg, network, mesh=mesh)
+    trainer.aot = registry_from_cfg(cfg, tracker=trainer.tracker)
     evaluator = None if cfg.get("skip_eval", False) else make_evaluator(cfg)
     recorder = make_recorder(cfg)
     # telemetry opens AFTER the recorder (a fresh run wipes record_dir —
@@ -706,6 +840,13 @@ def fit_ngp(cfg, network=None, log=print):
         )
         if rec_state:
             recorder.load_state_dict(rec_state)
+        # warm-start: adopt the persisted warm/carve phase counters so the
+        # resumed run re-enters the carved phase directly (falls back to
+        # the occupancy estimate in multi_step when absent/mismatched)
+        trainer.restore_phase(
+            load_phase_state(cfg.trained_model_dir),
+            expect_step=int(state.step),
+        )
     if begin_epoch == 0 and cfg.get("pretrain", ""):
         from .checkpoint import load_pretrain
 
@@ -716,7 +857,6 @@ def fit_ngp(cfg, network=None, log=print):
         save_trained_config(cfg)
 
     train_ds = make_dataset(cfg, "train")
-    test_ds = make_dataset(cfg, "test")
     if mesh is not None:
         from ..parallel.sharding import shard_bank
 
@@ -725,8 +865,21 @@ def fit_ngp(cfg, network=None, log=print):
         bank_rays, bank_rgbs = train_ds.ray_bank()
         perm = np.random.default_rng(seed).permutation(bank_rays.shape[0])
         bank = shard_bank(bank_rays[perm], bank_rgbs[perm], mesh)
+        # the shard_map step returns a mesh-replicated state; placing the
+        # initial state the same way makes step 1 match the steady-state
+        # layout, so ONE executable (lazy or AOT) serves the whole run
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        state = jax.device_put(state, NamedSharding(mesh, PartitionSpec()))
     else:
         bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+    # AOT: both phase variants of the burst executable start compiling on
+    # host threads NOW, overlapping the test-dataset load below and the
+    # first warm bursts — the carve-phase program no longer compiles
+    # serially at the phase switch (the round-5 warmup tax)
+    trainer.aot_register_steps(state, bank, base_key)
+    test_ds = make_dataset(cfg, "test")
+    trainer.aot_register_render(state, int(test_ds.H) * int(test_ds.W))
 
     epochs = int(cfg.train.epoch)
     ep_iter = int(cfg.get("ep_iter", 500))
@@ -815,10 +968,12 @@ def fit_ngp(cfg, network=None, log=print):
                 barrier("pre_save")
                 if chief and (epoch + 1) % save_ep == 0:
                     save_model(cfg.trained_model_dir, state, epoch,
-                               recorder.state_dict(), latest=False)
+                               recorder.state_dict(), latest=False,
+                               phase_state=trainer.phase_state())
                 if chief and (epoch + 1) % save_latest_ep == 0:
                     save_model(cfg.trained_model_dir, state, epoch,
-                               recorder.state_dict(), latest=True)
+                               recorder.state_dict(), latest=True,
+                               phase_state=trainer.phase_state())
                 barrier("post_save")
             if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
                 result = trainer.val(state, test_ds, evaluator, log=log)

@@ -28,6 +28,7 @@ import numpy as np
 from flax.training.train_state import TrainState
 
 from ..datasets.sampling import sample_step_key
+from ..utils.platform import donation_argnums
 from ..obs import (
     CompileTracker,
     ProfileWindow,
@@ -100,6 +101,10 @@ class Trainer:
         # no-ops unless a run emitter / profile config is active
         self.tracker = CompileTracker()
         self.profile = ProfileWindow.from_cfg(cfg)
+        # AOT compile registry (compile/registry): fit() installs one so
+        # step executables build on host threads during setup instead of on
+        # first dispatch; None (unit tests, aot: false) keeps the lazy path
+        self.aot = None
 
     def epoch_iters(self, bank_size: int) -> int:
         """Steps per epoch. ep_iter=-1 (the reference's 'no resampling'
@@ -151,7 +156,7 @@ class Trainer:
         # donate the state: params + adam moments update in place instead of
         # allocating fresh buffers every step (the sharded builders already
         # donate; the single-chip flagship path must too)
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=donation_argnums(0))
         def step_fn(state, bank_rays, bank_rgbs, base_key, *pool):
             key = sample_step_key(base_key, state.step, process_index)
             k_sample, k_render = jax.random.split(key)
@@ -173,7 +178,7 @@ class Trainer:
         near, far, loss = self.near, self.far, self.loss
         grad_accum = self.grad_accum
 
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=donation_argnums(0))
         def multi_step_fn(state, bank_rays, bank_rgbs, base_key):
             def body(st):
                 key = sample_step_key(base_key, st.step, process_index)
@@ -200,8 +205,10 @@ class Trainer:
             return self.step(state, bank_rays, bank_rgbs, base_key)
         fn = self._multi_step_fns.get(k)
         if fn is None:
+            name = f"train_step_k{k}"
+            pre = self.aot.take(name) if self.aot is not None else None
             fn = self._multi_step_fns[k] = self.tracker.wrap(
-                f"train_step_k{k}", self._build_multi_step(k)
+                name, pre if pre is not None else self._build_multi_step(k)
             )
         return fn(state, bank_rays, bank_rgbs, base_key)
 
@@ -209,17 +216,67 @@ class Trainer:
         """One optimization step; dispatches to the precrop or full variant."""
         if index_pool is not None:
             if self._step_fn_pool is None:
+                pre = (self.aot.take("train_step_pool")
+                       if self.aot is not None else None)
                 self._step_fn_pool = self.tracker.wrap(
-                    "train_step_pool", self._build_step(with_pool=True)
+                    "train_step_pool",
+                    pre if pre is not None else self._build_step(with_pool=True),
                 )
             return self._step_fn_pool(
                 state, bank_rays, bank_rgbs, base_key, index_pool
             )
         if self._step_fn is None:
+            pre = self.aot.take("train_step") if self.aot is not None else None
             self._step_fn = self.tracker.wrap(
-                "train_step", self._build_step(with_pool=False)
+                "train_step",
+                pre if pre is not None else self._build_step(with_pool=False),
             )
         return self._step_fn(state, bank_rays, bank_rgbs, base_key)
+
+    # -- AOT registration ----------------------------------------------------
+    def aot_register_steps(self, state, bank, base_key, pool=None) -> None:
+        """Register every step executable this run will dispatch with the
+        AOT registry and kick their builds off on host threads
+        (``compile_all(wait=False)``) — the caller overlaps them with the
+        rest of setup (test-dataset load, pool placement), and the first
+        optimizer step picks up a finished executable via ``take`` instead
+        of paying its build inside the timed hot loop.
+
+        Shapes come from the exact objects the loop will pass (post
+        sharding/device_put), so the lowered signature — including layout
+        — always matches the dispatch."""
+        if self.aot is None:
+            return
+        from ..compile import abstract_like
+
+        sig = abstract_like((state, bank[0], bank[1], base_key))
+        if pool is not None and self.precrop_iters > 0:
+            self.aot.register(
+                "train_step_pool", self._build_step(with_pool=True),
+                sig + (abstract_like(pool),),
+            )
+        if self.scan_steps > 1:
+            self.aot.register(
+                f"train_step_k{self.scan_steps}",
+                self._build_multi_step(self.scan_steps), sig,
+            )
+            # the epoch-end clamped tail dispatches its own smaller burst
+            # (train_epoch) — precompile it too instead of paying the one
+            # "extra small executable" at the first epoch boundary
+            tail = self.epoch_iters(int(bank[0].shape[0])) % self.scan_steps
+            if tail == 1:
+                self.aot.register(
+                    "train_step", self._build_step(with_pool=False), sig
+                )
+            elif tail > 1:
+                self.aot.register(
+                    f"train_step_k{tail}", self._build_multi_step(tail), sig
+                )
+        else:
+            self.aot.register(
+                "train_step", self._build_step(with_pool=False), sig
+            )
+        self.aot.compile_all(wait=False)
 
     # -- epoch loops ---------------------------------------------------------
     # graftlint: hot
@@ -372,6 +429,7 @@ def _device_mem_mb() -> float | None:
 def fit(cfg, network=None, log=print):
     """Full training entry (parity: train.py:31-98): build everything from
     cfg, resume if available, run the epoch loop with save/eval cadence."""
+    from ..compile import registry_from_cfg
     from ..datasets import make_dataset
     from ..evaluators import make_evaluator
     from ..parallel.collectives import barrier
@@ -449,7 +507,6 @@ def fit(cfg, network=None, log=print):
         save_trained_config(cfg)
 
     train_ds = make_dataset(cfg, "train")
-    test_ds = make_dataset(cfg, "test")
     pool = None
     frac = float(cfg.task_arg.get("precrop_frac", 0.5))
     if mesh is not None:
@@ -476,10 +533,25 @@ def fit(cfg, network=None, log=print):
             from ..parallel.step import shard_train_state
 
             state = shard_train_state(state, mesh)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # the shard_map DP step returns a mesh-replicated state;
+            # placing the initial state the same way makes step 1 match
+            # the steady-state layout, so one executable serves the run
+            state = jax.device_put(state, NamedSharding(mesh, PartitionSpec()))
     else:
         bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
         if trainer.precrop_iters > 0:
             pool = jax.device_put(train_ds.precrop_index_pool(frac))
+
+    # AOT: register and start compiling every step executable now, on host
+    # threads, so the builds overlap the test-dataset load below and the
+    # first optimizer step dispatches a finished executable
+    # (docs/compilation.md)
+    trainer.aot = registry_from_cfg(cfg, tracker=trainer.tracker)
+    trainer.aot_register_steps(state, bank, base_key, pool=pool)
+    test_ds = make_dataset(cfg, "test")
 
     epochs = int(cfg.train.epoch)
     save_ep = int(cfg.get("save_ep", 40))

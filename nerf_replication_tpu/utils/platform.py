@@ -66,6 +66,27 @@ def force_platform(platform: str = "cpu", device_count: int | None = None) -> No
     jax.config.update("jax_platforms", platform)
 
 
+def donation_argnums(*argnums: int) -> tuple[int, ...]:
+    """``donate_argnums`` value honoring the active backend.
+
+    Donating the input state halves parameter+optimizer HBM on
+    accelerators, but XLA:CPU's input-output aliasing under the
+    ``--xla_force_host_platform_device_count`` emulation (the test
+    topology) is unsound: a donated buffer can be freed while the aliased
+    output still references it, leaving stable pointer-pattern garbage in
+    the output leaves — most reliably when the donated state was just
+    restored from a checkpoint (numpy-backed leaves), and intermittently
+    as the corrupted step counters tests/test_ngp.py triaged with retries.
+    Host RAM is not the scarce resource donation exists for, so on the
+    cpu backend every step executable keeps plain copy semantics.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return ()
+    return tuple(argnums)
+
+
 def enable_compilation_cache(path: str | None = None) -> None:
     """Persistent XLA executable cache shared across processes.
 

@@ -1,0 +1,253 @@
+"""Cold-start benchmark: process start → first useful unit of work.
+
+The round-5 numbers put 177 s of a 420 s NGP bench window in compile +
+warm-up, and every process restart (battery stages, sweep points, serve
+redeploys) re-paid it. This bench measures exactly that tax, end to end,
+under a COLD vs WARM compile cache:
+
+* ``train`` — fresh interpreter: config → network → datasets → AOT
+  registry → **first optimizer step retired**. Wall clock starts before
+  the first heavy import (the real "process start").
+* ``serve`` — fresh interpreter: config → network → engine warm-up
+  (every (bucket, family) executable built or deserialized) → **first
+  render response**. The warm run must deserialize the whole executable
+  inventory from the artifact store: ``total_compiles`` is asserted into
+  the row from the engine's CompileTracker and is 0 on a true warm start.
+
+Each child process prints one JSON result line; the parent wipes (cold)
+or keeps (warm) the isolated cache dir between runs and appends one row
+per (target, mode) to ``BENCH_COLDSTART.jsonl`` (family ``coldstart``;
+``scripts/check_telemetry_schema.py`` validates it).
+
+    python scripts/bench_cold_start.py                    # both targets
+    python scripts/bench_cold_start.py --targets serve
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+NEAR, FAR = 2.0, 6.0
+RESULT_MARK = "COLDSTART_RESULT "
+
+
+def _train_cfg(args):
+    """Flagship-shaped config, sized so compile dominates a cold start the
+    way it does at bench scale (a toy MLP would hide the tax in imports)."""
+    from nerf_replication_tpu.config import make_cfg
+
+    return make_cfg(
+        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        [
+            "scene", "procedural",
+            "exp_name", "bench_cold_start",
+            "train_dataset.data_root", args.scene_root,
+            "test_dataset.data_root", args.scene_root,
+            "train_dataset.H", str(args.H), "train_dataset.W", str(args.H),
+            "test_dataset.H", str(args.H), "test_dataset.W", str(args.H),
+            "task_arg.N_rays", str(args.n_rays),
+            "task_arg.precrop_iters", "0",
+            # wide network (the compile cost being measured) with a small
+            # sample budget: on CPU a full lego step EXECUTES for ~50 s,
+            # which would drown the compile tax the bench isolates
+            "network.nerf.W", "448",
+            "network.nerf.D", "10",
+            "task_arg.N_samples", "24",
+            "task_arg.N_importance", "24",
+            "compile.dir", os.path.join(args.cache_dir, "aot"),
+        ],
+    )
+
+
+def _serve_cfg(args):
+    from nerf_replication_tpu.config import make_cfg
+
+    return make_cfg(
+        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        [
+            "scene", "procedural",
+            "exp_name", "bench_cold_start",
+            "train_dataset.data_root", args.scene_root,
+            "test_dataset.data_root", args.scene_root,
+            "task_arg.N_samples", "48",
+            "task_arg.N_importance", "48",
+            "task_arg.chunk_size", "1024",
+            "serve.buckets", "[1024, 4096]",
+            "serve.max_batch_rays", "4096",
+            "compile.dir", os.path.join(args.cache_dir, "aot"),
+        ],
+    )
+
+
+def _child_setup(args):
+    """Backend + caches, called before anything can compile. Returns the
+    perf-counter origin (taken before the heavy imports)."""
+    from nerf_replication_tpu.utils.platform import (
+        enable_compilation_cache,
+        setup_backend,
+    )
+
+    setup_backend(args.force_platform)
+    enable_compilation_cache(os.path.join(args.cache_dir, "xla"))
+    import jax
+
+    # persist EVERY executable: the default 0.5 s floor would silently
+    # drop bench-scale programs from the cache and fake a cold warm-run
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def child_train(args) -> dict:
+    t0 = time.perf_counter()
+    _child_setup(args)
+    import jax
+
+    from nerf_replication_tpu.compile import registry_from_cfg
+    from nerf_replication_tpu.datasets import make_dataset
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.train import make_loss, make_train_state
+    from nerf_replication_tpu.train.trainer import Trainer
+
+    cfg = _train_cfg(args)
+    network = make_network(cfg)
+    loss = make_loss(cfg, network)
+    trainer = Trainer(cfg, network, loss, None)
+    trainer.aot = registry_from_cfg(cfg, tracker=trainer.tracker)
+    state, _ = make_train_state(cfg, network, jax.random.PRNGKey(0))
+    train_ds = make_dataset(cfg, "train")
+    bank = tuple(jax.device_put(a) for a in train_ds.ray_bank())
+    base_key = jax.random.PRNGKey(1)
+    trainer.aot_register_steps(state, bank, base_key)
+    state, stats = trainer.step(state, bank[0], bank[1], base_key)
+    jax.block_until_ready(stats)
+    return {
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "total_compiles": trainer.tracker.total_compiles(),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def child_serve(args) -> dict:
+    t0 = time.perf_counter()
+    _child_setup(args)
+    import numpy as np
+
+    import jax
+
+    from nerf_replication_tpu.compile import registry_from_cfg
+    from nerf_replication_tpu.models import init_params_for, make_network
+    from nerf_replication_tpu.obs import CompileTracker
+    from nerf_replication_tpu.serve import RenderEngine
+
+    cfg = _serve_cfg(args)
+    network = make_network(cfg)
+    # fresh-init weights: the cold-start question is about executables,
+    # not checkpoints (engine_from_cfg additionally overlaps model I/O)
+    params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
+    tracker = CompileTracker()
+    aot = registry_from_cfg(cfg, tracker=tracker)
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          tracker=tracker, aot=aot)
+    rays = np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (300, 1)),
+         np.tile([0.0, 0.0, -1.0], (300, 1))], -1
+    ).astype(np.float32)
+    out = engine.render_request(rays, NEAR, FAR, emit=False)
+    assert "rgb_map_f" in out or "rgb_map_c" in out
+    stats = engine.stats()
+    return {
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "total_compiles": stats["total_compiles"],
+        "warm_source": stats["warm_source"],
+        "warmup_wall_s": stats["warmup_wall_s"],
+        "n_executables": len(stats["buckets"]) * 3,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def _spawn(target: str, mode: str, args) -> dict:
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child", target,
+        "--cache-dir", args.cache_dir, "--scene-root", args.scene_root,
+        "--H", str(args.H), "--n_rays", str(args.n_rays),
+        "--force_platform", args.force_platform,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, cwd=_REPO)
+    result = None
+    for line in proc.stdout.splitlines():
+        if line.startswith(RESULT_MARK):
+            result = json.loads(line[len(RESULT_MARK):])
+    if proc.returncode != 0 or result is None:
+        tail = "\n".join((proc.stderr or proc.stdout).splitlines()[-15:])
+        return {"coldstart": target, "mode": mode,
+                "error": f"child failed rc={proc.returncode}: {tail}"}
+    return {"coldstart": target, "mode": mode, **result}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="cold vs warm start benchmark")
+    p.add_argument("--targets", nargs="+", default=["train", "serve"],
+                   choices=("train", "serve"))
+    p.add_argument("--H", type=int, default=64)
+    p.add_argument("--n_rays", type=int, default=64)
+    p.add_argument("--cache-dir", dest="cache_dir",
+                   default=os.path.join(_REPO, "data", "bench_cold_start",
+                                        "cache"))
+    p.add_argument("--scene-root", dest="scene_root",
+                   default=os.path.join(_REPO, "data", "bench_cold_start",
+                                        "scene"))
+    p.add_argument("--out", default=os.path.join(_REPO,
+                                                 "BENCH_COLDSTART.jsonl"))
+    p.add_argument("--force_platform",
+                   default=os.environ.get("BENCH_FORCE_PLATFORM", "cpu"))
+    p.add_argument("--child", default="", choices=("", "train", "serve"),
+                   help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.child:
+        result = (child_train if args.child == "train" else child_serve)(args)
+        print(RESULT_MARK + json.dumps(result), flush=True)
+        return 0
+
+    from nerf_replication_tpu.datasets.procedural import ensure_scene
+    from nerf_replication_tpu.obs import append_jsonl, validate_bench_row
+
+    # scene generated ONCE, outside every timed window — both modes load
+    # the same files, so dataset I/O cancels out of the cold/warm delta
+    ensure_scene(args.scene_root, scene="procedural", H=args.H, W=args.H,
+                 n_train=8, n_test=1)
+
+    rc = 0
+    for target in args.targets:
+        walls = {}
+        for mode in ("cold", "warm"):
+            if mode == "cold" and os.path.isdir(args.cache_dir):
+                shutil.rmtree(args.cache_dir)
+            row = _spawn(target, mode, args)
+            errors = validate_bench_row(row)
+            if errors:
+                raise SystemExit(f"bench row failed schema check: {errors}")
+            append_jsonl(args.out, row)
+            print(json.dumps(row), flush=True)
+            if "error" in row:
+                rc = 1
+            else:
+                walls[mode] = row["wall_s"]
+        if "cold" in walls and "warm" in walls and walls["warm"] > 0:
+            print(f"{target}: cold {walls['cold']:.1f}s -> warm "
+                  f"{walls['warm']:.1f}s "
+                  f"({walls['cold'] / walls['warm']:.2f}x)", flush=True)
+    print(f"rows appended to {args.out}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,6 +36,11 @@ def main(argv=None):
     p.add_argument("--views", type=int, default=60)
     p.add_argument("--test_views", type=int, default=2)
     p.add_argument("--n_rays", type=int, default=4096)
+    p.add_argument("--eval_cap", type=int, default=1024,
+                   help="preset packed-eval stream cap (samples/ray avg) for "
+                        "the ngp arms — set from telemetry history so eval "
+                        "never escalate-recompiles mid-bench (stage-3c trail "
+                        "settled at 1024)")
     p.add_argument("--scene_root", default="data/bench_ngp_scene")
     p.add_argument("--arms", nargs="+", default=["std", "ngp"])
     p.add_argument("--config", default="lego_hash.yaml",
@@ -93,6 +98,7 @@ def main(argv=None):
             cfg = build_cfg((
                 "task_arg.ngp_training", "true",
                 "task_arg.ngp_grid_res", "128",
+                "task_arg.ngp_packed_cap_avg_eval", str(args.eval_cap),
             ))
         elif arm == "ngp_packed":
             # globally-packed sample stream (renderer/packed_march.py):
@@ -102,6 +108,7 @@ def main(argv=None):
                 "task_arg.ngp_training", "true",
                 "task_arg.ngp_grid_res", "128",
                 "task_arg.ngp_packed_march", "true",
+                "task_arg.ngp_packed_cap_avg_eval", str(args.eval_cap),
             ))
         else:
             cfg = build_cfg(())

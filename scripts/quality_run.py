@@ -45,6 +45,12 @@ def main(argv=None):
     p.add_argument("--target_psnr", type=float, default=21.55,
                    help="reference log.txt final PSNR (475 epochs)")
     p.add_argument("--n_rays", type=int, default=4096)
+    p.add_argument("--eval_cap", type=int, default=1024,
+                   help="preset packed-eval stream cap (samples/ray avg) for "
+                        "NGP configs — set from telemetry history so eval "
+                        "renders never escalate-recompile mid-run (the "
+                        "stage-3c trail settled at 1024; escalations now "
+                        "emit a telemetry compile row either way)")
     p.add_argument("--eval_every_s", type=float, default=120.0)
     p.add_argument("--force_platform", default=os.environ.get(
         "BENCH_FORCE_PLATFORM", ""))
@@ -112,6 +118,10 @@ def main(argv=None):
             "test_dataset.H", str(args.H), "test_dataset.W", str(args.H),
             "test_dataset.cams", "[0, -1, 1]",
             "task_arg.N_rays", str(args.n_rays),
+            # preset the packed-eval cap (NGP trainer reads it; inert
+            # elsewhere) instead of paying the escalate-recompile loop's
+            # executable rebuilds inside the eval cadence
+            "task_arg.ngp_packed_cap_avg_eval", str(args.eval_cap),
             "precision.compute_dtype", "bfloat16",
             *args.opts,
         ],

@@ -130,6 +130,30 @@ def summarize(rows: list[dict]) -> dict:
         if psnr is not None:
             summary["final_psnr"] = float(psnr)
             break
+    # warmup breakdown: where the run's compile seconds landed.
+    # call_index 0 = ahead-of-time builds (compile/registry's note_compile
+    # — paid off the hot path, overlapped with setup), call_index 1 =
+    # inline first-call builds (the classic warmup tax), >1 = retraces
+    # (steady-state shape drift). Eval-cap escalation markers
+    # (cap_old/cap_new, train/ngp.py) are accounted separately — they
+    # stand for a forced executable rebuild, not a build themselves.
+    if compiles:
+        caps = [r for r in compiles if r.get("cap_new") is not None]
+        builds = [r for r in compiles if r.get("cap_new") is None]
+        aot = [r for r in builds if int(r.get("call_index") or 0) == 0]
+        inline = [r for r in builds if int(r.get("call_index") or 0) == 1]
+        retrace = [r for r in builds if int(r.get("call_index") or 0) > 1]
+        wall = lambda rs: sum(float(r.get("wall_s", 0.0)) for r in rs)  # noqa: E731
+        summary["warmup_aot_builds"] = len(aot)
+        summary["warmup_aot_wall_s"] = wall(aot)
+        summary["warmup_inline_builds"] = len(inline)
+        summary["warmup_inline_wall_s"] = wall(inline)
+        summary["retrace_builds"] = len(retrace)
+        summary["retrace_wall_s"] = wall(retrace)
+        summary["eval_cap_escalations"] = len(caps)
+        if caps:
+            summary["eval_cap_final"] = caps[-1].get("cap_new")
+
     # dispatch/block split (medians): is the loop latency- or
     # compute-bound?
     dispatch = [r["dispatch_s"] for r in steps if r.get("dispatch_s") is not None]
@@ -210,6 +234,16 @@ def print_summary(summary: dict, label: str = "") -> None:
           f"{_fmt_s(summary['block_p50_s'])}")
     print(f"  compiles:      {summary['compile_count']} "
           f"({summary['compile_wall_s']:.2f}s wall)")
+    if summary.get("warmup_aot_builds") is not None:
+        print(f"    warmup:      aot {summary['warmup_aot_builds']} "
+              f"({summary['warmup_aot_wall_s']:.2f}s)  "
+              f"inline {summary['warmup_inline_builds']} "
+              f"({summary['warmup_inline_wall_s']:.2f}s)  "
+              f"retrace {summary['retrace_builds']} "
+              f"({summary['retrace_wall_s']:.2f}s)")
+        if summary.get("eval_cap_escalations"):
+            print(f"    eval cap:    {summary['eval_cap_escalations']} "
+                  f"escalation(s) -> {summary.get('eval_cap_final')}")
     print(f"  peak memory:   device {_fmt_bytes(summary['peak_device_bytes'])}"
           f"  host rss {_fmt_bytes(summary['peak_host_rss_bytes'])}")
     psnr = summary["final_psnr"]

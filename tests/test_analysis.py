@@ -3,7 +3,7 @@
 Three layers (docs/static_analysis.md):
 
 * per-rule fixtures — one positive and one negative snippet per rule
-  R1-R6, plus suppression and baseline-diff behavior on the same snippets;
+  R1-R7, plus suppression and baseline-diff behavior on the same snippets;
 * the repo gate — the committed tree lints CLEAN against the committed
   ``graftlint_baseline.json`` through the real CLI entry (this is tier-1's
   lint gate: a new hazard anywhere in the package fails this test), and a
@@ -319,6 +319,57 @@ def test_config_key_negative_known_dynamic_and_subconfig():
         "    return cfg.get('num_levels', 16)\n"
     )
     assert "config-key" not in _rules_of(lint(src, config_keys=_KNOWN))
+
+
+# --------------------------------------------------------------------------
+# R7 aot
+# --------------------------------------------------------------------------
+
+_LIB_PATH = "nerf_replication_tpu/render/foo.py"
+
+
+def test_aot_unrouted_library_jit_flagged():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def render(params, rays):\n"
+        "    return rays * 2\n"
+        "step = jax.jit(lambda s, b: s)\n"
+    )
+    found = lint_source(src, path=_LIB_PATH)
+    assert sum(1 for f in found if f.rule == "aot") == 2
+
+
+def test_aot_negative_registered_builder_and_direct_arg():
+    """Both routing shapes: the jit handed straight to register(), and a
+    builder whose NAME flows into a register() call (the trainer idiom —
+    `aot.register("k", self._build_step(), sig)`)."""
+    src = (
+        "import jax\n"
+        "class T:\n"
+        "    def _build_step(self):\n"
+        "        return jax.jit(lambda s, b: s)\n"
+        "    def warm(self, sig):\n"
+        "        self.aot.register('k', self._build_step(), sig)\n"
+        "        self.aot.register('r', jax.jit(lambda r: r), sig)\n"
+    )
+    assert "aot" not in _rules_of(lint_source(src, path=_LIB_PATH))
+
+
+def test_aot_exempt_outside_library_code():
+    src = "import jax\nf = jax.jit(lambda x: x + 1)\n"
+    for path in ("scripts/bench_foo.py", "tests/test_foo.py", "serve.py",
+                 "nerf_replication_tpu/compile/registry.py"):
+        assert "aot" not in _rules_of(lint_source(src, path=path)), path
+
+
+def test_aot_inline_suppressible():
+    src = (
+        "import jax\n"
+        "# graftlint: ok(aot: one-shot debug helper)\n"
+        "f = jax.jit(lambda x: x + 1)\n"
+    )
+    assert "aot" not in _rules_of(lint_source(src, path=_LIB_PATH))
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,134 @@
+"""AOT compile registry (nerf_replication_tpu/compile/) — the warmup-tax
+subsystem.
+
+Three invariants the cold-start work rests on:
+
+* **zero retrace on dispatch** — executables built (or deserialized) by
+  the registry never count as compiles when dispatched through a
+  CompileTracker wrap; the ONLY compile accounting is the registry's own
+  ``note_compile`` per actual build;
+* **artifact round-trip** — a ``serialize=True`` entry persists to the
+  repo-anchored cache and a second registry (same config hash, same
+  shapes) resolves it from disk with zero builds, reporting
+  ``warm_source() == "disk"``;
+* **graceful degradation** — unknown names, a disabled registry, and
+  failed builds all return None from ``take`` so callers keep their lazy
+  jit path.
+
+scripts/bench_cold_start.py asserts the same invariants end-to-end across
+process boundaries; this file pins them at unit scale inside tier-1.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from nerf_replication_tpu.compile import (
+    AOTRegistry,
+    abstract_like,
+    artifact_key,
+)
+from nerf_replication_tpu.obs import CompileTracker
+
+
+def _registry(tmp_path, tracker, **kw):
+    return AOTRegistry(cache_dir=str(tmp_path / "aot"), tracker=tracker,
+                       config_hash="test", **kw)
+
+
+def test_aot_registry_builds_then_zero_retrace_dispatch(tmp_path):
+    tracker = CompileTracker()
+    reg = _registry(tmp_path, tracker)
+    x = jnp.ones((8, 8), jnp.float32)
+    f = jax.jit(lambda a: a * 2.0 + 1.0)
+    g = jax.jit(lambda a, b: (a @ b).sum())
+    reg.register("f", f, (abstract_like(x),))
+    reg.register("g", g, (abstract_like(x), abstract_like(x)))
+    reg.compile_all(wait=True)
+
+    # exactly one note_compile per AOT build, nothing else
+    assert tracker.total_compiles() == 2
+    summary = reg.summary()
+    assert summary["entries"] == 2 and summary["sources"] == {"compiled": 2}
+
+    pf = tracker.wrap("f", reg.take("f"))
+    pg = tracker.wrap("g", reg.take("g"))
+    for _ in range(3):
+        jax.block_until_ready(pf(x))
+        jax.block_until_ready(pg(x, x))
+    # dispatching a precompiled executable is never a build
+    assert tracker.total_compiles() == 2
+    np.testing.assert_allclose(np.asarray(pf(x)), np.asarray(x) * 2.0 + 1.0)
+    np.testing.assert_allclose(
+        float(pg(x, x)), float((np.asarray(x) @ np.asarray(x)).sum())
+    )
+
+
+def test_aot_abstract_like_passthrough_and_shapes():
+    x = jnp.ones((4, 3), jnp.float32)
+    sds = jax.ShapeDtypeStruct((2,), jnp.int32)
+    tree = abstract_like({"x": x, "s": sds, "k": jnp.zeros((), jnp.uint32)})
+    assert tree["s"] is sds  # already-abstract leaves pass through
+    assert tree["x"].shape == (4, 3) and tree["x"].dtype == jnp.float32
+
+
+def test_aot_artifact_roundtrip_second_registry_warm_from_disk(tmp_path):
+    x = jnp.ones((16, 6), jnp.float32)
+    sig = (abstract_like(x),)
+
+    t1 = CompileTracker()
+    r1 = _registry(tmp_path, t1)
+    r1.register("render", jax.jit(lambda a: jnp.tanh(a).sum(-1)), sig,
+                serialize=True)
+    r1.compile_all(wait=True)
+    assert t1.total_compiles() == 1
+    assert r1.warm_source() == "compiled"
+    ref = np.asarray(r1.take("render")(x))
+
+    # fresh registry, same cache dir + config hash: a process restart
+    t2 = CompileTracker()
+    r2 = _registry(tmp_path, t2)
+    r2.register("render", jax.jit(lambda a: jnp.tanh(a).sum(-1)), sig,
+                serialize=True)
+    r2.compile_all(wait=True)
+    assert t2.total_compiles() == 0  # zero builds: deserialized
+    assert r2.warm_source() == "disk"
+    assert r2.summary()["sources"] == {"disk": 1}
+    np.testing.assert_allclose(np.asarray(r2.take("render")(x)), ref)
+
+
+def test_aot_artifact_key_separates_config_and_shapes():
+    sig_a = (jax.ShapeDtypeStruct((8,), jnp.float32),)
+    sig_b = (jax.ShapeDtypeStruct((16,), jnp.float32),)
+    assert artifact_key("f", sig_a, extra="h1") == artifact_key(
+        "f", sig_a, extra="h1"
+    )
+    # shape, name, and config hash each invalidate the artifact
+    assert artifact_key("f", sig_a, "h1") != artifact_key("f", sig_b, "h1")
+    assert artifact_key("f", sig_a, "h1") != artifact_key("g", sig_a, "h1")
+    assert artifact_key("f", sig_a, "h1") != artifact_key("f", sig_a, "h2")
+
+
+def test_aot_take_degrades_to_lazy_path(tmp_path):
+    tracker = CompileTracker()
+    reg = _registry(tmp_path, tracker)
+    assert reg.take("never_registered") is None
+
+    # a build failure is captured, not raised: take -> None, callers jit
+    bad_sig = (abstract_like(jnp.ones((7,), jnp.float32)),)
+    reg.register("bad", jax.jit(lambda a: a.reshape(3, 3)), bad_sig)
+    reg.compile_all(wait=True)
+    assert reg.take("bad") is None
+    assert reg.summary()["errors"] == ["bad"]
+    assert tracker.total_compiles() == 0
+
+    off = AOTRegistry(cache_dir=str(tmp_path / "aot"), enabled=False)
+    off.register("f", jax.jit(lambda a: a), bad_sig)
+    off.compile_all(wait=True)
+    assert off.take("f") is None

@@ -174,13 +174,16 @@ def test_ngp_multi_step_burst_matches_single_steps(setup):
     (same key threading via state.step inside the scan).
 
     Retry discipline (PR 3 triage, docs/operations.md): on this host
-    (XLA:CPU, jax 0.4.37) this test's donated step executables
-    intermittently corrupt the step scalar — garbage ints (1073528057) or
-    a lost increment, ~1/5 runs, REPRODUCED WITH A VIRGIN compilation
-    cache, so it is runtime corruption, not (only) cache tearing. The
-    retry triggers ONLY on that corruption signature (insane step
-    counters); the burst-vs-single numerics assertions — the point of the
-    test — are never retried around."""
+    (XLA:CPU, jax 0.4.37) donated step executables intermittently
+    corrupted the step scalar — garbage ints (1073528057) or a lost
+    increment, ~1/5 runs, REPRODUCED WITH A VIRGIN compilation cache.
+    PR 5 root-caused it: XLA:CPU's input-output aliasing under the
+    forced-device-count test topology frees donated buffers while aliased
+    outputs still reference them, so donation is now gated off on the cpu
+    backend entirely (utils/platform.py donation_argnums). The retry
+    stays as a cheap backstop on the same corruption signature (insane
+    step counters); the burst-vs-single numerics assertions — the point
+    of the test — are never retried around."""
     root, cfg, net = setup
     ds = Dataset(data_root=root, scene="procedural", split="train", H=32, W=32)
     bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
@@ -341,3 +344,86 @@ def test_fit_trains_ngp_config_end_to_end(setup, tmp_path):
     # resume restores the grid alongside params
     state2 = fit(cfg, log=lambda *a, **k: None)
     assert int(state2.step) == 60  # epochs exhausted; nothing retrains
+
+
+def test_ngp_warm_start_resume_bitwise_parity(setup, tmp_path):
+    """Kill/resume must land bitwise on the uninterrupted trajectory: the
+    grid EMA + optimizer state ride the checkpoint bundle and the phase
+    sidecar re-enters the carved phase directly — a resumed run must not
+    replay grid warm-up (the round-5 warmup tax) or fork numerically."""
+    from nerf_replication_tpu.train.checkpoint import (
+        load_model,
+        load_phase_state,
+        save_model,
+    )
+
+    root, _, _ = setup
+    # warmup_max == warmup_steps == 2: burst 1 (k=2) is the whole warm
+    # phase, burst 2 runs carved — the phase switch sits inside the run
+    cfg = tiny_cfg(root, NGP_EXTRA + (
+        "task_arg.ngp_warmup_steps", "2",
+        "task_arg.ngp_warmup_max", "2",
+    ))
+    net = make_network(cfg)
+    ds = Dataset(data_root=root, scene="procedural", split="train",
+                 H=32, W=32)
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    key = jax.random.PRNGKey(1)
+
+    tr_a = make_ngp_trainer(cfg, net)
+    sa, _ = tr_a.make_state(jax.random.PRNGKey(0))
+    sa, _ = tr_a.multi_step(sa, bank[0], bank[1], key, k_steps=2)
+    assert tr_a.last_burst_warm
+    sa, _ = tr_a.multi_step(sa, bank[0], bank[1], key, k_steps=2)
+    assert not tr_a.last_burst_warm  # carved phase reached
+    # full-state sync before checkpointing and the bitwise reads below:
+    # bursts dispatch async (and donate their input state on accelerator
+    # backends — utils/platform.py donation_argnums)
+    jax.block_until_ready(sa)
+
+    model_dir = str(tmp_path / "ckpt")
+    save_model(model_dir, sa, 0, None, latest=True,
+               phase_state=tr_a.phase_state())
+
+    tr_b = make_ngp_trainer(cfg, net)
+    template, _ = tr_b.make_state(jax.random.PRNGKey(3))
+    sb, begin_epoch, _ = load_model(model_dir, template)
+    assert begin_epoch == 1
+    phase = load_phase_state(model_dir)
+    assert phase is not None
+    assert tr_b.restore_phase(phase, expect_step=int(sb.step))
+    assert tr_b.phase_state() == phase  # counters round-trip the sidecar
+
+    # the full bundle (params, optimizer moments, grid EMA) round-trips
+    # bitwise through the checkpoint — compared BEFORE the continuation
+    # bursts below consume (on accelerators: donate) both input states
+    for a, b in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    # uninterrupted continuation vs resumed continuation; sync each burst
+    # (state AND stats) before reading — np.asarray on CPU is a zero-copy
+    # view of the device buffer
+    sa2, stats_a = tr_a.multi_step(sa, bank[0], bank[1], key, k_steps=2)
+    jax.block_until_ready((sa2, stats_a))
+    sb2, stats_b = tr_b.multi_step(sb, bank[0], bank[1], key, k_steps=2)
+    jax.block_until_ready((sb2, stats_b))
+    # resume re-enters the carved phase directly: no warm-up replay
+    assert not tr_b.last_burst_warm
+    for a, b in zip(jax.tree.leaves(sa2), jax.tree.leaves(sb2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert float(stats_a["loss"]) == float(stats_b["loss"])
+
+
+def test_ngp_resume_rejects_torn_phase_sidecar(setup, tmp_path):
+    """A phase sidecar whose host_step disagrees with the restored bundle
+    (torn save pair) must be rejected — the occupancy heuristic takes
+    over instead of pinning the trainer to a stale phase."""
+    root, cfg, net = setup
+    trainer = make_ngp_trainer(cfg, net)
+    assert not trainer.restore_phase(None)
+    assert not trainer.restore_phase({}, expect_step=0)
+    good = {"host_step": 4, "last_occ": 0.5, "warm_steps_total": 2,
+            "bursts": 2, "trunc_warned": False}
+    assert not trainer.restore_phase(good, expect_step=8)  # mismatch
+    assert trainer.restore_phase(good, expect_step=4)
+    assert trainer._host_step == 4 and trainer._warm_steps_total == 2

@@ -148,8 +148,8 @@ def default_cfg() -> ConfigNode:
     # request stream never retraces; the micro-batcher coalesces pending
     # requests until max_batch_rays or max_delay_ms, whichever first; under
     # backlog, shed_queue_depths are the queue depths (requests still
-    # waiting) that activate degradation tiers 1..3
-    # (reduced_k / coarse / half_res)
+    # waiting) that activate degradation tiers 1..4
+    # (bf16 / reduced_k / coarse / half_res)
     cfg.serve = ConfigNode(
         {
             "buckets": [4096, 16384],
@@ -159,7 +159,7 @@ def default_cfg() -> ConfigNode:
             "cache_entries": 64,     # pose->image LRU slots (0 disables)
             "pose_decimals": 3,      # camera-pose quantization for cache keys
             "warmup": True,          # pre-compile every (bucket, tier) pair
-            "shed_queue_depths": [4, 8, 16],
+            "shed_queue_depths": [4, 8, 16, 32],
         }
     )
 

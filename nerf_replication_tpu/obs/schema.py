@@ -106,6 +106,16 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"tier": (str,), "queue_depth": _NUM},
         {"n_requests": _NUM, "n_rays": _NUM},
     ),
+    # -- traversal (renderer/packed_march.py hierarchical coarse-DDA) --------
+    # one per eval image (or bench arm): rows entering the global sort vs
+    # occupied rows surviving the fine test — the sweep-efficiency ratio
+    # tlm_report summarizes and --diff gates against regression
+    "march": (
+        {"candidates_in": _NUM, "samples_out": _NUM},
+        {"mode": (str,), "surface": (str,), "coarse_occ": _NUM,
+         "fine_occ": _NUM, "overflow_frac": _NUM, "truncated": _NUM,
+         "n_rays": _NUM, "step": _NUM},
+    ),
     # -- static analysis (nerf_replication_tpu/analysis) ---------------------
     # one per scripts/graftlint.py run: finding counts split new-vs-baseline
     # so the report can watch the baseline shrink (and flag a lint gate
@@ -187,6 +197,12 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # a cold vs warm compile cache. NOTE: these rows must not carry any
     # earlier discriminator key above (bench_family is first-match).
     "coldstart": ("mode", "wall_s"),
+    # scripts/bench_traversal.py rows (BENCH_TRAVERSAL.jsonl): one row per
+    # (traversal arm × occupancy regime) — flat vs hierarchical candidate
+    # stream size and throughput. NOTE: must not carry any earlier
+    # discriminator key (bench_family is first-match), hence the
+    # traversal-specific field names.
+    "traversal_mode": ("grid_occ", "candidates_per_ray", "rays_per_s"),
 }
 
 

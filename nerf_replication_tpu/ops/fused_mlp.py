@@ -367,6 +367,70 @@ def _bwd_kernel(spec, x_ref, v_ref, draw_ref, *rest):
             ref[...] = ref[...] + g
 
 
+def _fwd_kernel_masked(spec, x_ref, v_ref, valid_ref, *rest):
+    """Forward with the packed march's per-sample occupancy bit streamed
+    into the kernel (the fine-level bit-test fused with the matmul chain
+    — pure elementwise + matmul, the op mix Mosaic accepts, unlike the
+    recorded in-kernel gather negative in models/encoding/pallas_hash.py;
+    the raw grid/hash GATHER itself stays outside the kernel).
+
+    The packed stream is sorted valid-first, so whole tail tiles are
+    all-invalid: ``pl.when`` skips their matmul chain entirely, making
+    the stream's padding cost ~no MXU work."""
+    ws = rest[:-1]
+    out_ref = rest[-1]
+    valid = valid_ref[...]  # [tile, 1] f32 0/1
+    any_valid = jnp.sum(valid) > 0.0
+
+    @pl.when(any_valid)
+    def _run():
+        raw8, _ = _forward_tile(
+            spec, x_ref[...], v_ref[...], [w[...] for w in ws]
+        )
+        out_ref[...] = raw8 * valid
+
+    @pl.when(jnp.logical_not(any_valid))
+    def _skip():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+
+def _bwd_kernel_masked(spec, x_ref, v_ref, valid_ref, draw_ref, *rest):
+    n_p = spec.n_params()
+    ws = rest[:n_p]
+    dx_ref, dv_ref = rest[n_p], rest[n_p + 1]
+    gr = rest[n_p + 2 :]
+    valid = valid_ref[...]
+    any_valid = jnp.sum(valid) > 0.0
+    first = pl.program_id(0) == 0
+
+    # zero-init unconditionally on the first grid step: with tile-skip,
+    # "first tile" and "first tile that accumulates" need not coincide,
+    # and a skipped first tile must not leave the accumulators unwritten
+    for ref in gr:
+        @pl.when(first)
+        def _init(ref=ref):
+            ref[...] = jnp.zeros_like(ref)
+
+    @pl.when(any_valid)
+    def _run():
+        # masking the cotangent masks everything downstream: every dx/dv
+        # row and every weight-grad contribution chains linearly from its
+        # row's draw, so invalid rows contribute exactly zero
+        dx, dv, grads = _backward_tile(
+            spec, x_ref[...], v_ref[...], draw_ref[...] * valid,
+            [w[...] for w in ws],
+        )
+        dx_ref[...] = dx
+        dv_ref[...] = dv
+        for ref, g in zip(gr, grads):
+            ref[...] = ref[...] + g
+
+    @pl.when(jnp.logical_not(any_valid))
+    def _skip():
+        dx_ref[...] = jnp.zeros_like(dx_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(0, 1))
 def _fused_raw(spec, tile, flat_ws, x, v):
     out, _ = _fused_fwd(spec, tile, flat_ws, x, v)
@@ -444,6 +508,106 @@ def _fused_bwd(spec, tile, res, draw):
 _fused_raw.defvjp(_fused_fwd, _fused_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fused_raw_masked(spec, tile, flat_ws, x, v, valid):
+    out, _ = _fused_fwd_masked(spec, tile, flat_ws, x, v, valid)
+    return out
+
+
+def _pallas_fwd_masked(spec, tile, flat_ws, x, v, valid):
+    m = x.shape[0]
+    grid = (m // tile,)
+    in_specs = [
+        pl.BlockSpec((tile, x.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, v.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+    ] + [
+        pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
+        for w in flat_ws
+    ]
+    return pl.pallas_call(
+        partial(_fwd_kernel_masked, spec),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((tile, 8), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, 8), jnp.float32),
+        interpret=_interpret(),
+        **_mosaic_kwargs(tile),
+    )(x, v, valid, *flat_ws)
+
+
+def _fused_fwd_masked(spec, tile, flat_ws, x, v, valid):
+    out = _pallas_fwd_masked(spec, tile, flat_ws, x, v, valid)
+    return out, (flat_ws, x, v, valid)
+
+
+def _fused_bwd_masked(spec, tile, res, draw):
+    flat_ws, x, v, valid = res
+    m = x.shape[0]
+    grid = (m // tile,)
+    in_specs = [
+        pl.BlockSpec((tile, x.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, v.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        pl.BlockSpec((tile, 8), lambda i: (i, 0)),
+    ] + [
+        pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
+        for w in flat_ws
+    ]
+    out_specs = [
+        pl.BlockSpec((tile, x.shape[1]), lambda i: (i, 0)),
+        pl.BlockSpec((tile, v.shape[1]), lambda i: (i, 0)),
+    ] + [
+        pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
+        for w in flat_ws
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((m, x.shape[1]), jnp.float32),
+        jax.ShapeDtypeStruct((m, v.shape[1]), jnp.float32),
+    ] + [jax.ShapeDtypeStruct(w.shape, jnp.float32) for w in flat_ws]
+    outs = pl.pallas_call(
+        partial(_bwd_kernel_masked, spec),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+        **_mosaic_kwargs(tile),
+    )(x, v, valid, jnp.asarray(draw, jnp.float32), *flat_ws)
+    dx, dv = outs[0], outs[1]
+    dws = [g.astype(w.dtype) for g, w in zip(outs[2:], flat_ws)]
+    # the occupancy bit is data-routing, not a differentiable quantity
+    return tuple(dws), dx, dv, jnp.zeros_like(valid)
+
+
+_fused_raw_masked.defvjp(_fused_fwd_masked, _fused_bwd_masked)
+
+
+def fused_mlp_raw_masked(
+    spec: FusedSpec, branch: dict, x_enc, d_enc, valid, tile=512
+):
+    """``fused_mlp_raw`` with a [M] validity mask streamed into the kernel.
+
+    Rows with ``valid == 0`` return raw 0 and receive zero cotangent; the
+    row-pad to the tile multiple is marked invalid, so the padded tail
+    tiles (and, for the sorted packed stream, the trailing all-padding
+    tiles of the real rows) skip the MLP entirely."""
+    m = x_enc.shape[0]
+    m_pad = _rup(max(m, 1), tile)
+    x = _pad_cols(jnp.asarray(x_enc, jnp.float32), spec.c_in_pad)
+    v = _pad_cols(jnp.asarray(d_enc, jnp.float32), spec.c_views_pad)
+    x = _pad_rows(x, m_pad)
+    v = _pad_rows(v, m_pad)
+    val = _pad_rows(
+        jnp.asarray(valid, jnp.float32).reshape(-1, 1), m_pad
+    )
+
+    flat = spec.flatten_params(branch)
+
+    raw8 = _fused_raw_masked(spec, tile, tuple(flat), x, v, val)
+    return raw8[:m, :4]
+
+
 def fused_mlp_raw(spec: FusedSpec, branch: dict, x_enc, d_enc, tile=512):
     """[M, c_in] encoded points + [M, c_views] encoded dirs → [M, 4] raw.
 
@@ -495,7 +659,7 @@ def make_fused_apply(network, cfg):
         compute_dtype=network.compute_dtype,
     )
 
-    def apply_fn(params, pts, viewdirs, model):
+    def apply_fn(params, pts, viewdirs, model, valid=None):
         x_enc = network.xyz_encoder(pts)
         dirs = jnp.broadcast_to(
             viewdirs[..., None, :], pts.shape[:-1] + (viewdirs.shape[-1],)
@@ -503,12 +667,24 @@ def make_fused_apply(network, cfg):
         d_enc = network.dir_encoder(dirs)
         lead = x_enc.shape[:-1]
         branch = params["params"][model]
-        raw = fused_mlp_raw(
-            spec, branch,
-            x_enc.reshape(-1, x_enc.shape[-1]),
-            d_enc.reshape(-1, d_enc.shape[-1]),
-            tile=tile,
-        )
+        if valid is None:
+            raw = fused_mlp_raw(
+                spec, branch,
+                x_enc.reshape(-1, x_enc.shape[-1]),
+                d_enc.reshape(-1, d_enc.shape[-1]),
+                tile=tile,
+            )
+        else:
+            raw = fused_mlp_raw_masked(
+                spec, branch,
+                x_enc.reshape(-1, x_enc.shape[-1]),
+                d_enc.reshape(-1, d_enc.shape[-1]),
+                jnp.reshape(valid, (-1,)),
+                tile=tile,
+            )
         return raw.reshape(*lead, 4)
 
+    # the packed march streams its per-sample occupancy bit into the
+    # kernel when the apply advertises this flag (packed_march.py)
+    apply_fn.supports_valid_mask = True
     return apply_fn

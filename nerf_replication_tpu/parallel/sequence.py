@@ -92,6 +92,9 @@ def build_sequence_parallel_renderer(
             lambda rays_p: smap(params, rays_p), n_shards, rays
         )
 
+    # the sharded executable itself, exposed for AOT registration
+    # (aot_register_sequence_renderer) — the wrapper above only pads/slices
+    render.jitted = smap
     return render
 
 
@@ -141,4 +144,58 @@ def build_sequence_parallel_march(
         out["n_truncated"] = jnp.sum(out.pop("truncated"))
         return out
 
+    march.jitted = smap
     return march
+
+
+# -- AOT registration --------------------------------------------------------
+def _padded_rays(n_rays: int, mesh) -> int:
+    n_shards = mesh.shape[DATA_AXIS]
+    return n_rays + (-n_rays) % n_shards
+
+
+def aot_register_sequence_renderer(
+    registry, params, n_rays: int, mesh, network, options, near, far,
+    chunk_size: int | None = None, serialize: bool = False,
+) -> str:
+    """Register the sequence-parallel renderer's sharded executable with a
+    compile/AOTRegistry: the build happens during warm-up instead of on
+    the first eval image. ``registry.take(name)`` yields the precompiled
+    smap — callers wrap it with the same pad/slice the builder applies."""
+    from ..compile.registry import abstract_like
+
+    n_pad = _padded_rays(n_rays, mesh)
+    name = f"seqpar_render_{n_pad}"
+    registry.register(
+        name,
+        build_sequence_parallel_renderer(
+            mesh, network, options, near, far, chunk_size
+        ).jitted,
+        (abstract_like(params),
+         jax.ShapeDtypeStruct((n_pad, 6), jnp.float32)),
+        serialize=serialize,
+    )
+    return name
+
+
+def aot_register_sequence_march(
+    registry, params, n_rays: int, grid, bbox, mesh, network, march_options,
+    near, far, chunk_size: int | None = None, serialize: bool = False,
+) -> str:
+    """Register the sequence-parallel ESS+ERT march's sharded executable
+    (grid + bbox replicated) with a compile/AOTRegistry."""
+    from ..compile.registry import abstract_like
+
+    n_pad = _padded_rays(n_rays, mesh)
+    name = f"seqpar_march_{n_pad}"
+    registry.register(
+        name,
+        build_sequence_parallel_march(
+            mesh, network, march_options, near, far, chunk_size
+        ).jitted,
+        (abstract_like(params),
+         jax.ShapeDtypeStruct((n_pad, 6), jnp.float32),
+         abstract_like(grid), abstract_like(bbox)),
+        serialize=serialize,
+    )
+    return name

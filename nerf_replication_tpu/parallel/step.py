@@ -218,6 +218,48 @@ def build_gspmd_step(
     return jax.jit(step, donate_argnums=donation_argnums(0))
 
 
+def aot_register_dp_step(
+    registry, name: str, abstract_args: tuple, *, mesh: Mesh, loss,
+    n_rays_global: int, near: float, far: float, k_steps: int = 1,
+    with_pool: bool = False, grad_accum: int = 1, serialize: bool = False,
+) -> str:
+    """Register the shard_map DP train step with a compile/AOTRegistry so
+    the sharded executable builds during warm-up (overlapping dataset
+    loading) instead of on the first burst. ``abstract_args`` is
+    ``compile.abstract_like`` of the real ``(state, bank_rays, bank_rgbs,
+    base_key[, pool])`` — shardings included, or the compiled executable
+    rejects its own inputs."""
+    registry.register(
+        name,
+        build_dp_step(
+            mesh, loss, n_rays_global, near, far, k_steps=k_steps,
+            with_pool=with_pool, grad_accum=grad_accum,
+        ),
+        abstract_args,
+        serialize=serialize,
+    )
+    return name
+
+
+def aot_register_gspmd_step(
+    registry, name: str, abstract_args: tuple, *, mesh: Mesh, loss,
+    n_rays: int, near: float, far: float, k_steps: int = 1,
+    grad_accum: int = 1, serialize: bool = False,
+) -> str:
+    """Register the GSPMD dp×tp train step with a compile/AOTRegistry
+    (same contract as :func:`aot_register_dp_step`)."""
+    registry.register(
+        name,
+        build_gspmd_step(
+            mesh, loss, n_rays, near, far, k_steps=k_steps,
+            grad_accum=grad_accum,
+        ),
+        abstract_args,
+        serialize=serialize,
+    )
+    return name
+
+
 def shard_train_state(state, mesh: Mesh):
     """Place a TrainState on the mesh per the partition rules (params and
     optimizer moments column-sharded over ``model``; scalars replicated)."""

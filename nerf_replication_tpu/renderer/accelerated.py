@@ -50,6 +50,15 @@ class MarchOptions:
     # (shrinking the phase-1/sort row counts) at unchanged in-bbox
     # resolution. Changes quadrature positions: off by default.
     clip_bbox: bool = False
+    # packed march only: hierarchical coarse-DDA traversal. coarse_block
+    # groups the S march positions into blocks of this many consecutive
+    # fine steps; a block enters the fine sweep + global sort only when
+    # one of its positions' PARENT coarse-pyramid cell is occupied. 0
+    # disables (flat sweep — the pre-pyramid behavior). coarse_cap is the
+    # static per-ray interval budget K_c (blocks kept per ray); 0 picks
+    # ceil(S_c / 4), a 4× candidate-stream reduction at the default.
+    coarse_block: int = 0
+    coarse_cap: int = 0
 
     @classmethod
     def from_cfg(cls, cfg) -> "MarchOptions":
@@ -63,6 +72,8 @@ class MarchOptions:
             white_bkgd=bool(ta.get("white_bkgd", True)),
             chunk_size=int(ta.get("march_chunk_size", 4096)),
             clip_bbox=bool(ta.get("march_clip_bbox", False)),
+            coarse_block=int(ta.get("march_coarse_block", 0)),
+            coarse_cap=int(ta.get("march_coarse_cap", 0)),
         )
 
     @classmethod
@@ -165,6 +176,14 @@ def march_rays_accelerated(
             "set task_arg.ngp_packed_march true (the per-ray [N, K] "
             "march would silently run UNCLIPPED at the coarse step, "
             "invalidating any A/B labeled with the clip knob)"
+        )
+    if options.coarse_block > 0:
+        raise ValueError(
+            "march_coarse_block (hierarchical coarse-DDA traversal) is "
+            "implemented only by the packed march — set "
+            "task_arg.ngp_packed_march true (the per-ray [N, K] march "
+            "would silently run the FLAT sweep, invalidating any A/B "
+            "labeled with the hierarchical knob)"
         )
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     n_rays = rays.shape[0]

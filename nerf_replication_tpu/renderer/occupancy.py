@@ -55,7 +55,9 @@ def bake_occupancy_grid(params, network, cfg) -> np.ndarray:
         n_batches, batch, n_sub, 3
     )
 
-    @jax.jit
+    # one-shot offline bake: traced once per bake invocation and thrown
+    # away — an AOT registry entry would outlive the only call it serves
+    @jax.jit  # graftlint: ok(aot: one-shot bake, no steady-state dispatch)
     def sweep(params, pts_p):
         def body(p):
             dirs = jnp.zeros((p.shape[0], 3), jnp.float32)
@@ -79,13 +81,69 @@ def default_grid_path(cfg_file: str) -> str:
     return os.path.join("logs", name, "occupancy_grid.npz")
 
 
+# ---------------------------------------------------------------------------
+# Mip pyramid: coarse levels are max-pool (any-) reductions of the fine bool
+# grid. The hierarchical packed march (packed_march.py) tests each sample's
+# PARENT coarse cell (fine voxel index // factor) before admitting it to the
+# fine sweep + global sort, so a coarse level must be a strict superset of
+# the fine grid: fine-occupied ⇒ coarse-occupied, which the any-reduce
+# guarantees. Resolution not divisible by the factor pads with False (the
+# pad lies past the +bbox face and is never a parent of an in-range voxel).
+# ---------------------------------------------------------------------------
+
+PYRAMID_VERSION = 1
+# reduction factor of each coarse level relative to the FINE grid; the
+# traversal marches the coarsest (last) level, the intermediate level exists
+# for stats/debug and cheap future re-tuning of the traversal factor
+PYRAMID_FACTORS = (2, 4)
+
+
+def _reduce_any(grid, factor: int, xp):
+    """Max-pool (any-) reduce a bool [R,R,R] grid by ``factor`` per axis;
+    ``xp`` is numpy (host bake) or jax.numpy (in-graph derivation)."""
+    r = grid.shape[0]
+    rp = -(-r // factor) * factor
+    if rp != r:
+        grid = xp.pad(grid, [(0, rp - r)] * 3)
+    rc = rp // factor
+    g = grid.reshape(rc, factor, rc, factor, rc, factor)
+    return xp.any(g, axis=(1, 3, 5))
+
+
+def coarse_from_grid(grid: jax.Array, factor: int) -> jax.Array:
+    """Traced any-reduce used INSIDE march executables.
+
+    Deriving the coarse level in-graph (an R³ bool reduce, trivial next to
+    the sweep it gates) keeps every executable signature at
+    ``(params, rays, grid, bbox)`` — serve buckets, AOT registrations, and
+    the NGP step donate the SAME fine grid they always did, and the live
+    NGP grid (re-carved every maintenance step) gets a coarse level that
+    can never go stale. Provably identical to the baked artifact levels:
+    both run ``_reduce_any`` with the same factor."""
+    return _reduce_any(grid, factor, jnp)
+
+
+def build_pyramid(grid: np.ndarray) -> list[np.ndarray]:
+    """Host-side ``[fine, coarse@2, coarse@4]`` mip stack of a bool grid."""
+    grid = np.asarray(grid, bool)
+    return [grid] + [_reduce_any(grid, f, np) for f in PYRAMID_FACTORS]
+
+
 def save_occupancy_grid(path: str, grid: np.ndarray, bbox, threshold: float) -> str:
+    """Write the VERSIONED pyramid artifact: the fine grid plus its baked
+    coarse levels. ``grid``/``bbox``/``threshold`` keys keep the legacy
+    layout so pre-pyramid readers (check_grid.py, load_occupancy_grid)
+    work unchanged."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    levels = build_pyramid(grid)
     np.savez_compressed(
         path,
-        grid=np.asarray(grid, bool),
+        grid=levels[0],
         bbox=np.asarray(bbox, np.float32),
         threshold=np.float32(threshold),
+        pyramid_version=np.int32(PYRAMID_VERSION),
+        pyramid_factors=np.asarray(PYRAMID_FACTORS, np.int32),
+        **{f"level_{i}": lv for i, lv in enumerate(levels[1:], start=1)},
     )
     return path
 
@@ -94,6 +152,41 @@ def load_occupancy_grid(path: str):
     """(grid bool [R,R,R], bbox [2,3]) or raises FileNotFoundError."""
     with np.load(path) as z:
         return np.asarray(z["grid"], bool), np.asarray(z["bbox"], np.float32)
+
+
+def load_occupancy_pyramid(path: str):
+    """(levels ``[fine, coarse@2, coarse@4]``, bbox [2,3]).
+
+    Legacy flat-grid ``.npz`` files (no ``pyramid_version`` key) upgrade
+    transparently: the pyramid is rebuilt on load from the fine grid. A
+    version/factor mismatch (artifact baked by a different pyramid layout)
+    also rebuilds rather than trusting stale coarse levels — the fine grid
+    is always the source of truth."""
+    with np.load(path) as z:
+        grid = np.asarray(z["grid"], bool)
+        bbox = np.asarray(z["bbox"], np.float32)
+        baked_ok = (
+            "pyramid_version" in z
+            and int(z["pyramid_version"]) == PYRAMID_VERSION
+            and tuple(np.asarray(z["pyramid_factors"]).tolist())
+            == PYRAMID_FACTORS
+        )
+        if baked_ok:
+            levels = [grid] + [
+                np.asarray(z[f"level_{i}"], bool)
+                for i in range(1, len(PYRAMID_FACTORS) + 1)
+            ]
+        else:
+            levels = build_pyramid(grid)
+    return levels, bbox
+
+
+def pyramid_stats(levels: list[np.ndarray]) -> dict:
+    """Per-level occupancy fractions — the headline traversal quantity
+    (candidate stream shrinks with the COARSEST level's occupancy)."""
+    return {
+        f"level_{i}_occ": float(lv.mean()) for i, lv in enumerate(levels)
+    }
 
 
 def occupancy_stats(grid: np.ndarray) -> dict:

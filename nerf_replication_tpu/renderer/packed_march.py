@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from .accelerated import MarchOptions, occupancy_sweep
+from .occupancy import PYRAMID_FACTORS, coarse_from_grid, world_to_voxel
 
 
 def _ray_bbox_spans(rays_o, rays_d, bbox, near, far):
@@ -59,6 +60,110 @@ def _ray_bbox_spans(rays_o, rays_d, bbox, near, far):
     t0 = jnp.clip(tmin, near, far)
     t1 = jnp.clip(tmax, near, far)
     return t0, jnp.maximum(t1, t0)
+
+
+def hierarchical_caps(n_steps: int, options: MarchOptions) -> tuple[int, int]:
+    """Static (S_c coarse blocks per ray, K_c kept-interval budget).
+
+    K_c defaults to ceil(S_c / 4): a 4× reduction of the candidate stream
+    entering the fine sweep + global sort. The DDA static-shape contract
+    (docs/traversal.md): every executable sees exactly N·K_c·coarse_block
+    candidate rows regardless of scene content; rays crossing more than
+    K_c occupied coarse blocks are CLIPPED and report ``truncated``."""
+    r = options.coarse_block
+    s_c = -(-n_steps // r)
+    k_c = options.coarse_cap if options.coarse_cap > 0 else max(1, -(-s_c // 4))
+    return s_c, min(k_c, s_c)
+
+
+def _hierarchical_sweep(rays, near, far, grid, bbox, options, spans):
+    """Coarse-DDA phase 1: fixed-step march of the COARSE pyramid level
+    selects per-ray occupied intervals; only their fine positions get a
+    fine-grid lookup and enter the global sort.
+
+    The coarse test is the PARENT cell of each position's fine voxel index
+    (``fine_vox // factor``) against the any-reduced pyramid level — a
+    strict superset of the fine grid by construction, so admitting exactly
+    the positions whose parent is occupied can never drop a fine-occupied
+    sample: hierarchical and flat marches composite identically (up to the
+    K_c interval clip, which reports ``truncated``). The elementwise
+    position→voxel math still runs at every march position (it is what the
+    DDA steps on), but the three O(N·S) terms that dominate the flat sweep
+    — the fine-grid random gather, the [N·S] global sort, and everything
+    downstream — shrink to the N·K_c·r candidate stream.
+
+    Returns ``(flat_cand [N, C] fine voxel ids, occ_cand [N, C] bool,
+    s_f [N, C] fine step ids, n_steps, n_blk [N], block_frac scalar,
+    k_c)`` with C = K_c · coarse_block.
+    """
+    import math
+
+    if rays.shape[-1] > 6:
+        # same contract as occupancy_sweep: a static geometry bake cannot
+        # gate time-conditioned rays
+        raise ValueError(
+            "the occupancy-accelerated march only supports static [N, 6] "
+            f"rays, got {rays.shape[-1]} columns — time-conditioned scenes "
+            "must use the chunked volume renderer (accelerated_renderer: "
+            "false)"
+        )
+    rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
+    n_rays = rays.shape[0]
+    resolution = grid.shape[0]
+    factor = PYRAMID_FACTORS[-1]
+    r = options.coarse_block
+    n_steps = max(math.ceil((far - near) / options.step_size - 1e-9), 1)
+    s_c, k_c = hierarchical_caps(n_steps, options)
+    s_pad = s_c * r
+
+    s_idx = jnp.arange(s_pad, dtype=jnp.float32)
+    if spans is None:
+        ts = near + s_idx * options.step_size
+        pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[None, :, None]
+    else:
+        t0, step_r = spans
+        ts = t0[:, None] + s_idx[None, :] * step_r[:, None]  # [N, S_pad]
+        pts = rays_o[:, None, :] + rays_d[:, None, :] * ts[..., None]
+    vox = world_to_voxel(pts, bbox, resolution)  # [N, S_pad, 3]
+
+    # coarse lookup in INDEX space (parent = fine // factor), not a second
+    # world_to_voxel at coarse resolution: when R is not a multiple of the
+    # factor the two mappings disagree near the +bbox face, and a mismatch
+    # there would break the superset guarantee the parity contract rests on
+    coarse = coarse_from_grid(grid, factor)
+    rc = coarse.shape[0]
+    cvox = vox // factor  # < rc always: vox ≤ R-1 ≤ rc·factor - 1
+    cflat = (cvox[..., 0] * rc + cvox[..., 1]) * rc + cvox[..., 2]
+    coarse_occ = jnp.take(coarse.reshape(-1), cflat)  # [N, S_pad] bool
+    real = jnp.sum(rays_d * rays_d, axis=-1) > 0.0  # padding rays drop out
+    in_range = jnp.arange(s_pad) < n_steps
+    coarse_occ = coarse_occ & real[:, None] & in_range[None, :]
+    if spans is not None:
+        coarse_occ = coarse_occ & (spans[1] > 0)[:, None]
+
+    # fixed-step DDA over blocks of r consecutive fine positions: a block
+    # is an interval [s·r, (s+1)·r) of march steps, admitted when ANY of
+    # its positions sits in an occupied coarse cell
+    block_occ = coarse_occ.reshape(n_rays, s_c, r).any(-1)  # [N, S_c]
+    n_blk = jnp.sum(block_occ, axis=-1)  # [N]
+    block_frac = jnp.mean(block_occ.astype(jnp.float32))
+
+    # static-shape per-ray interval list: stable argsort floats occupied
+    # blocks to the front IN MARCH ORDER; keep the first K_c
+    border = jnp.argsort(~block_occ, axis=-1, stable=True)[:, :k_c]
+    bvalid = jnp.take_along_axis(block_occ, border, axis=-1)  # [N, K_c]
+
+    s_f = border[..., None] * r + jnp.arange(r)  # [N, K_c, r]
+    s_f = s_f.reshape(n_rays, k_c * r)
+    cand_mask = jnp.broadcast_to(
+        bvalid[..., None], (n_rays, k_c, r)
+    ).reshape(n_rays, k_c * r) & (s_f < n_steps)
+
+    # fine sweep ONLY at admitted candidates — [N, K_c·r] not [N, S]
+    flat_all = (vox[..., 0] * resolution + vox[..., 1]) * resolution + vox[..., 2]
+    flat_cand = jnp.take_along_axis(flat_all, s_f, axis=-1)
+    occ_cand = jnp.take(grid.reshape(-1), flat_cand) & cand_mask
+    return flat_cand, occ_cand, s_f, n_steps, n_blk, block_frac, k_c
 
 
 def march_rays_packed(
@@ -88,6 +193,9 @@ def march_rays_packed(
     # switches the shared sweep to per-ray quadrature: the same static S
     # covers only the ray's bbox span at a finer per-ray step. Padding
     # rays / bbox misses come back fully unoccupied either way.
+    # coarse_block > 0 inserts the coarse-DDA stage: the flat [N, S]
+    # candidate set shrinks to the [N, K_c·r] positions inside occupied
+    # coarse-pyramid cells BEFORE the fine gather and the global sort.
     if options.clip_bbox:
         import math
 
@@ -97,14 +205,25 @@ def march_rays_packed(
         spans = (t0, step_r)
     else:
         t0 = step_r = spans = None
-    _, flat_vox, occupied, n_steps = occupancy_sweep(
-        rays, near, far, grid, bbox, step, spans=spans
-    )
-    m_cap = min(int(n_rays * cap_avg), n_rays * n_steps)
+    hierarchical = options.coarse_block > 0
+    if hierarchical:
+        flat_vox, occupied, s_f, n_steps, n_blk_c, block_frac, k_c = (
+            _hierarchical_sweep(rays, near, far, grid, bbox, options, spans)
+        )
+    else:
+        _, flat_vox, occupied, n_steps = occupancy_sweep(
+            rays, near, far, grid, bbox, step, spans=spans
+        )
+        s_f = None
+        block_frac = jnp.float32(1.0)
+    n_cand = occupied.shape[-1]  # K_c·r hierarchical, S flat
+    m_cap = min(int(n_rays * cap_avg), n_rays * n_cand)
 
     # phase 2: ONE global sort compacts every occupied (ray, t) position
-    # to the front of a flat [N·S] stream in (ray, t) order.
-    total = n_rays * n_steps
+    # to the front of a flat candidate stream in (ray, t) order. In the
+    # hierarchical mode candidates are already (ray, t)-lexicographic:
+    # kept blocks ascend in march order and steps ascend within a block.
+    total = n_rays * n_cand
     occ_flat = occupied.reshape(-1)
     idx = jnp.arange(total, dtype=jnp.int32)
     key = jnp.where(occ_flat, idx, total + idx)
@@ -112,8 +231,11 @@ def march_rays_packed(
     order = order[:m_cap]  # static [M] alive-list
     valid = occ_flat[order]  # [M] bool (False ⇒ stream tail padding)
 
-    ray_id = order // n_steps  # [M] int32, nondecreasing over valid prefix
-    s_id = order % n_steps
+    ray_id = order // n_cand  # [M] int32, nondecreasing over valid prefix
+    if hierarchical:
+        s_id = s_f.reshape(-1)[order]  # fine march step of each candidate
+    else:
+        s_id = order % n_cand
     if options.clip_bbox:
         t_m = t0[ray_id] + s_id.astype(jnp.float32) * step_r[ray_id]
         step_m = step_r[ray_id]
@@ -127,8 +249,18 @@ def march_rays_packed(
     viewdirs = rays_d / jnp.linalg.norm(rays_d, axis=-1, keepdims=True)
 
     # the network contract is [rays, samples, 3] points + [rays, 3] dirs;
-    # the packed stream is "M rays of one sample each"
-    raw = apply_fn(pts_m[:, None, :], viewdirs[ray_id], "fine")[:, 0, :]
+    # the packed stream is "M rays of one sample each". Fused-trunk apply
+    # fns advertise ``supports_valid_mask``: the per-sample occupancy bit
+    # streams INTO the Pallas kernel, which masks invalid rows and skips
+    # the matmul chain for all-invalid tiles — the sorted stream puts the
+    # valid prefix first, so the padding tail costs ~no MXU work.
+    if getattr(apply_fn, "supports_valid_mask", False):
+        raw = apply_fn(
+            pts_m[:, None, :], viewdirs[ray_id], "fine",
+            valid=valid.astype(jnp.float32),
+        )[:, 0, :]
+    else:
+        raw = apply_fn(pts_m[:, None, :], viewdirs[ray_id], "fine")[:, 0, :]
 
     rgb = jax.nn.sigmoid(raw[..., :3])  # [M, 3]
     sigma = jax.nn.relu(raw[..., 3])  # [M]
@@ -181,6 +313,12 @@ def march_rays_packed(
     c_end = c[jnp.maximum(kept_end - 1, 0)]
     t_after = jnp.where(kept_n > 0, jnp.exp(-(c_end - e0)), 1.0)
     still_alive = t_after >= options.transmittance_threshold
+    if hierarchical:
+        # the coarse DDA clipped whole intervals off rays crossing more
+        # than K_c occupied blocks BEFORE the stream ever saw them — the
+        # stream-overflow test alone cannot observe that loss, so a
+        # clipped ray must still report truncation, not silently shorten
+        lost = lost | (n_blk_c > k_c)
     n_total_occ = cum_occ[-1]
     out = {
         "rgb_map_f": rgb_map,
@@ -191,6 +329,12 @@ def march_rays_packed(
             jnp.maximum(n_total_occ - m_cap, 0).astype(jnp.float32)
             / jnp.maximum(n_total_occ, 1).astype(jnp.float32)
         ),
+        # traversal telemetry (obs/schema.py "march" rows): rows entering
+        # the global sort, occupied rows surviving the fine test, and the
+        # coarse-level admission fraction (1.0 in the flat sweep)
+        "march_candidates": jnp.float32(total),
+        "march_samples_out": n_total_occ.astype(jnp.float32),
+        "march_coarse_occ": block_frac,
     }
     if return_samples:
         out["sample_flat"] = jax.lax.stop_gradient(

@@ -309,11 +309,22 @@ class Renderer:
         # the Renderer's accelerated path only serves EVAL (run.py,
         # render_video.py) — it takes the eval-specific march budget
         self.march_options = MarchOptions.eval_from_cfg(cfg)
+        # stream cap for the packed (hierarchical / clip_bbox) march
+        self.packed_cap = int(
+            cfg.task_arg.get(
+                "packed_cap_avg_eval", self.march_options.max_samples
+            )
+        )
         self.occupancy_grid = None
         self.grid_bbox = None
         self._march_fns: dict = {}
         self._march_fns_cap = 8
         self._n_truncated = jnp.zeros((), jnp.int32)
+        # last traversal diagnostics from the packed march, kept ON DEVICE
+        # (no sync on the render path); telemetry surfaces pull them
+        self.last_march_stats: dict = {}
+        # AOT bookkeeping: registry entry name -> local executable-cache key
+        self._aot_names: dict = {}
         # fused Pallas MLP trunk (ops/fused_mlp.py): weights + activations
         # VMEM-resident per tile, backward recomputes in VMEM — the lever
         # against the flagship's 48.8 GB/step activation traffic (PERF.md
@@ -346,6 +357,38 @@ class Renderer:
             options,
         )
 
+    def _build_chunked_fn(self, n_chunks: int):
+        """Jitted chunked-eval executable for a fixed chunk count. Named
+        builder so AOT registration (aot_register_eval) can route it
+        through compile/AOTRegistry instead of first-dispatch tracing."""
+        options = self.eval_options
+        network = self.network
+        fused = self._fused_apply
+
+        @jax.jit
+        def fn(params, rays_p, near, far, key):
+            if fused is not None:
+                apply_fn = lambda pts, vd, model: fused(  # noqa: E731
+                    params, pts, vd, model
+                )
+            else:
+                apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+                    params, pts, vd, model=model
+                )
+
+            def body(idx_and_rays):
+                idx, rays_chunk = idx_and_rays
+                # distinct stream per chunk, else every chunk repeats the
+                # same jitter/noise draws → chunk-periodic stripes
+                ck = None if key is None else jax.random.fold_in(key, idx)
+                return render_rays(
+                    apply_fn, rays_chunk, near, far, ck, options
+                )
+
+            return jax.lax.map(body, (jnp.arange(n_chunks), rays_p))
+
+        return fn
+
     def render_chunked(self, params, batch: dict, key=None) -> dict:
         """Full-image eval: `lax.map` over fixed-size chunks with padding —
         the XLA idiom for the reference's python chunk loop
@@ -357,33 +400,7 @@ class Renderer:
 
         fn = self._chunked_fns.get((n_chunks, chunk))
         if fn is None:
-            options = self.eval_options
-            network = self.network
-
-            fused = self._fused_apply
-
-            @jax.jit
-            def fn(params, rays_p, near, far, key):
-                if fused is not None:
-                    apply_fn = lambda pts, vd, model: fused(  # noqa: E731
-                        params, pts, vd, model
-                    )
-                else:
-                    apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
-                        params, pts, vd, model=model
-                    )
-
-                def body(idx_and_rays):
-                    idx, rays_chunk = idx_and_rays
-                    # distinct stream per chunk, else every chunk repeats the
-                    # same jitter/noise draws → chunk-periodic stripes
-                    ck = None if key is None else jax.random.fold_in(key, idx)
-                    return render_rays(
-                        apply_fn, rays_chunk, near, far, ck, options
-                    )
-
-                return jax.lax.map(body, (jnp.arange(n_chunks), rays_p))
-
+            fn = self._build_chunked_fn(n_chunks)
             self._chunked_fns[(n_chunks, chunk)] = fn
 
         out = fn(params, rays_p, batch["near"], batch["far"], key)
@@ -392,26 +409,94 @@ class Renderer:
     # -- occupancy-accelerated path (ESS + ERT) -----------------------------
     def load_occupancy_grid(self, grid_path: str) -> bool:
         """Load a baked grid; missing file → slow-mode fallback, matching the
-        reference (volume_renderer.py:249-259). Returns True when loaded."""
+        reference (volume_renderer.py:249-259). Returns True when loaded.
+
+        Reads the versioned pyramid artifact (legacy flat ``.npz`` grids are
+        upgraded on load). Only the FINE level is held — the coarse DDA
+        level is derived in-graph (occupancy.coarse_from_grid) inside each
+        executable, so the march signature stays (params, rays, grid, bbox)
+        and the coarse level can never go stale against the fine grid."""
         import os
 
-        from .occupancy import load_occupancy_grid
+        from .occupancy import load_occupancy_pyramid
 
         if not os.path.exists(grid_path):
             print(f"Occupancy grid file not found: {grid_path}, run in slow mode.")
             return False
-        grid, bbox = load_occupancy_grid(grid_path)
-        self.occupancy_grid = jnp.asarray(grid)
+        levels, bbox = load_occupancy_pyramid(grid_path)
+        self.occupancy_grid = jnp.asarray(levels[0])
         self.grid_bbox = jnp.asarray(bbox)
         return True
+
+    def _build_march_fn(self, near: float, far: float):
+        """Jitted occupancy-march executable for fixed bounds/options.
+
+        Routing mirrors serve/engine.py exactly (full-tier parity by
+        construction): ``coarse_block > 0`` (hierarchical coarse-DDA) or
+        ``clip_bbox`` (per-ray quadrature) take the globally-packed march;
+        the plain per-ray two-phase march otherwise. Named builder so AOT
+        registration (aot_register_eval) can route it through
+        compile/AOTRegistry."""
+        network = self.network
+        options = self.march_options
+        fused = self._fused_apply
+        packed = options.coarse_block > 0 or options.clip_bbox
+
+        def _apply(params):
+            if fused is not None:
+                def apply_fn(pts, vd, model, valid=None):
+                    if valid is not None:
+                        return fused(params, pts, vd, model, valid=valid)
+                    return fused(params, pts, vd, model)
+
+                # forward the Pallas trunk's masked entry point so the
+                # packed march can stream its occupancy bits into the kernel
+                apply_fn.supports_valid_mask = getattr(
+                    fused, "supports_valid_mask", False
+                )
+            else:
+                apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+                    params, pts, vd, model=model
+                )
+            return apply_fn
+
+        if packed:
+            from .packed_march import march_rays_packed
+
+            cap = self.packed_cap
+
+            @jax.jit
+            def fn(params, rays_p, grid, bbox):
+                apply_fn = _apply(params)
+                return jax.lax.map(
+                    lambda rc: march_rays_packed(
+                        apply_fn, rc, near, far, grid, bbox, options,
+                        cap_avg=cap,
+                    ),
+                    rays_p,
+                )
+
+            return fn
+
+        from .accelerated import march_rays_accelerated
+
+        @jax.jit
+        def fn(params, rays_p, grid, bbox):
+            apply_fn = _apply(params)
+            return jax.lax.map(
+                lambda rc: march_rays_accelerated(
+                    apply_fn, rc, near, far, grid, bbox, options
+                ),
+                rays_p,
+            )
+
+        return fn
 
     def render_accelerated(self, params, batch: dict) -> dict:
         """Full-image ESS+ERT render; falls back to the vanilla chunked path
         when no grid is loaded (volume_renderer.py:269-271)."""
         if self.occupancy_grid is None:
             return self.render_chunked(params, batch)
-
-        from .accelerated import march_rays_accelerated
 
         rays_p, n, n_chunks, chunk = _pad_to_chunks(
             batch["rays"], self.march_options.chunk_size
@@ -429,27 +514,7 @@ class Renderer:
         cache_key = (n_chunks, chunk, near, far, self.march_options)
         fn = self._march_fns.get(cache_key)
         if fn is None:
-            network = self.network
-            options = self.march_options
-            fused = self._fused_apply
-
-            @jax.jit
-            def fn(params, rays_p, grid, bbox):
-                if fused is not None:
-                    apply_fn = lambda pts, vd, model: fused(  # noqa: E731
-                        params, pts, vd, model
-                    )
-                else:
-                    apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
-                        params, pts, vd, model=model
-                    )
-                return jax.lax.map(
-                    lambda rc: march_rays_accelerated(
-                        apply_fn, rc, near, far, grid, bbox, options
-                    ),
-                    rays_p,
-                )
-
+            fn = self._build_march_fn(near, far)
             while len(self._march_fns) >= self._march_fns_cap:
                 self._march_fns.pop(next(iter(self._march_fns)))
             self._march_fns[cache_key] = fn
@@ -459,12 +524,91 @@ class Renderer:
         out = _unpad_outputs(
             fn(params, rays_p, self.occupancy_grid, self.grid_bbox), n
         )
+        # the packed march also reports per-chunk traversal diagnostics —
+        # [n_chunks] vectors, NOT per-ray — park them on device for
+        # telemetry surfaces (train/ngp.py render_image emits "march" rows)
+        for k in (
+            "march_candidates", "march_samples_out", "march_coarse_occ",
+            "overflow_frac",
+        ):
+            if k in out:
+                self.last_march_stats[k] = out.pop(k)
         # accumulate the truncation diagnostic ON DEVICE — a host sync here
         # would serialize per-image dispatch (ADVICE r1); callers read it
         # once per eval via report_truncation(). Summed after unpadding, so
         # padding rows never count.
         self._n_truncated = self._n_truncated + jnp.sum(out.pop("truncated"))
         return out
+
+    # -- AOT registration ---------------------------------------------------
+    def aot_register_eval(
+        self, registry, params, n_rays: int, near: float, far: float,
+        serialize: bool = False,
+    ) -> list[str]:
+        """Register the renderer's eval executables with a
+        compile/AOTRegistry so their builds happen during warm-up
+        (concurrently, optionally serialized to the artifact store)
+        instead of on the first validation image. The chunked entry is
+        lowered for deterministic eval (key=None — run.py's eval
+        contract); the march entry is registered only once a grid is
+        loaded. Call :meth:`aot_install` after ``compile_all()`` to adopt
+        the precompiled executables. Returns the registered names."""
+        from ..compile.registry import abstract_like
+
+        near, far = float(near), float(far)
+        p_abs = abstract_like(params)
+        names: list[str] = []
+
+        chunk = min(self.eval_options.chunk_size, n_rays)
+        n_chunks = -(-n_rays // chunk)
+        rays_abs = jax.ShapeDtypeStruct((n_chunks, chunk, 6), jnp.float32)
+        name = f"eval_chunked_{n_chunks}x{chunk}"
+        registry.register(
+            name,
+            self._build_chunked_fn(n_chunks),
+            (p_abs, rays_abs, near, far, None),
+            serialize=serialize,
+        )
+        self._aot_names[name] = ("chunked", (n_chunks, chunk))
+        names.append(name)
+
+        if self.occupancy_grid is not None:
+            chunk_m = min(self.march_options.chunk_size, n_rays)
+            n_chunks_m = -(-n_rays // chunk_m)
+            rays_m = jax.ShapeDtypeStruct(
+                (n_chunks_m, chunk_m, 6), jnp.float32
+            )
+            mname = f"eval_march_{n_chunks_m}x{chunk_m}"
+            registry.register(
+                mname,
+                self._build_march_fn(near, far),
+                (
+                    p_abs, rays_m, abstract_like(self.occupancy_grid),
+                    abstract_like(self.grid_bbox),
+                ),
+                serialize=serialize,
+            )
+            self._aot_names[mname] = (
+                "march", (n_chunks_m, chunk_m, near, far, self.march_options)
+            )
+            names.append(mname)
+        return names
+
+    def aot_install(self, registry) -> int:
+        """Adopt every successfully precompiled eval executable into the
+        local caches (failed builds keep the lazy-jit path). Returns the
+        number installed."""
+        installed = 0
+        for name, (kind, key) in self._aot_names.items():
+            fn = registry.take(name)
+            if fn is None:
+                continue
+            if kind == "chunked":
+                self._chunked_fns[key] = fn
+            else:
+                self._march_fns[key] = fn
+            installed += 1
+        return installed
 
     def accumulate_truncated(self, flags_or_count) -> None:
         """Fold an external path's truncation diagnostic (per-ray flags or a

@@ -11,10 +11,16 @@ retrace. With NerfAcc-style occupancy sampling making per-ray FLOPs cheap,
 dispatch/batching dominates serving latency; the bucket set is the whole
 executable inventory, compiled before the first request arrives.
 
-Three executable families exist per bucket — ``full`` / ``reduced_k`` /
-``coarse`` (serve/policy.py's degradation ladder; ``half_res`` reuses
-``coarse`` with host-side ray striding) — so shedding load under backlog
-switches executables, never compiles one.
+Four executable families exist per bucket — ``full`` / ``bf16`` /
+``reduced_k`` / ``coarse`` (serve/policy.py's degradation ladder;
+``half_res`` reuses ``coarse`` with host-side ray striding) — so shedding
+load under backlog switches executables, never compiles one. ``bf16`` is
+the full march budget with the network cloned to bfloat16 COMPUTE (f32
+params and f32 compositing — the march's sigmoid/relu/transmittance math
+runs outside the network): its own prewarmed bucket set, no new code
+path. When the march options enable the hierarchical traversal
+(``march_coarse_block``), every grid-backed family routes through the
+coarse-DDA packed march (renderer/packed_march.py).
 
 Numerics contract: for the ``full`` tier the per-bucket executable is the
 SAME program ``Renderer.render_accelerated`` builds — identical chunking
@@ -47,7 +53,7 @@ class ServeOptions:
     cache_entries: int = 64
     pose_decimals: int = 3
     warmup: bool = True
-    shed_queue_depths: tuple[int, ...] = (4, 8, 16)
+    shed_queue_depths: tuple[int, ...] = (4, 8, 16, 32)
 
     @classmethod
     def from_cfg(cls, cfg) -> "ServeOptions":
@@ -61,7 +67,7 @@ class ServeOptions:
             pose_decimals=int(s.get("pose_decimals", 3)),
             warmup=bool(s.get("warmup", True)),
             shed_queue_depths=tuple(
-                int(d) for d in s.get("shed_queue_depths", (4, 8, 16))
+                int(d) for d in s.get("shed_queue_depths", (4, 8, 16, 32))
             ),
         )
 
@@ -109,6 +115,13 @@ class RenderEngine:
         # construction, not by keeping two configs in sync
         self.march_options = MarchOptions.eval_from_cfg(cfg)
         self.eval_options = RenderOptions.from_cfg(cfg, train=False)
+        # stream cap for the packed (hierarchical / clip_bbox) march: the
+        # NGP eval knob when set, else the per-ray max budget on average
+        self.packed_cap = int(
+            cfg.task_arg.get(
+                "packed_cap_avg_eval", self.march_options.max_samples
+            )
+        )
         self.chunk = (
             self.march_options.chunk_size if self.use_grid
             else self.eval_options.chunk_size
@@ -126,6 +139,13 @@ class RenderEngine:
         self.n_pad_rays = 0
         self.n_truncated = 0
         self.warmup_compiles = 0
+        # traversal accounting (packed march only): sums over dispatched
+        # chunks, read as means via stats()["march"]
+        self.march_chunks = 0
+        self.march_candidates = 0.0
+        self.march_samples_out = 0.0
+        self.march_coarse_occ_sum = 0.0
+        self.march_overflow_sum = 0.0
         # AOT registry (compile/registry): executables lower/compile — or
         # deserialize from the artifact store — up front on host threads.
         # With a registry the engine can warm on ABSTRACT params (shape
@@ -143,7 +163,9 @@ class RenderEngine:
 
     def _family_march_options(self, family: str):
         base = self.march_options
-        if family == "full":
+        if family in ("full", "bf16"):
+            # bf16 keeps the FULL march budget: its quality trade is the
+            # compute dtype, not the sample count
             return base
         # reduced_k and coarse share the halved MLP budget; coarse
         # additionally swaps the queried network (in _build_fn)
@@ -151,25 +173,59 @@ class RenderEngine:
 
     def _family_eval_options(self, family: str):
         base = self.eval_options
-        if family == "full":
+        if family in ("full", "bf16"):
             return base
         if family == "reduced_k":
             return replace(base, n_importance=base.n_importance // 2)
         return replace(base, n_importance=0)  # coarse-only
+
+    def _family_network(self, family: str):
+        if family != "bf16":
+            return self.network
+        import jax.numpy as jnp
+
+        # bf16 COMPUTE, f32 params: Network builds its submodules from
+        # ``compute_dtype`` in setup(), so a clone re-applies the SAME f32
+        # checkpoint with bf16 matmuls — no second parameter tree, no new
+        # code path, just one more prewarmed executable set
+        return self.network.clone(compute_dtype=jnp.bfloat16)
 
     def _build_fn(self, bucket: int, family: str):
         import jax
         import jax.numpy as jnp  # noqa: F401  (kept local: no import cost pre-jax)
 
         from ..renderer.accelerated import march_rays_accelerated
+        from ..renderer.packed_march import march_rays_packed
         from ..renderer.volume import render_rays
 
-        network = self.network
+        network = self._family_network(family)
         near, far = self.near, self.far
         model = "coarse" if family == "coarse" else "fine"
 
         if self.use_grid:
             options = self._family_march_options(family)
+
+            if options.coarse_block > 0 or options.clip_bbox:
+                # hierarchical (or clipped) traversal: the packed march,
+                # same routing condition as Renderer.render_accelerated —
+                # full-tier parity with the one-shot surfaces holds by
+                # construction, both switch on the same MarchOptions
+                cap = self.packed_cap
+
+                @jax.jit
+                def fn(params, rays_p, grid, bbox):
+                    apply_fn = lambda pts, vd, _m: network.apply(  # noqa: E731
+                        params, pts, vd, model=model
+                    )
+                    return jax.lax.map(
+                        lambda rc: march_rays_packed(
+                            apply_fn, rc, near, far, grid, bbox, options,
+                            cap_avg=cap,
+                        ),
+                        rays_p,
+                    )
+
+                return fn
 
             @jax.jit
             def fn(params, rays_p, grid, bbox):
@@ -304,7 +360,23 @@ class RenderEngine:
                        family: str) -> dict:
         n = rays.shape[0]
         rays_b = np.pad(rays, ((0, bucket - n), (0, 0)))
-        out = self._dispatch(rays_b, bucket, family)
+        out = dict(self._dispatch(rays_b, bucket, family))
+        # traversal diagnostics are PER-CHUNK scalars ([n_chunks] under the
+        # lax.map), not per-ray maps — fold them into the serving counters
+        # before the per-ray reshape below would garble them
+        if "march_candidates" in out:
+            cand = np.asarray(out.pop("march_candidates"))  # graftlint: ok(host-sync)
+            self.march_chunks += cand.size
+            self.march_candidates += float(cand.sum())
+            self.march_samples_out += float(
+                np.sum(np.asarray(out.pop("march_samples_out")))  # graftlint: ok(host-sync)
+            )
+            self.march_coarse_occ_sum += float(
+                np.sum(np.asarray(out.pop("march_coarse_occ")))  # graftlint: ok(host-sync)
+            )
+            self.march_overflow_sum += float(
+                np.sum(np.asarray(out.pop("overflow_frac")))  # graftlint: ok(host-sync)
+            )
         out = {
             # intentional device pull: outputs ARE the response payload
             k: np.asarray(v).reshape((-1,) + v.shape[2:])[:n]  # graftlint: ok(host-sync)
@@ -444,7 +516,20 @@ class RenderEngine:
     # -- introspection --------------------------------------------------------
 
     def stats(self) -> dict:
+        march = None
+        if self.march_chunks:
+            march = {
+                "chunks": self.march_chunks,
+                "candidates_per_chunk": self.march_candidates / self.march_chunks,
+                "samples_out_per_chunk": self.march_samples_out / self.march_chunks,
+                "sweep_efficiency": (
+                    self.march_samples_out / max(self.march_candidates, 1.0)
+                ),
+                "coarse_occ_mean": self.march_coarse_occ_sum / self.march_chunks,
+                "overflow_mean": self.march_overflow_sum / self.march_chunks,
+            }
         return {
+            "march": march,
             "buckets": list(self.buckets),
             "chunk": self.chunk,
             "use_grid": self.use_grid,
@@ -483,7 +568,7 @@ def engine_from_cfg(cfg, cfg_file: str | None = None) -> RenderEngine:
     from ..compile import registry_from_cfg
     from ..datasets import make_dataset
     from ..models import init_params_for, make_network
-    from ..renderer.occupancy import default_grid_path, load_occupancy_grid
+    from ..renderer.occupancy import default_grid_path, load_occupancy_pyramid
     from ..train.checkpoint import load_network
 
     network = make_network(cfg)
@@ -494,7 +579,12 @@ def engine_from_cfg(cfg, cfg_file: str | None = None) -> RenderEngine:
 
         path = default_grid_path(cfg_file or "config")
         if os.path.exists(path):
-            grid, bbox = load_occupancy_grid(path)
+            # versioned pyramid artifact; legacy flat grids upgrade on
+            # load. Executables consume the FINE level and derive the
+            # coarse level in-graph (renderer/occupancy.coarse_from_grid)
+            # so the serve signatures stay (params, chunks, grid, bbox).
+            levels, bbox = load_occupancy_pyramid(path)
+            grid = levels[0]
         else:
             print(f"occupancy grid not found at {path}; "
                   "serving through the chunked volume path")

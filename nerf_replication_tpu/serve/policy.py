@@ -8,13 +8,19 @@ the affected user), the policy trades quality for latency in deterministic
 steps, and every response records the tier it was served at so degraded
 traffic is measurable, never silent.
 
-Tier ladder (cheapest executable family in parentheses — tiers 2 and 3
-share one, so degrading never compiles anything new):
+Tier ladder (cheapest executable family in parentheses — the last two
+tiers share one, so degrading never compiles anything new):
 
 ==========  =================  =============================================
 tier        executable family  meaning
 ==========  =================  =============================================
-full        full               eval-budget march, fine network
+full        full               eval-budget march, fine network, f32
+bf16        bf16               full march budget, fine network, bf16
+                               COMPUTE (matmul chain) with f32 compositing —
+                               the mildest shed step: quality loss is a
+                               rounding-level PSNR delta, and on TPU the
+                               halved MXU word size makes it cheaper than
+                               full, not just equal
 reduced_k   reduced_k          half the max_samples MLP budget per ray
 coarse      coarse             coarse network + reduced budget
 half_res    coarse             coarse, every 2nd ray rendered, output
@@ -27,18 +33,21 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 # degradation order; index 0 is the undegraded tier
-TIER_NAMES: tuple[str, ...] = ("full", "reduced_k", "coarse", "half_res")
+TIER_NAMES: tuple[str, ...] = (
+    "full", "bf16", "reduced_k", "coarse", "half_res"
+)
 
 # tier -> (executable family, ray stride applied OUTSIDE the executable)
 TIER_IMPL: dict[str, tuple[str, int]] = {
     "full": ("full", 1),
+    "bf16": ("bf16", 1),
     "reduced_k": ("reduced_k", 1),
     "coarse": ("coarse", 1),
     "half_res": ("coarse", 2),
 }
 
 # the executable families the engine pre-warms per bucket
-FAMILIES: tuple[str, ...] = ("full", "reduced_k", "coarse")
+FAMILIES: tuple[str, ...] = ("full", "bf16", "reduced_k", "coarse")
 
 
 @dataclass(frozen=True)
@@ -51,7 +60,7 @@ class DegradationPolicy:
     the tier index is the count of thresholds the depth has reached.
     """
 
-    thresholds: tuple[int, ...] = (4, 8, 16)
+    thresholds: tuple[int, ...] = (4, 8, 16, 32)
 
     def __post_init__(self):
         if list(self.thresholds) != sorted(self.thresholds):
@@ -69,7 +78,7 @@ class DegradationPolicy:
         s = cfg.get("serve", {})
         return cls(
             thresholds=tuple(
-                int(d) for d in s.get("shed_queue_depths", (4, 8, 16))
+                int(d) for d in s.get("shed_queue_depths", (4, 8, 16, 32))
             )
         )
 

@@ -23,11 +23,36 @@ class ImgFitRenderer:
     def __init__(self, cfg, network):
         self.network = network
         self.chunk_size = int(cfg.task_arg.get("chunk_size", 16384))
-        self._apply = jax.jit(
+        self._apply = self._build_apply()
+
+    def _build_apply(self):
+        """The jitted chunked apply — a named builder so AOT registration
+        (aot_register) can route it through compile/AOTRegistry."""
+        network = self.network
+        return jax.jit(
             lambda params, uv_p: jax.lax.map(
                 lambda c: network.apply(params, c), uv_p
             )
         )
+
+    def aot_register(self, registry, params, n_rays: int,
+                     serialize: bool = False) -> str:
+        """Register the chunked apply for ``n_rays``-pixel eval images with
+        a compile/AOTRegistry; ``registry.take(name)`` after compile_all
+        yields the precompiled executable (assignable to ``_apply``)."""
+        from ..compile.registry import abstract_like
+
+        chunk = min(self.chunk_size, n_rays)
+        n_chunks = -(-n_rays // chunk)
+        name = f"img_fit_apply_{n_chunks}x{chunk}"
+        registry.register(
+            name,
+            self._build_apply(),
+            (abstract_like(params),
+             jax.ShapeDtypeStruct((n_chunks, chunk, 2), jnp.float32)),
+            serialize=serialize,
+        )
+        return name
 
     def render_chunked(self, params, batch: dict) -> dict:
         uv = jnp.asarray(batch["rays"])

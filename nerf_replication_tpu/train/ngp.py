@@ -370,6 +370,9 @@ class NGPTrainer:
                 if packed:
                     # occupied samples dropped by the global stream cap
                     stats["overflow_frac"] = out["overflow_frac"]
+                    # coarse-DDA block admission fraction (1.0 when the
+                    # march runs flat) — the carved phase's sweep shrink
+                    stats["march_coarse_occ"] = out["march_coarse_occ"]
                 return l, (out, stats)
 
             def loss_fn_warm(p):
@@ -740,6 +743,27 @@ class NGPTrainer:
                 f"{self.packed_cap_avg_eval} and re-rendering"
             )
         out = _unpad_outputs(out, n)
+        # traversal telemetry ([n_chunks] vectors from the packed march —
+        # popped BEFORE callers treat remaining keys as per-ray maps): one
+        # "march" row per eval image feeds tlm_report's sweep-efficiency
+        # summary and --diff regression gate
+        if "march_candidates" in out:
+            cand = float(np.asarray(jnp.sum(out.pop("march_candidates"))))
+            samp = float(np.asarray(jnp.sum(out.pop("march_samples_out"))))
+            c_occ = float(np.asarray(jnp.mean(out.pop("march_coarse_occ"))))
+            get_emitter().emit(
+                "march",
+                surface="ngp_eval",
+                mode=(
+                    "hierarchical" if self.eval_march.coarse_block > 0
+                    else "packed"
+                ),
+                candidates_in=cand,
+                samples_out=samp,
+                coarse_occ=c_occ,
+                overflow_frac=max_of,
+                n_rays=n,
+            )
         # surface the budget diagnostics like Renderer.render_accelerated
         # does instead of silently dropping far content — citing the knob
         # that actually bounds the active march mode

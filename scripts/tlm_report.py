@@ -193,6 +193,30 @@ def summarize(rows: list[dict]) -> dict:
         )
         summary["serve_tiers"] = tiers
 
+    # traversal rows (renderer/packed_march.py hierarchical coarse-DDA):
+    # sweep efficiency = occupied samples surviving the fine test per
+    # candidate row entering the global sort — the number the mip-pyramid
+    # DDA exists to raise. Keys present only when the run marched packed.
+    marches = [r for r in rows if r.get("kind") == "march"]
+    if marches:
+        cand = sum(float(r.get("candidates_in", 0.0)) for r in marches)
+        samp = sum(float(r.get("samples_out", 0.0)) for r in marches)
+        c_occ = [float(r["coarse_occ"]) for r in marches
+                 if r.get("coarse_occ") is not None]
+        over = [float(r["overflow_frac"]) for r in marches
+                if r.get("overflow_frac") is not None]
+        summary["march_rows"] = len(marches)
+        summary["march_candidates"] = cand
+        summary["march_samples_out"] = samp
+        summary["march_sweep_efficiency"] = samp / cand if cand else None
+        summary["march_coarse_occ"] = (
+            sum(c_occ) / len(c_occ) if c_occ else None
+        )
+        summary["march_overflow_max"] = max(over) if over else None
+        summary["march_modes"] = sorted(
+            {r.get("mode", "packed") for r in marches}
+        )
+
     # static-analysis rows (scripts/graftlint.py): the latest run's
     # new-vs-baselined split and rule mix — keys present only when the
     # stream carries lint_run rows (logs/graftlint/telemetry.jsonl)
@@ -267,6 +291,18 @@ def print_summary(summary: dict, label: str = "") -> None:
         print(f"    cache hits:  "
               + (f"{hit * 100:.1f}%" if hit is not None else "n/a")
               + f"  tiers: {tiers or 'n/a'}")
+    if summary.get("march_rows"):
+        eff = summary.get("march_sweep_efficiency")
+        occ = summary.get("march_coarse_occ")
+        over = summary.get("march_overflow_max")
+        print(f"  march:         {summary['march_rows']} row(s)  "
+              f"modes: {','.join(summary['march_modes'])}")
+        print(f"    sweep eff:   "
+              + (f"{eff * 100:.1f}%" if eff is not None else "n/a")
+              + "  coarse occ: "
+              + (f"{occ * 100:.1f}%" if occ is not None else "n/a")
+              + "  overflow max: "
+              + (f"{over * 100:.1f}%" if over is not None else "n/a"))
     if summary.get("lint_runs"):
         rule_mix = " ".join(
             f"{k}:{v}"
@@ -308,6 +344,16 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     a, b = base.get("lint_new"), cand.get("lint_new")
     if a is not None and b is not None and b > a:
         flags.append(f"graftlint new findings grew {a} -> {b}")
+    # sweep efficiency DROPPING means the coarse DDA is admitting more
+    # dead candidate rows into the sort per useful sample — a traversal
+    # regression even when step time hasn't moved yet
+    a = base.get("march_sweep_efficiency")
+    b = cand.get("march_sweep_efficiency")
+    if a and b is not None and (a - b) / a * 100.0 > gate_pct:
+        flags.append(
+            f"march sweep efficiency dropped {a * 100:.1f}% -> "
+            f"{b * 100:.1f}%"
+        )
     return flags
 
 

@@ -172,6 +172,119 @@ def test_fused_bf16_stream_parity(setup):
         )
 
 
+def test_fused_masked_forward_matches_unmasked_times_valid(setup):
+    """The masked kernel's contract: rows with valid == 0 return raw 0,
+    rows with valid == 1 are bit-compatible with the unmasked kernel —
+    i.e. masked(x) == unmasked(x) · valid."""
+    cfg, network, params, fused, pts, dirs = setup
+    assert getattr(fused, "supports_valid_mask", False)
+    rng = np.random.default_rng(11)
+    valid = jnp.asarray(rng.random(pts.shape[:2]) < 0.6, jnp.float32)
+    ref = np.asarray(fused(params, pts, dirs, "fine"))
+    got = np.asarray(fused(params, pts, dirs, "fine", valid=valid))
+    np.testing.assert_allclose(
+        got, ref * np.asarray(valid)[..., None], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fused_masked_gradients_match_masked_flax(setup):
+    """d(loss)/d(params) through the masked custom_vjp must equal the Flax
+    backward of the same masked loss — invalid rows contribute exactly
+    zero cotangent, valid rows the full chain."""
+    cfg, network, params, fused, pts, dirs = setup
+    rng = np.random.default_rng(12)
+    valid = jnp.asarray(rng.random(pts.shape[:2]) < 0.6, jnp.float32)
+
+    def loss_ref(p):
+        raw = network.apply(p, pts, dirs, model="fine")
+        return jnp.mean((raw * valid[..., None]) ** 2)
+
+    def loss_fused(p):
+        return jnp.mean(fused(p, pts, dirs, "fine", valid=valid) ** 2)
+
+    l_ref, g_ref = jax.value_and_grad(loss_ref)(params)
+    l_fus, g_fus = jax.value_and_grad(loss_fused)(params)
+    np.testing.assert_allclose(float(l_fus), float(l_ref), rtol=1e-6)
+    flat_fus = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(g_fus)
+    )
+    for k, v_ref in jax.tree_util.tree_leaves_with_path(g_ref):
+        ks = jax.tree_util.keystr(k)
+        np.testing.assert_allclose(
+            np.asarray(flat_fus[ks]), np.asarray(v_ref),
+            rtol=2e-4, atol=1e-5, err_msg=ks,
+        )
+
+
+def test_fused_masked_all_invalid_is_zero_everywhere(setup):
+    """An all-invalid batch (the pl.when-skipped tile path) must produce
+    zero output AND zero parameter gradients — not NaNs from a skipped
+    matmul chain reading uninitialized accumulators."""
+    cfg, network, params, fused, pts, dirs = setup
+    valid = jnp.zeros(pts.shape[:2], jnp.float32)
+    out = fused(params, pts, dirs, "fine", valid=valid)
+    assert float(jnp.abs(out).max()) == 0.0
+
+    g = jax.grad(
+        lambda p: jnp.sum(fused(p, pts, dirs, "fine", valid=valid) ** 2)
+    )(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert float(jnp.abs(leaf).max()) == 0.0
+
+
+def test_fused_masked_packed_march_parity(setup):
+    """The production seam: march_rays_packed streams its per-sample
+    occupancy bit into the kernel when the apply advertises
+    supports_valid_mask. The composited images must equal the plain-apply
+    packed march (which multiplies weights by the same mask outside)."""
+    import dataclasses
+
+    from nerf_replication_tpu.renderer.accelerated import MarchOptions
+    from nerf_replication_tpu.renderer.packed_march import march_rays_packed
+
+    cfg, network, params, fused, pts, dirs = setup
+    rng = np.random.default_rng(13)
+    n = 32
+    rays = jnp.asarray(
+        np.concatenate(
+            [np.tile([0.0, 0.0, 4.0], (n, 1)),
+             np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3))], -1
+        ).astype(np.float32)
+    )
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    grid = jnp.asarray(grid)
+    bbox = jnp.asarray(cfg.train_dataset.scene_bbox, jnp.float32)
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+    )
+
+    def plain(p3, v, model):
+        return network.apply(params, p3, v, model=model)
+
+    def fused_apply(p3, v, model, valid=None):
+        if valid is not None:
+            return fused(params, p3, v, model, valid=valid)
+        return fused(params, p3, v, model)
+
+    fused_apply.supports_valid_mask = True
+
+    for opt in (options,
+                dataclasses.replace(options, coarse_block=4, coarse_cap=3)):
+        a = march_rays_packed(
+            plain, rays, 2.0, 6.0, grid, bbox, opt, cap_avg=16
+        )
+        b = march_rays_packed(
+            fused_apply, rays, 2.0, 6.0, grid, bbox, opt, cap_avg=16
+        )
+        for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+            np.testing.assert_allclose(
+                np.asarray(b[k]), np.asarray(a[k]), rtol=2e-4, atol=2e-5,
+                err_msg=f"{k} coarse_block={opt.coarse_block}",
+            )
+
+
 def test_fused_apply_refuses_unsupported_families(setup):
     cfg, network, params, fused, pts, dirs = setup
     root = cfg.train_dataset.data_root

@@ -19,9 +19,15 @@ from nerf_replication_tpu.renderer.accelerated import (
     march_rays_accelerated,
 )
 from nerf_replication_tpu.renderer.occupancy import (
+    PYRAMID_FACTORS,
+    PYRAMID_VERSION,
     bake_occupancy_grid,
+    build_pyramid,
+    coarse_from_grid,
     load_occupancy_grid,
+    load_occupancy_pyramid,
     occupancy_stats,
+    pyramid_stats,
     save_occupancy_grid,
     voxel_sample_points,
     world_to_voxel,
@@ -109,6 +115,75 @@ def test_bake_and_roundtrip(tmp_path, setup):
     loaded, bbox = load_occupancy_grid(path)
     np.testing.assert_array_equal(loaded, grid)
     assert bbox.shape == (2, 3)
+
+
+def test_pyramid_roundtrip_and_legacy_flat_upgrade(tmp_path, setup):
+    """The versioned artifact round-trips its baked coarse levels; a legacy
+    flat-grid .npz (no ``pyramid_version`` key) upgrades transparently by
+    rebuilding the pyramid from the fine grid, and the flat reader keeps
+    working against both layouts."""
+    cfg, network, params = setup
+    grid = bake_occupancy_grid(params, network, cfg)
+    bbox = cfg.train_dataset.scene_bbox
+
+    path = str(tmp_path / "pyr.npz")
+    save_occupancy_grid(path, grid, bbox, 0.5)
+    with np.load(path) as z:
+        assert int(z["pyramid_version"]) == PYRAMID_VERSION
+        assert tuple(np.asarray(z["pyramid_factors"])) == PYRAMID_FACTORS
+    levels, bbox_l = load_occupancy_pyramid(path)
+    assert len(levels) == 1 + len(PYRAMID_FACTORS)
+    np.testing.assert_array_equal(levels[0], grid)
+    assert bbox_l.shape == (2, 3)
+    for f, lv in zip(PYRAMID_FACTORS, levels[1:]):
+        assert lv.shape == tuple(-(-s // f) for s in grid.shape)
+        assert lv.dtype == np.bool_
+        # baked coarse levels are bit-identical to the in-graph derivation
+        # the march executables run (coarse_from_grid) — the parity
+        # contract between artifact and live-NGP traversal
+        np.testing.assert_array_equal(
+            np.asarray(coarse_from_grid(jnp.asarray(grid), f)), lv
+        )
+
+    # legacy layout: the exact keys pre-pyramid save_occupancy_grid wrote
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez_compressed(
+        legacy, grid=grid, bbox=np.asarray(bbox, np.float32),
+        threshold=np.float32(0.5),
+    )
+    levels2, _ = load_occupancy_pyramid(legacy)
+    for a, b in zip(levels2, levels):
+        np.testing.assert_array_equal(a, b)
+    g_flat, _ = load_occupancy_grid(legacy)
+    np.testing.assert_array_equal(g_flat, grid)
+    g_flat2, _ = load_occupancy_grid(path)
+    np.testing.assert_array_equal(g_flat2, grid)
+
+    stats = pyramid_stats(levels)
+    # any-reduce can only grow the occupied fraction per level
+    assert (
+        stats["level_0_occ"]
+        <= stats["level_1_occ"]
+        <= stats["level_2_occ"]
+    )
+
+
+def test_pyramid_superset_holds_for_nondivisible_resolution():
+    """R=9 doesn't divide either factor: the False-pad must land past the
+    +bbox face, never stealing a real voxel's parent — every fine-occupied
+    voxel's parent cell stays occupied, and no parent is occupied without
+    at least one occupied child."""
+    rng = np.random.default_rng(0)
+    g = rng.random((9, 9, 9)) < 0.2
+    levels = build_pyramid(g)
+    assert levels[1].shape == (5, 5, 5) and levels[2].shape == (3, 3, 3)
+    occ = np.argwhere(g)
+    for f, lv in zip(PYRAMID_FACTORS, levels[1:]):
+        parents = occ // f
+        assert lv[parents[:, 0], parents[:, 1], parents[:, 2]].all()
+        # tightness: occupied parents are exactly the occupied children's
+        n_parents = len(np.unique(parents, axis=0))
+        assert int(lv.sum()) == n_parents
 
 
 def test_bake_matches_direct_density_query(setup):

@@ -171,6 +171,111 @@ def test_packed_pad_rays_and_fully_dropped_segments(setup):
     assert trunc[fully_dropped].all()
 
 
+def test_hierarchical_march_matches_flat_when_no_block_clips(setup):
+    """coarse_block > 0 inserts the coarse-DDA stage; because the pyramid
+    level is an any-reduce (strict superset) of the fine grid, admitting
+    only the positions with occupied parents must composite IDENTICALLY to
+    the flat packed march whenever K_c covers every occupied block — while
+    genuinely shrinking the candidate stream entering the global sort."""
+    import dataclasses
+
+    cfg, apply_fn, rays, grid, bbox = setup
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+    )
+    # S=16, r=4 ⇒ S_c=4 blocks; the box [4:12]³ spans at most 3 of them
+    # for any ray in this batch, so K_c=3 never clips
+    hier_opt = dataclasses.replace(options, coarse_block=4, coarse_cap=3)
+    flat = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, options, cap_avg=16
+    )
+    hier = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox, hier_opt, cap_avg=16
+    )
+    assert float(hier["overflow_frac"]) == 0.0
+    assert not bool(hier["truncated"].any())
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        np.testing.assert_allclose(
+            np.asarray(hier[k]), np.asarray(flat[k]), rtol=2e-4, atol=2e-5,
+            err_msg=k,
+        )
+    # the same occupied samples composite on both sides...
+    assert float(hier["march_samples_out"]) == float(flat["march_samples_out"])
+    # ...from a smaller candidate stream (K_c·r = 12 < S = 16)
+    assert float(hier["march_candidates"]) < float(flat["march_candidates"])
+    assert 0.0 < float(hier["march_coarse_occ"]) < 1.0
+    assert float(flat["march_coarse_occ"]) == 1.0
+
+
+def test_hierarchical_coarse_clip_reports_truncation(setup):
+    """Satellite fix: a ray whose occupied coarse blocks were CLIPPED by
+    K_c lost samples the stream never saw — the global-overflow test alone
+    cannot observe that, so ``truncated`` must still fire. Unclipped rays
+    must be untouched."""
+    import dataclasses
+
+    cfg, apply_fn, rays, grid, bbox = setup
+    options = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+    )
+    full = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox,
+        dataclasses.replace(options, coarse_block=4, coarse_cap=3),
+        cap_avg=16,
+    )
+    clipped = march_rays_packed(
+        apply_fn, rays, 2.0, 6.0, grid, bbox,
+        dataclasses.replace(options, coarse_block=4, coarse_cap=1),
+        cap_avg=16,
+    )
+    # the stream itself never overflowed: every reported truncation below
+    # comes from the coarse clip, not the global cap
+    assert float(clipped["overflow_frac"]) == 0.0
+    trunc = np.asarray(clipped["truncated"])
+    assert trunc.any()
+    # unflagged rays composite exactly like the uncapped run (either they
+    # fit in one block, or their clipped tail was already ERT-dead)
+    ok = ~trunc
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        np.testing.assert_allclose(
+            np.asarray(clipped[k])[ok], np.asarray(full[k])[ok],
+            rtol=2e-4, atol=2e-5, err_msg=k,
+        )
+    # flagged rays lost tail samples: they can only composite LESS opacity
+    acc_c = np.asarray(clipped["acc_map_f"])
+    acc_f = np.asarray(full["acc_map_f"])
+    assert (acc_c[trunc] <= acc_f[trunc] + 1e-5).all()
+
+
+def test_hierarchical_march_is_differentiable(setup):
+    """Grads must flow through the coarse-DDA stage (block selection is a
+    constant gather; only the candidate set changes) and stay finite."""
+    import dataclasses
+
+    cfg, apply_fn, rays, grid, bbox = setup
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    options = dataclasses.replace(
+        MarchOptions(
+            step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64
+        ),
+        coarse_block=4, coarse_cap=3,
+    )
+    gt = jnp.ones((rays.shape[0], 3)) * 0.5
+
+    def loss_fn(p):
+        out = march_rays_packed(
+            lambda pts, d, m: network.apply(p, pts, d, model=m),
+            rays, 2.0, 6.0, grid, bbox, options, cap_avg=8,
+        )
+        return jnp.mean((out["rgb_map_f"] - gt) ** 2)
+
+    g = jax.grad(loss_fn)(params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert leaves and all(bool(jnp.isfinite(leaf).all()) for leaf in leaves)
+    assert sum(float(jnp.abs(leaf).sum()) for leaf in leaves) > 0.0
+
+
 def test_packed_march_is_differentiable(setup):
     """Grads must flow through the packed stream (sort indices are
     constant; gather/cumsum/segment_sum all differentiate) and be finite."""

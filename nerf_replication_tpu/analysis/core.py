@@ -40,7 +40,7 @@ from dataclasses import dataclass, field
 # rule id -> one-line description (registry filled by rules.py import)
 RULES: dict[str, "Rule"] = {}
 
-# R1..R7 short names used in findings, suppressions, and the baseline
+# R1..R8 short names used in findings, suppressions, and the baseline
 RULE_IDS = (
     "host-sync",    # R1
     "retrace",      # R2
@@ -49,6 +49,7 @@ RULE_IDS = (
     "side-effect",  # R5
     "config-key",   # R6
     "aot",          # R7
+    "swallow",      # R8
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ok(?:\(([^)]*)\))?")

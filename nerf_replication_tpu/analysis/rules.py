@@ -1,4 +1,4 @@
-"""graftlint rules R1-R7 — JAX hazards tuned to this codebase's idioms.
+"""graftlint rules R1-R8 — JAX hazards tuned to this codebase's idioms.
 
 Each rule encodes one of the failure modes PR 1's telemetry made observable
 at runtime (obs/: CompileTracker retraces, dispatch-vs-block stalls, HBM
@@ -24,6 +24,9 @@ rule id                hazard
 ``aot``         (R7)   library-code ``jax.jit`` not routed through the AOT
                        registry (compile/registry.py) — first caller pays
                        the compile inline at dispatch time
+``swallow``     (R8)   ``except Exception`` / bare ``except`` in library
+                       code that neither re-raises nor emits telemetry —
+                       the failure disappears from every record
 =====================  ==========================================================
 """
 
@@ -1040,3 +1043,112 @@ class AotRule(Rule):
         if info is None:
             return False
         return any(seg in routed_names for seg in info.qualname.split("."))
+
+
+# ---------------------------------------------------------------------------
+# R8: swallowed exceptions
+# ---------------------------------------------------------------------------
+
+#: call names (terminal segment of the callee chain) that count as "the
+#: failure left a trace" — telemetry rows, log lines, or collected errors.
+_SWALLOW_SIGNALS = frozenset(
+    {
+        "emit",
+        "warn",
+        "warning",
+        "warnings",
+        "log",
+        "print",
+        "report",
+        "record",
+        "error",
+        "exception",
+        "debug",
+        "info",
+        "fail",
+        "fault_point",
+    }
+)
+
+
+@register
+class SwallowRule(Rule):
+    """R8: broad except handlers in library code must re-raise or emit.
+
+    A ``try``/``except Exception`` (or bare ``except``) whose handler body
+    neither contains a ``raise`` nor calls anything that records the failure
+    (``report``/``emit``/``warn``/``log``/...) makes the error vanish: no
+    telemetry row, no log line, no propagation.  In a fault-injected run
+    these are exactly the sites where an injected IOError disappears and
+    the chaos harness cannot attribute the recovery.
+
+    Narrow handlers (``except OSError``, ``except (KeyError, ValueError)``)
+    are out of scope — catching a specific exception is a statement of
+    intent; catching *everything* silently is not.
+    """
+
+    rule_id = "swallow"
+    doc = (
+        "broad `except Exception`/bare `except` in library code that "
+        "neither re-raises nor emits telemetry — the failure vanishes; "
+        "re-raise, call resil.report()/emitter.emit(), or suppress with "
+        "a reason"
+    )
+
+    LIB_PREFIX = "nerf_replication_tpu/"
+    #: the lint engine itself parses/walks arbitrary source and recovers
+    #: from malformed modules by design; its handlers are not failure sinks.
+    EXEMPT_PREFIXES = ("nerf_replication_tpu/analysis/",)
+
+    _BROAD = ("Exception", "BaseException")
+
+    def check(self, module: ModuleContext) -> list[Finding]:
+        rel = module.rel_path.replace(os.sep, "/")
+        if not rel.startswith(self.LIB_PREFIX):
+            return []
+        if any(rel.startswith(p) for p in self.EXEMPT_PREFIXES):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not self._is_broad(handler):
+                    continue
+                if self._leaves_trace(handler):
+                    continue
+                f = module.finding(
+                    self.rule_id,
+                    handler,
+                    "broad except swallows the failure: handler neither "
+                    "re-raises nor emits telemetry/logging — add "
+                    "resil.report(...)/raise, narrow the exception type, "
+                    "or suppress with `# graftlint: ok(swallow: reason)`",
+                )
+                if f is not None:
+                    findings.append(f)
+        return findings
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:  # bare `except:`
+            return True
+        types = (
+            handler.type.elts
+            if isinstance(handler.type, ast.Tuple)
+            else [handler.type]
+        )
+        for t in types:
+            chain = _attr_chain(t)
+            if chain and chain[-1] in self._BROAD:
+                return True
+        return False
+
+    def _leaves_trace(self, handler: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(handler):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func)
+                if chain and chain[-1] in _SWALLOW_SIGNALS:
+                    return True
+        return False

@@ -32,6 +32,16 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import sys
+
+from ..obs.emit import get_emitter
+from ..resil import (
+    fault_point,
+    report,
+    verify_checksum,
+    with_retry,
+    write_checksum,
+)
 
 
 def default_artifact_dir() -> str:
@@ -62,6 +72,7 @@ def artifact_key(name: str, abstract_args, extra: str = "") -> str:
     backend = "unknown"
     try:
         backend = jax.default_backend()
+    # graftlint: ok(swallow: backend probe; key falls back to 'unknown')
     except Exception:
         pass
     payload = "\x1f".join(
@@ -74,41 +85,76 @@ def artifact_path(cache_dir: str, key: str) -> str:
     return os.path.join(cache_dir, f"{key}.aot")
 
 
-def save_artifact(cache_dir: str, key: str, compiled) -> bool:
-    """Persist one compiled executable; False when it cannot serialize
-    (unpicklable treedefs, backend without executable serialization)."""
+def _skip(name: str, key: str, reason: str) -> None:
+    """A serialization skip is a visible event, not a silent degrade: one
+    ``compile`` row (phase "aot") + a one-line warning."""
+    get_emitter().emit(
+        "compile", name=name or key, n_compiles=0, wall_s=0.0,
+        phase="aot", skipped_reason=reason[:200],
+    )
+    print(
+        f"warning: aot artifact skipped for {name or key}: {reason[:120]}",
+        file=sys.stderr,
+    )
+
+
+def save_artifact(cache_dir: str, key: str, compiled, name: str = "") -> bool:
+    """Persist one compiled executable (+ its checksum sidecar); False
+    when it cannot serialize (unpicklable treedefs, backend without
+    executable serialization) or cannot be written."""
     try:
         from jax.experimental import serialize_executable
 
         payload, in_tree, out_tree = serialize_executable.serialize(compiled)
         blob = pickle.dumps((payload, in_tree, out_tree))
-    except Exception:
+    # graftlint: ok(swallow: _skip emits the compile row with skipped_reason)
+    except Exception as exc:
+        _skip(name, key, f"unserializable: {type(exc).__name__}: {exc}")
         return False
     try:
         os.makedirs(cache_dir, exist_ok=True)
         path = artifact_path(cache_dir, key)
+        fault_point("artifact.save", path=path)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, path)
+        write_checksum(path)
         return True
-    except OSError:
-        return False  # read-only FS etc.: degrade to no artifact
+    except OSError as exc:  # read-only FS etc.: degrade to no artifact
+        _skip(name, key, f"io: {exc}")
+        return False
 
 
 def load_artifact(cache_dir: str, key: str):
     """Deserialize one executable, or None (missing/stale/torn artifact —
-    every failure mode degrades to the normal compile path)."""
+    every failure mode degrades to the normal compile path). Transient
+    read errors retry with backoff; a checksum mismatch (truncated .aot)
+    is reported and degrades to the lazy build, never loads garbage."""
     path = artifact_path(cache_dir, key)
     if not os.path.exists(path):
+        return None
+
+    def _read():
+        fault_point("artifact.load", path=path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    try:
+        blob = with_retry(_read, point="artifact.load")
+    except OSError:
+        return None  # retries exhausted: the normal compile path runs
+    if verify_checksum(path) is False:
+        report("artifact.load", "checksum", path=path)
         return None
     try:
         from jax.experimental import serialize_executable
 
-        with open(path, "rb") as f:
-            payload, in_tree, out_tree = pickle.loads(f.read())
+        payload, in_tree, out_tree = pickle.loads(blob)
         return serialize_executable.deserialize_and_load(
             payload, in_tree, out_tree
         )
-    except Exception:
+    except Exception as exc:
+        report("artifact.load", "torn", path=path,
+               detail=f"{type(exc).__name__}")
         return None

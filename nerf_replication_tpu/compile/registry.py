@@ -152,7 +152,8 @@ class AOTRegistry:
                 return
         try:
             compiled = entry.jitted.lower(*entry.abstract_args).compile()
-        except Exception as exc:  # degrade to the caller's lazy build
+        # graftlint: ok(swallow: degrades to lazy build; entry.error reaches the compile summary)
+        except Exception as exc:
             entry.error = f"{type(exc).__name__}: {exc}"
             return
         entry.wall_s = time.perf_counter() - t0
@@ -162,7 +163,7 @@ class AOTRegistry:
         if self.tracker is not None:
             self.tracker.note_compile(entry.name, entry.wall_s)
         if entry.serialize and self.artifacts:
-            save_artifact(self.cache_dir, key, compiled)
+            save_artifact(self.cache_dir, key, compiled, name=entry.name)
 
     def compile_all(self, wait: bool = True,
                     max_workers: int | None = None) -> None:

@@ -178,6 +178,23 @@ def default_cfg() -> ConfigNode:
         }
     )
 
+    # resilience knobs (nerf_replication_tpu/resil, docs/robustness.md):
+    # bounded-backoff retry on resumable load paths, the finite-loss guard
+    # + divergence rollback budget, SIGTERM preemption flush, and the
+    # serve dispatch circuit breaker
+    cfg.resil = ConfigNode(
+        {
+            "retry_attempts": 3,       # tries per load before giving up
+            "retry_base_s": 0.05,      # first backoff; doubles per retry
+            "retry_max_s": 2.0,        # backoff ceiling
+            "finite_guard": True,      # raise on non-finite host loss
+            "max_rollbacks": 2,        # divergence rollbacks before abort
+            "preempt_sigterm": True,   # SIGTERM -> checkpoint flush + exit
+            "breaker_threshold": 5,    # consecutive dispatch failures to open
+            "breaker_cooldown_s": 5.0,  # open -> half_open probe delay
+        }
+    )
+
     return cfg
 
 
@@ -219,6 +236,7 @@ def _git_describe(args_: Sequence[str]) -> str:
             ["git", *args_], capture_output=True, text=True, timeout=5
         )
         return out.stdout.strip()
+    # graftlint: ok(swallow: best-effort git metadata; empty value lands in run_meta)
     except Exception:
         return ""
 

@@ -96,6 +96,7 @@ def _jsonable(value):
             return value.item()
         if isinstance(value, np.ndarray):
             return value.tolist()
+    # graftlint: ok(swallow: telemetry layer itself; str() fallback below is the record)
     except Exception:
         pass
     return str(value)
@@ -113,6 +114,7 @@ def config_hash(cfg) -> str:
     """Stable short hash of the merged config (run identity for diffs)."""
     try:
         dump = cfg.dump()
+    # graftlint: ok(swallow: repr fallback is still hashed into the run identity)
     except Exception:
         dump = repr(cfg)
     return hashlib.sha256(dump.encode()).hexdigest()[:12]

@@ -74,9 +74,12 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"name": (str,), "n_compiles": _NUM, "wall_s": _NUM},
         # cap_old/cap_new: packed-eval stream cap escalation (train/ngp.py
         # render_image) — the rebuild rides a compile row so
-        # `tlm_report --diff` flags an escalating run as a regression
+        # `tlm_report --diff` flags an escalating run as a regression.
+        # phase/skipped_reason: AOT pipeline markers (compile/artifacts.py)
+        # — a serialization skip is visible, not silent
         {"call_index": _NUM, "steady_p50_s": _OPT_NUM, "step": _OPT_NUM,
-         "cap_old": _NUM, "cap_new": _NUM},
+         "cap_old": _NUM, "cap_new": _NUM,
+         "phase": (str,), "skipped_reason": (str,)},
     ),
     "memory": (
         {"devices": (list,)},
@@ -115,6 +118,31 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"mode": (str,), "surface": (str,), "coarse_occ": _NUM,
          "fine_occ": _NUM, "overflow_frac": _NUM, "truncated": _NUM,
          "n_rays": _NUM, "step": _NUM},
+    ),
+    # -- resilience rows (nerf_replication_tpu/resil) ------------------------
+    # one per fault at a named fault point: injected (FaultPlan chaos) or
+    # detected in the wild (checksum mismatch, torn dir, worker crash).
+    # `fault` is the fault kind: io_error | truncate | latency | nan_loss |
+    # kill | checksum | torn | crash
+    "fault": (
+        {"point": (str,), "fault": (str,)},
+        {"path": (str,), "delay_s": _NUM, "hit": _NUM,
+         "injected": (bool, int), "step": _NUM, "detail": (str,)},
+    ),
+    # one per retry decision at a load path (resil/retry.py): status is
+    # retry (backing off), ok (recovered after >=1 failure), or exhausted
+    # (gave up — the unrecovered-fault count tlm_report --diff gates on)
+    "retry": (
+        {"point": (str,), "attempt": _NUM, "status": (str,)},
+        {"error": (str,), "backoff_s": _NUM, "wall_s": _NUM},
+    ),
+    # one per circuit-breaker state transition (resil/breaker.py): the
+    # serve engine degrading through shed tiers / fast-failing under
+    # repeated dispatch failures
+    "breaker": (
+        {"state": (str,)},
+        {"point": (str,), "failures": _NUM, "consecutive": _NUM,
+         "tier": (str,), "retry_after_s": _NUM},
     ),
     # -- static analysis (nerf_replication_tpu/analysis) ---------------------
     # one per scripts/graftlint.py run: finding counts split new-vs-baseline

@@ -19,6 +19,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resil import fault_point, report, verify_checksum, with_retry
+from ..resil import write_checksum as _write_checksum
+
 SUBSAMPLES = (2, 2, 2)  # occupancy_grid.py:28
 
 
@@ -145,6 +148,7 @@ def save_occupancy_grid(path: str, grid: np.ndarray, bbox, threshold: float) -> 
         pyramid_factors=np.asarray(PYRAMID_FACTORS, np.int32),
         **{f"level_{i}": lv for i, lv in enumerate(levels[1:], start=1)},
     )
+    _write_checksum(path)
     return path
 
 
@@ -161,24 +165,46 @@ def load_occupancy_pyramid(path: str):
     transparently: the pyramid is rebuilt on load from the fine grid. A
     version/factor mismatch (artifact baked by a different pyramid layout)
     also rebuilds rather than trusting stale coarse levels — the fine grid
-    is always the source of truth."""
-    with np.load(path) as z:
-        grid = np.asarray(z["grid"], bool)
-        bbox = np.asarray(z["bbox"], np.float32)
-        baked_ok = (
-            "pyramid_version" in z
-            and int(z["pyramid_version"]) == PYRAMID_VERSION
-            and tuple(np.asarray(z["pyramid_factors"]).tolist())
-            == PYRAMID_FACTORS
-        )
-        if baked_ok:
-            levels = [grid] + [
-                np.asarray(z[f"level_{i}"], bool)
-                for i in range(1, len(PYRAMID_FACTORS) + 1)
-            ]
-        else:
-            levels = build_pyramid(grid)
-    return levels, bbox
+    is always the source of truth.
+
+    Resilience: transient read errors retry with backoff; a checksum
+    mismatch or an unparseable archive (truncated ``.npz``) raises
+    ``OSError`` after a detected-fault row, so callers rebuild or fall
+    back to the chunked path instead of consuming garbage."""
+    if verify_checksum(path) is False:
+        report("occupancy.load", "checksum", path=path)
+        raise OSError(f"corrupt occupancy artifact (checksum mismatch): {path}")
+
+    def _read():
+        fault_point("occupancy.load", path=path)
+        with np.load(path) as z:
+            grid = np.asarray(z["grid"], bool)
+            bbox = np.asarray(z["bbox"], np.float32)
+            baked_ok = (
+                "pyramid_version" in z
+                and int(z["pyramid_version"]) == PYRAMID_VERSION
+                and tuple(np.asarray(z["pyramid_factors"]).tolist())
+                == PYRAMID_FACTORS
+            )
+            if baked_ok:
+                levels = [grid] + [
+                    np.asarray(z[f"level_{i}"], bool)
+                    for i in range(1, len(PYRAMID_FACTORS) + 1)
+                ]
+            else:
+                levels = build_pyramid(grid)
+        return levels, bbox
+
+    try:
+        return with_retry(_read, point="occupancy.load")
+    except OSError:
+        raise
+    except Exception as exc:  # torn zip member / bad header / missing key
+        report("occupancy.load", "torn", path=path,
+               detail=f"{type(exc).__name__}")
+        raise OSError(
+            f"corrupt occupancy artifact: {path} ({type(exc).__name__})"
+        ) from exc
 
 
 def pyramid_stats(levels: list[np.ndarray]) -> dict:

@@ -423,7 +423,13 @@ class Renderer:
         if not os.path.exists(grid_path):
             print(f"Occupancy grid file not found: {grid_path}, run in slow mode.")
             return False
-        levels, bbox = load_occupancy_pyramid(grid_path)
+        try:
+            levels, bbox = load_occupancy_pyramid(grid_path)
+        except OSError as exc:
+            # truncated/corrupt artifact: the chunked (slow-mode) path is
+            # always correct — never march a garbage grid
+            print(f"Occupancy grid unusable ({exc}), run in slow mode.")
+            return False
         self.occupancy_grid = jnp.asarray(levels[0])
         self.grid_bbox = jnp.asarray(bbox)
         return True

@@ -1,0 +1,59 @@
+"""resil/: fault injection, retry, checksums, circuit breaking, and
+training preemption/rollback — failure as a first-class, injectable,
+telemetry-visible input (docs/robustness.md).
+
+Import surface is deliberately flat: call sites touch one module, and
+nothing here imports jax — every primitive is host-side, so the chaos
+machinery itself can never cause a retrace.
+"""
+
+from .breaker import BreakerOpenError, CircuitBreaker
+from .checksum import (
+    SIDECAR_SUFFIX,
+    file_sha256,
+    verify_checksum,
+    write_checksum,
+)
+from .faults import (
+    FAULT_KINDS,
+    FAULT_POINTS,
+    FaultPlan,
+    FaultSpec,
+    SimulatedKill,
+    active,
+    fault_point,
+    injecting,
+    install,
+    report,
+    truncate_file,
+    uninstall,
+)
+from .guard import DivergenceError, PreemptionGuard, check_finite
+from .retry import RETRY_ATTEMPTS, retry_params, with_retry
+
+__all__ = [
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "DivergenceError",
+    "FAULT_KINDS",
+    "FAULT_POINTS",
+    "FaultPlan",
+    "FaultSpec",
+    "PreemptionGuard",
+    "RETRY_ATTEMPTS",
+    "SIDECAR_SUFFIX",
+    "SimulatedKill",
+    "active",
+    "check_finite",
+    "fault_point",
+    "file_sha256",
+    "injecting",
+    "install",
+    "report",
+    "retry_params",
+    "truncate_file",
+    "uninstall",
+    "verify_checksum",
+    "with_retry",
+    "write_checksum",
+]

@@ -1,0 +1,57 @@
+"""Sidecar checksums for on-disk artifacts (.aot executables, occupancy
+.npz): a truncated or bit-flipped artifact must degrade to lazy-jit /
+rebuild, never load garbage into a serving replica.
+
+A ``<file>.sha256`` sidecar carries the hex digest; the sidecar is
+written atomically AFTER the artifact (tmp + ``os.replace``), so a crash
+between the two leaves an artifact without a sidecar — which verifies as
+"unknown" (None), not as valid. Verification is opt-out cheap: one
+streamed read at load time, host-only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+SIDECAR_SUFFIX = ".sha256"
+
+
+def file_sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_checksum(path: str) -> str:
+    """Write ``path``'s digest sidecar atomically; the digest."""
+    digest = file_sha256(path)
+    sidecar = path + SIDECAR_SUFFIX
+    tmp = f"{sidecar}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(digest + "\n")
+    os.replace(tmp, sidecar)
+    return digest
+
+
+def verify_checksum(path: str) -> bool | None:
+    """True = digest matches, False = mismatch (torn/corrupt artifact),
+    None = unknown (no sidecar, or either file unreadable — the caller's
+    ordinary missing-file path handles it)."""
+    sidecar = path + SIDECAR_SUFFIX
+    try:
+        with open(sidecar, encoding="utf-8") as fh:
+            expected = fh.read().strip()
+    except OSError:
+        return None
+    if not expected:
+        return None
+    try:
+        return file_sha256(path) == expected
+    except OSError:
+        return None

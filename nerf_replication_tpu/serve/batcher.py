@@ -32,7 +32,8 @@ import numpy as np
 
 from ..obs import get_emitter
 from ..renderer.gate import check_baked_bounds
-from .policy import TIER_IMPL, DegradationPolicy
+from ..resil import BreakerOpenError, CircuitBreaker, fault_point, report
+from .policy import TIER_IMPL, TIER_NAMES, DegradationPolicy
 
 
 class ServeTimeoutError(TimeoutError):
@@ -85,13 +86,15 @@ class MicroBatcher:
     """Deadline-coalescing request queue in front of a RenderEngine."""
 
     def __init__(self, engine, policy: DegradationPolicy | None = None,
-                 clock=time.monotonic, start: bool = True):
+                 clock=time.monotonic, start: bool = True,
+                 breaker: CircuitBreaker | None = None):
         self.engine = engine
         self.options = engine.options
         self.policy = policy or DegradationPolicy(
             thresholds=engine.options.shed_queue_depths
         )
         self.clock = clock
+        self.breaker = breaker or CircuitBreaker(clock=clock)
         self._queue: deque[_Pending] = deque()
         self._cond = threading.Condition()
         self._stop = False
@@ -100,12 +103,64 @@ class MicroBatcher:
         self.n_shed = 0
         self.n_timeouts = 0
         self.n_completed = 0
+        self.n_dispatch_errors = 0
+        self.worker_restarts = 0
+        self._inflight: list[_Pending] = []
+        self._worker_dead = False
+        self._last_dispatch_t: float | None = None
         self._thread: threading.Thread | None = None
+        self._started = start
         if start:
-            self._thread = threading.Thread(
-                target=self._worker, name="serve-batcher", daemon=True
-            )
-            self._thread.start()
+            self._spawn_worker()
+
+    def _spawn_worker(self) -> None:
+        self._worker_dead = False
+        self._thread = threading.Thread(
+            target=self._worker_main, name="serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def _worker_main(self) -> None:
+        try:
+            self._worker()
+        except BaseException:
+            # flag FIRST: a client unblocked by _fail_inflight may resubmit
+            # before this thread finishes unwinding (is_alive() still True)
+            self._worker_dead = True
+            # the worker is dying (kill fault, unexpected error): fail the
+            # in-flight batch on the way down — like a real process death
+            # severing client connections — so callers get an immediate
+            # error instead of blocking out their full request timeout.
+            # Deliberately re-raised: recovery is the watchdog's job.
+            self._fail_inflight()
+            raise
+
+    def ensure_worker(self) -> bool:
+        """Watchdog: restart a dead worker thread (a crash that escaped
+        the batch-level handler — e.g. a kill fault) so the queue keeps
+        draining. Cheap (one is_alive check); runs on every submit and on
+        health probes. Returns whether a worker is running."""
+        if not self._started or self._stop:
+            return self._thread is not None and self._thread.is_alive()
+        t = self._thread
+        if t is None or not t.is_alive() or self._worker_dead:
+            self.worker_restarts += 1
+            report("serve.flush", "crash",
+                   detail=f"worker dead; restart #{self.worker_restarts}")
+            # belt-and-braces: normally the dying worker already failed
+            # its own in-flight batch (_worker_main)
+            self._fail_inflight()
+            self._spawn_worker()
+        return True
+
+    def _fail_inflight(self) -> None:
+        with self._cond:
+            stranded = [p for p in self._inflight if not p.future.done()]
+            self._inflight = []
+        for p in stranded:
+            p.future.set_exception(RuntimeError(
+                "serve worker crashed mid-batch; request lost"
+            ))
 
     # -- submission -----------------------------------------------------------
 
@@ -113,7 +168,13 @@ class MicroBatcher:
         """Enqueue a [N, C] ray request; returns a future.
 
         Bounds are validated HERE (BakedBoundsError raises to the caller
-        synchronously) so a bad request never occupies queue capacity."""
+        synchronously) so a bad request never occupies queue capacity.
+        With the circuit breaker open, submission fast-fails with
+        :class:`BreakerOpenError` (503 + Retry-After at the HTTP edge)
+        instead of queueing work onto a known-bad dispatch path."""
+        if not self.breaker.allow():
+            raise BreakerOpenError(self.breaker.retry_after_s())
+        self.ensure_worker()
         check_baked_bounds(self.engine.near, self.engine.far, near, far,
                            surface="serve micro-batcher")
         rays = np.asarray(rays, np.float32)
@@ -224,7 +285,14 @@ class MicroBatcher:
         if not live:
             return 0
 
+        # failure degrades through the SAME ladder load does: consecutive
+        # dispatch failures (pre-open breaker pressure) push the tier pick
+        # further down — cheaper executables, never a new compile
         tier = self.policy.tier_for(queue_depth)
+        steps = self.breaker.degrade_steps()
+        if steps:
+            i = TIER_NAMES.index(tier)
+            tier = TIER_NAMES[min(i + steps, len(TIER_NAMES) - 1)]
         family, stride = TIER_IMPL[tier]
         if tier != "full":
             self.n_shed += 1
@@ -247,13 +315,36 @@ class MicroBatcher:
         )
 
         t0 = self.clock()
+        # deliberately no try/finally around _inflight: a kill must LEAVE
+        # it populated so the watchdog can fail the stranded futures
+        with self._cond:
+            self._inflight = live
         try:
+            # chaos hook: the flush-level fault point (a kill here is a
+            # BaseException — it escapes this handler, dies with the
+            # worker thread, and the watchdog restarts it)
+            fault_point("serve.flush")
             out, info = self.engine.render_flat(flat, family)
         except Exception as err:  # scatter the failure; don't kill the loop
+            self.n_dispatch_errors += 1
+            self._last_dispatch_t = self.clock()
+            self.breaker.record_failure()
+            detail = f"{type(err).__name__}: {err}"
             for p in live:
                 p.future.set_exception(err)
+                get_emitter().emit(
+                    "serve_request",
+                    latency_s=self.clock() - p.t_enqueued,
+                    n_rays=p.n_rays, tier=tier, status="error",
+                    queue_s=t0 - p.t_enqueued,
+                )
+            report("serve.dispatch", "error", detail=detail[:200])
+            with self._cond:
+                self._inflight = []
             return 0
         render_s = self.clock() - t0
+        self._last_dispatch_t = self.clock()
+        self.breaker.record_success()
 
         self.n_batches += 1
         emitter.emit(
@@ -287,6 +378,8 @@ class MicroBatcher:
                 queue_s=t0 - p.t_enqueued,
             )
             p.future.set_result(sliced)
+        with self._cond:
+            self._inflight = []
         return len(live)
 
     def stats(self) -> dict:
@@ -296,4 +389,30 @@ class MicroBatcher:
             "n_completed": self.n_completed,
             "n_shed": self.n_shed,
             "n_timeouts": self.n_timeouts,
+            "n_dispatch_errors": self.n_dispatch_errors,
+            "worker_restarts": self.worker_restarts,
+            "breaker": self.breaker.snapshot(),
+        }
+
+    def last_dispatch_age_s(self) -> float | None:
+        """Seconds since the last dispatch attempt (None before the
+        first) — the liveness number /healthz reports."""
+        if self._last_dispatch_t is None:
+            return None
+        return max(0.0, self.clock() - self._last_dispatch_t)
+
+    def health(self) -> dict:
+        """The /healthz payload: queue depth, last-dispatch age, breaker
+        state, worker liveness. ``ok`` is the headline bit — False when
+        the breaker is open or the worker is dead and unrestartable."""
+        worker_alive = self.ensure_worker() if self._started else True
+        breaker = self.breaker.snapshot()
+        age = self.last_dispatch_age_s()
+        return {
+            "ok": bool(worker_alive and breaker["state"] != "open"),
+            "queue_depth": self.queue_depth(),
+            "last_dispatch_age_s": None if age is None else round(age, 3),
+            "breaker": breaker,
+            "worker_alive": bool(worker_alive),
+            "worker_restarts": self.worker_restarts,
         }

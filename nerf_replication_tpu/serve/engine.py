@@ -38,6 +38,7 @@ import numpy as np
 
 from ..obs import CompileTracker, get_emitter
 from ..renderer.gate import check_baked_bounds
+from ..resil import fault_point
 from .cache import PoseCache
 from .policy import FAMILIES, TIER_IMPL
 
@@ -345,6 +346,9 @@ class RenderEngine:
         """One executable call on exactly ``bucket`` rays (already padded)."""
         import jax
 
+        # chaos hook: injected dispatch failures exercise the batcher's
+        # circuit breaker / degradation path without touching executables
+        fault_point("serve.dispatch")
         chunks = rays_b.reshape(bucket // self.chunk, self.chunk,
                                 rays_b.shape[-1])
         # the request rays' host->device copy is the one INTENDED transfer
@@ -583,8 +587,14 @@ def engine_from_cfg(cfg, cfg_file: str | None = None) -> RenderEngine:
             # load. Executables consume the FINE level and derive the
             # coarse level in-graph (renderer/occupancy.coarse_from_grid)
             # so the serve signatures stay (params, chunks, grid, bbox).
-            levels, bbox = load_occupancy_pyramid(path)
-            grid = levels[0]
+            try:
+                levels, bbox = load_occupancy_pyramid(path)
+                grid = levels[0]
+            except OSError as exc:
+                # truncated/corrupt artifact: serve correct pixels through
+                # the chunked volume path rather than marching garbage
+                print(f"occupancy grid unusable ({exc}); "
+                      "serving through the chunked volume path")
         else:
             print(f"occupancy grid not found at {path}; "
                   "serving through the chunked volume path")
@@ -598,6 +608,7 @@ def engine_from_cfg(cfg, cfg_file: str | None = None) -> RenderEngine:
     if aot is not None:
         try:
             params = jax.eval_shape(lambda k: init(network, k), init_key)
+        # graftlint: ok(swallow: the fallback IS the handling — untraceable inits pay the real compute)
         except Exception:
             params = init(network, init_key)  # exotic init: pay the compute
     else:

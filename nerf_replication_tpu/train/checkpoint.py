@@ -18,6 +18,8 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from ..resil import fault_point, report, retry_params, with_retry
+
 KEEP_EPOCHS = 5  # net_utils.py:337-343
 
 
@@ -130,11 +132,17 @@ def save_model(model_dir: str, state, epoch: int, recorder_state=None,
     os.makedirs(model_dir, exist_ok=True)
     name = "latest" if latest else str(epoch)
     path = _abs(os.path.join(model_dir, name))
+    # fault point sits BEFORE the rmtree: a kill here leaves the previous
+    # checkpoint intact (the atomicity a preempted save must preserve)
+    fault_point("checkpoint.save", path=path)
     if os.path.exists(path):
         shutil.rmtree(path)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, _bundle(state, epoch, recorder_state))
     ckptr.wait_until_finished()
+    # the torn-dir window: a kill between the bundle landing and the
+    # sidecars leaves a loadable bundle with stale/absent sidecars
+    fault_point("checkpoint.save.sidecar", path=path)
 
     # full recorder state (incl. variable-key SmoothedValue trees, which
     # the fixed-schema orbax bundle can't structure-match) rides in a
@@ -174,31 +182,96 @@ def _available_epochs(model_dir: str) -> list[int]:
     )
 
 
-def load_model(model_dir: str, state, epoch: int = -1):
-    """Full resume (net_utils.py:288-320). Returns (state, begin_epoch,
-    recorder_state) or (state, 0, None) when nothing to resume."""
-    target = None
-    if os.path.isdir(os.path.join(model_dir, "latest")) and epoch == -1:
-        target = os.path.join(model_dir, "latest")
-    else:
-        epochs = _available_epochs(model_dir)
-        if epochs:
-            pick = epoch if epoch != -1 and epoch in epochs else epochs[-1]
-            target = os.path.join(model_dir, str(pick))
-    if target is None:
-        return state, 0, None
+def save_model_with_retry(cfg, model_dir: str, state, epoch: int,
+                          recorder_state=None, *, log=print, **kw) -> bool:
+    """``save_model`` under the bounded retry ladder (``resil:`` knobs).
 
-    ckptr = ocp.StandardCheckpointer()
-    template = _bundle(state, 0, {})
+    An exhausted ladder is logged and ABSORBED: losing one cadence save
+    must not kill a healthy run — the next cadence saves again, and a
+    resume falls back to the previous epoch. The ``retry`` telemetry rows
+    (status ``exhausted``) still record the loss for ``tlm_report``."""
     try:
-        restored = ckptr.restore(_abs(target), target=template)
+        with_retry(
+            lambda: save_model(model_dir, state, epoch, recorder_state,
+                               **kw),
+            point="checkpoint.save",
+            **retry_params(cfg),
+        )
+        return True
+    except OSError as exc:
+        log(f"warning: checkpoint save (epoch {epoch}) failed after "
+            f"retries: {exc} — training continues")
+        return False
+
+
+def has_checkpoint(model_dir: str) -> bool:
+    """Anything resumable on disk? The divergence-rollback path must not
+    "restore" from an empty dir — ``load_model`` would hand back its
+    template (the poisoned live state) unchanged."""
+    return bool(
+        os.path.isdir(os.path.join(model_dir, "latest"))
+        or _available_epochs(model_dir)
+    )
+
+
+def _restore_bundle(target: str, template: dict, ckptr):
+    try:
+        return ckptr.restore(_abs(target), target=template)
     except Exception:
         if "grid_ema" not in template:
             raise
         # legacy NGP checkpoint (saved before the grid rode the bundle):
         # restore what it has; the grid keeps the caller's warm start
-        template.pop("grid_ema")
-        restored = ckptr.restore(_abs(target), target=template)
+        legacy = dict(template)
+        legacy.pop("grid_ema")
+        return ckptr.restore(_abs(target), target=legacy)
+
+
+def load_model(model_dir: str, state, epoch: int = -1):
+    """Full resume (net_utils.py:288-320). Returns (state, begin_epoch,
+    recorder_state) or (state, 0, None) when nothing to resume.
+
+    Resilience: transient read errors retry with backoff, and a torn
+    ``latest/`` (a save killed mid-write) falls back to the newest
+    numbered epoch — each fallback is reported as a detected ``fault``
+    row. An explicitly pinned epoch gets no fallback: the caller asked
+    for exactly that checkpoint."""
+    candidates: list[str] = []
+    if os.path.isdir(os.path.join(model_dir, "latest")) and epoch == -1:
+        candidates.append(os.path.join(model_dir, "latest"))
+    epochs = _available_epochs(model_dir)
+    if epochs:
+        pick = epoch if epoch != -1 and epoch in epochs else epochs[-1]
+        candidates.append(os.path.join(model_dir, str(pick)))
+        if epoch == -1:  # older epochs, newest first, as last resorts
+            candidates += [
+                os.path.join(model_dir, str(e))
+                for e in reversed(epochs)
+                if e != pick
+            ]
+    if not candidates:
+        return state, 0, None
+
+    ckptr = ocp.StandardCheckpointer()
+    template = _bundle(state, 0, {})
+    restored, target = None, None
+    for i, cand in enumerate(candidates):
+        def _attempt(cand=cand):
+            fault_point("checkpoint.load", path=cand)
+            return _restore_bundle(cand, template, ckptr)
+
+        try:
+            restored = with_retry(_attempt, point="checkpoint.load")
+            target = cand
+            break
+        except Exception as exc:
+            if i + 1 >= len(candidates):
+                raise
+            report(
+                "checkpoint.load", "torn", path=cand,
+                detail=f"{type(exc).__name__}: falling back to "
+                       f"{os.path.basename(candidates[i + 1])}",
+            )
     new_state = state.replace(
         params=restored["params"],
         opt_state=restored["opt_state"],
@@ -271,14 +344,21 @@ def load_network(model_dir: str, params, epoch: int = -1):
     # not provided" warning that blind PyTreeCheckpointer.restore emits
     template = {"params": inner}
     ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-    restored = ckptr.restore(
-        _abs(target),
-        args=ocp.args.PyTreeRestore(
-            item=template,
-            transforms={},
-            restore_args=ocp.checkpoint_utils.construct_restore_args(template),
-        ),
-    )
+
+    def _restore():
+        fault_point("checkpoint.load", path=target)
+        return ckptr.restore(
+            _abs(target),
+            args=ocp.args.PyTreeRestore(
+                item=template,
+                transforms={},
+                restore_args=ocp.checkpoint_utils.construct_restore_args(
+                    template
+                ),
+            ),
+        )
+
+    restored = with_retry(_restore, point="checkpoint.load")
     loaded = jax.tree.map(
         lambda t, r: np.asarray(r).astype(t.dtype).reshape(t.shape),
         inner,
@@ -303,7 +383,10 @@ def load_pretrain(pretrain_dir: str, params):
     if not os.path.isdir(path):
         return params, False
     ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(_abs(path), target={"params": params})
+    restored = with_retry(
+        lambda: ckptr.restore(_abs(path), target={"params": params}),
+        point="checkpoint.load",
+    )
     return restored["params"], True
 
 

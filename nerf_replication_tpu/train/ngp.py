@@ -794,6 +794,82 @@ def make_ngp_trainer(cfg, network) -> NGPTrainer:
     return NGPTrainer(cfg, network)
 
 
+def _ngp_epoch_steps(trainer, state, bank, base_key, recorder, schedule,
+                     emitter, epoch, ep_iter, log_interval, host_step, *,
+                     finite_guard=True, guard=None, log=print):
+    """One epoch's burst loop (fit_ngp's hot inner loop, factored out so
+    the epoch driver can wrap it in divergence-rollback handling).
+    Returns (state, host_step); stops early at a burst boundary when the
+    SIGTERM guard has triggered (fit_ngp then flushes latest/)."""
+    import time
+
+    from ..resil import DivergenceError, check_finite
+
+    it = 0
+    end = time.time()
+    while it < ep_iter:
+        trainer.profile.tick(host_step)
+        k = min(trainer.scan_steps, ep_iter - it)
+        t_dispatch = time.perf_counter()
+        state, stats = trainer.multi_step(
+            state, bank[0], bank[1], base_key, k
+        )
+        dispatch_s = time.perf_counter() - t_dispatch
+        # multi_step may clamp a burst at the warmup boundary — account
+        # the steps that actually ran, or epochs undertrain silently
+        k = trainer.last_burst_steps
+        host_step += k
+        should_log = (
+            it == 0
+            or (it + k - 1) // log_interval > (it - 1) // log_interval
+            or it + k >= ep_iter
+        )
+        recorder.step = host_step
+        recorder.batch_time.update((time.time() - end) / k)
+        recorder.data_time.update(0.0)
+        end = time.time()
+        if should_log:
+            t_block = time.perf_counter()
+            jax.block_until_ready(stats)
+            block_s = time.perf_counter() - t_block
+            stats_host = {kk: float(v) for kk, v in stats.items()}
+            if finite_guard:
+                try:
+                    stats_host = check_finite(stats_host, host_step)
+                except DivergenceError as err:
+                    # attach the live (NaN-poisoned but valid-buffered)
+                    # state: the rollback needs a restore template whose
+                    # buffers were never donated away
+                    err.state = state
+                    raise
+            recorder.update_loss_stats(stats_host)
+            lr = float(schedule(host_step))
+            log(recorder.console_line(
+                epoch, min(it + k - 1, ep_iter - 1), ep_iter, lr,
+                None,
+            ))
+            recorder.record("train")
+            emitter.emit(
+                "step",
+                step=host_step,
+                epoch=epoch,
+                k=k,
+                step_time_s=recorder.batch_time.median,
+                step_time_avg_s=recorder.batch_time.avg,
+                data_time_s=recorder.data_time.avg,
+                dispatch_s=dispatch_s / k,
+                block_s=block_s / k,
+                lr=lr,
+                stats=stats_host,
+            )
+        it += k
+        if guard is not None and guard.triggered:
+            # SIGTERM landed: stop at this burst boundary
+            break
+    trainer.profile.tick(host_step)
+    return state, host_step
+
+
 def fit_ngp(cfg, network=None, log=print):
     """Epoch-loop training entry for ``task_arg.ngp_training: true`` —
     the occupancy-accelerated counterpart of trainer.fit (train.py routes
@@ -813,11 +889,13 @@ def fit_ngp(cfg, network=None, log=print):
     from ..parallel.collectives import barrier
     from ..compile import registry_from_cfg
     from ..parallel.mesh import is_chief, multihost_init
+    from ..resil import DivergenceError, PreemptionGuard, check_finite, report
     from ..utils.setup import configure_runtime
     from .checkpoint import (
+        has_checkpoint,
         load_model,
         load_phase_state,
-        save_model,
+        save_model_with_retry,
         save_trained_config,
     )
     from .recorder import make_recorder
@@ -914,64 +992,53 @@ def fit_ngp(cfg, network=None, log=print):
     eval_ep = int(cfg.get("eval_ep", 10))
     log_interval = int(cfg.get("log_interval", 20))
 
+    # resilience (docs/robustness.md): finite-loss guard on the fetched
+    # stats, bounded divergence rollback, SIGTERM -> latest/ flush + exit
+    rcfg = cfg.get("resil", {})
+    finite_guard = bool(rcfg.get("finite_guard", True))
+    max_rollbacks = int(rcfg.get("max_rollbacks", 2))
+    guard = (PreemptionGuard.install()
+             if bool(rcfg.get("preempt_sigterm", True)) else None)
+    rollbacks = 0
+
     t_fit_start = time.time()
     try:
-        for epoch in range(begin_epoch, epochs):
+        epoch = begin_epoch
+        while epoch < epochs:
             recorder.epoch = epoch
             host_step = int(state.step)
             step_before = host_step
             t_epoch = time.time()
-            it = 0
-            end = time.time()
-            while it < ep_iter:
-                trainer.profile.tick(host_step)
-                k = min(trainer.scan_steps, ep_iter - it)
-                t_dispatch = time.perf_counter()
-                state, stats = trainer.multi_step(
-                    state, bank[0], bank[1], base_key, k
+            try:
+                state, host_step = _ngp_epoch_steps(
+                    trainer, state, bank, base_key, recorder, schedule,
+                    emitter, epoch, ep_iter, log_interval, host_step,
+                    finite_guard=finite_guard, guard=guard, log=log,
                 )
-                dispatch_s = time.perf_counter() - t_dispatch
-                # multi_step may clamp a burst at the warmup boundary —
-                # account the steps that actually ran, or epochs
-                # undertrain silently
-                k = trainer.last_burst_steps
-                host_step += k
-                should_log = (
-                    it == 0
-                    or (it + k - 1) // log_interval > (it - 1) // log_interval
-                    or it + k >= ep_iter
+            except DivergenceError as err:
+                rollbacks += 1
+                template = getattr(err, "state", state)
+                if rollbacks > max_rollbacks or not has_checkpoint(
+                    cfg.trained_model_dir
+                ):
+                    raise  # nothing to roll back to, or the budget is spent
+                report("train.loss", "rollback", step=err.step,
+                       detail=f"rollback {rollbacks}/{max_rollbacks}")
+                log(f"non-finite loss at step {err.step}: rolling back to "
+                    f"the last good checkpoint ({rollbacks}/{max_rollbacks})")
+                state, epoch, rec_state = load_model(
+                    cfg.trained_model_dir, template
                 )
-                recorder.step = host_step
-                recorder.batch_time.update((time.time() - end) / k)
-                recorder.data_time.update(0.0)
-                end = time.time()
-                if should_log:
-                    t_block = time.perf_counter()
-                    jax.block_until_ready(stats)
-                    block_s = time.perf_counter() - t_block
-                    stats_host = {kk: float(v) for kk, v in stats.items()}
-                    recorder.update_loss_stats(stats_host)
-                    lr = float(schedule(host_step))
-                    log(recorder.console_line(
-                        epoch, min(it + k - 1, ep_iter - 1), ep_iter, lr,
-                        None,
-                    ))
-                    recorder.record("train")
-                    emitter.emit(
-                        "step",
-                        step=host_step,
-                        epoch=epoch,
-                        k=k,
-                        step_time_s=recorder.batch_time.median,
-                        step_time_avg_s=recorder.batch_time.avg,
-                        data_time_s=recorder.data_time.avg,
-                        dispatch_s=dispatch_s / k,
-                        block_s=block_s / k,
-                        lr=lr,
-                        stats=stats_host,
-                    )
-                it += k
-            trainer.profile.tick(host_step)
+                if rec_state:
+                    recorder.load_state_dict(rec_state)
+                # re-sync the warm/carve phase to the RESTORED state (the
+                # diverged run's host counters are stale)
+                trainer._host_step = None
+                trainer.restore_phase(
+                    load_phase_state(cfg.trained_model_dir),
+                    expect_step=int(state.step),
+                )
+                continue
             wall = time.time() - t_epoch
             emitter.emit(
                 "epoch", epoch=epoch, steps=host_step - step_before,
@@ -991,19 +1058,37 @@ def fit_ngp(cfg, network=None, log=print):
             if saving:
                 barrier("pre_save")
                 if chief and (epoch + 1) % save_ep == 0:
-                    save_model(cfg.trained_model_dir, state, epoch,
-                               recorder.state_dict(), latest=False,
-                               phase_state=trainer.phase_state())
+                    save_model_with_retry(
+                        cfg, cfg.trained_model_dir, state, epoch,
+                        recorder.state_dict(), latest=False, log=log,
+                        phase_state=trainer.phase_state())
                 if chief and (epoch + 1) % save_latest_ep == 0:
-                    save_model(cfg.trained_model_dir, state, epoch,
-                               recorder.state_dict(), latest=True,
-                               phase_state=trainer.phase_state())
+                    save_model_with_retry(
+                        cfg, cfg.trained_model_dir, state, epoch,
+                        recorder.state_dict(), latest=True, log=log,
+                        phase_state=trainer.phase_state())
                 barrier("post_save")
             if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
                 result = trainer.val(state, test_ds, evaluator, log=log)
                 if result:
                     recorder.record("val", step=epoch, stats=result)
+            if guard is not None and guard.triggered:
+                # preemption: one atomic latest/ flush carrying the phase
+                # sidecar, then a clean exit — the resumed run restores
+                # this state bitwise and re-enters the exact phase
+                barrier("pre_save")
+                if chief:
+                    save_model_with_retry(
+                        cfg, cfg.trained_model_dir, state, epoch,
+                        recorder.state_dict(), latest=True, log=log,
+                        phase_state=trainer.phase_state())
+                barrier("post_save")
+                log("SIGTERM: latest checkpoint flushed; exiting")
+                break
+            epoch += 1
     finally:
+        if guard is not None:
+            guard.uninstall()
         trainer.profile.stop()
         emitter.close()
     return state

@@ -37,7 +37,14 @@ from ..obs import (
     init_run,
     sample_memory,
 )
-from .checkpoint import load_model, load_pretrain, save_model, save_trained_config
+from ..resil import DivergenceError, PreemptionGuard, check_finite, report
+from .checkpoint import (
+    has_checkpoint,
+    load_model,
+    load_pretrain,
+    save_model_with_retry,
+    save_trained_config,
+)
 from .step_core import sampled_grad_step, scan_k_steps
 from .optim import make_optimizer
 from .recorder import Recorder
@@ -105,6 +112,11 @@ class Trainer:
         # step executables build on host threads during setup instead of on
         # first dispatch; None (unit tests, aot: false) keeps the lazy path
         self.aot = None
+        # resilience (resil/guard.py, docs/robustness.md): the finite-loss
+        # guard rides the stats the logging path already fetched (no extra
+        # host sync); fit() installs the SIGTERM guard polled below
+        self.finite_guard = bool(cfg.get("resil", {}).get("finite_guard", True))
+        self.preempt = None
 
     def epoch_iters(self, bank_size: int) -> int:
         """Steps per epoch. ep_iter=-1 (the reference's 'no resampling'
@@ -334,6 +346,15 @@ class Trainer:
                 jax.block_until_ready(stats)
                 block_s = time.perf_counter() - t_block
                 stats_host = {kk: float(v) for kk, v in stats.items()}
+                if self.finite_guard:
+                    try:
+                        stats_host = check_finite(stats_host, host_step)
+                    except DivergenceError as err:
+                        # attach the live (NaN-poisoned but valid-buffered)
+                        # state: fit's rollback needs a restore template
+                        # whose buffers were never donated away
+                        err.state = state
+                        raise
                 recorder.update_loss_stats(stats_host)
             recorder.step = host_step
             # per-step time so the console line stays comparable across
@@ -363,6 +384,10 @@ class Trainer:
                     stats=stats_host,
                 )
             it += k
+            if self.preempt is not None and self.preempt.triggered:
+                # SIGTERM landed: stop at this burst boundary; fit flushes
+                # one atomic latest/ checkpoint and exits
+                break
         self.profile.tick(host_step)
         return state, stats
 
@@ -421,6 +446,7 @@ def _device_mem_mb() -> float | None:
         stats = jax.local_devices()[0].memory_stats()
         if stats and "peak_bytes_in_use" in stats:
             return stats["peak_bytes_in_use"] / 2**20
+    # graftlint: ok(swallow: best-effort HBM probe for the progress line; None hides the field)
     except Exception:
         pass
     return None
@@ -558,16 +584,44 @@ def fit(cfg, network=None, log=print):
     save_latest_ep = int(cfg.get("save_latest_ep", 10))
     eval_ep = int(cfg.get("eval_ep", 10))
 
+    # resilience (docs/robustness.md): a non-finite loss rolls back to the
+    # last good checkpoint (bounded), SIGTERM flushes latest/ and exits
+    rcfg = cfg.get("resil", {})
+    max_rollbacks = int(rcfg.get("max_rollbacks", 2))
+    guard = (PreemptionGuard.install()
+             if bool(rcfg.get("preempt_sigterm", True)) else None)
+    trainer.preempt = guard
+    rollbacks = 0
+
     t_fit_start = time.time()
     try:
-        for epoch in range(begin_epoch, epochs):
+        epoch = begin_epoch
+        while epoch < epochs:
             recorder.epoch = epoch
             t_epoch = time.time()
             step_before = int(state.step)
-            state, _ = trainer.train_epoch(
-                state, epoch, bank, base_key, recorder, schedule,
-                index_pool=pool, log=log,
-            )
+            try:
+                state, _ = trainer.train_epoch(
+                    state, epoch, bank, base_key, recorder, schedule,
+                    index_pool=pool, log=log,
+                )
+            except DivergenceError as err:
+                rollbacks += 1
+                template = getattr(err, "state", state)
+                if rollbacks > max_rollbacks or not has_checkpoint(
+                    cfg.trained_model_dir
+                ):
+                    raise  # nothing to roll back to, or the budget is spent
+                report("train.loss", "rollback", step=err.step,
+                       detail=f"rollback {rollbacks}/{max_rollbacks}")
+                log(f"non-finite loss at step {err.step}: rolling back to "
+                    f"the last good checkpoint ({rollbacks}/{max_rollbacks})")
+                state, epoch, rec_state = load_model(
+                    cfg.trained_model_dir, template
+                )
+                if rec_state:
+                    recorder.load_state_dict(rec_state)
+                continue
             # epoch cadence telemetry: throughput + HBM creep + liveness
             step_after = int(state.step)
             wall = time.time() - t_epoch
@@ -592,17 +646,34 @@ def fit(cfg, network=None, log=print):
                 # can never observe a half-written bundle
                 barrier("pre_save")
                 if chief and (epoch + 1) % save_ep == 0:
-                    save_model(cfg.trained_model_dir, state, epoch,
-                               recorder.state_dict(), latest=False)
+                    save_model_with_retry(cfg, cfg.trained_model_dir, state,
+                                          epoch, recorder.state_dict(),
+                                          latest=False, log=log)
                 if chief and (epoch + 1) % save_latest_ep == 0:
-                    save_model(cfg.trained_model_dir, state, epoch,
-                               recorder.state_dict(), latest=True)
+                    save_model_with_retry(cfg, cfg.trained_model_dir, state,
+                                          epoch, recorder.state_dict(),
+                                          latest=True, log=log)
                 barrier("post_save")
             # chief-only: validation renders/writes artifacts on one process
             # (the reference runs val on rank 0 only, train.py:84-85)
             if chief and (epoch + 1) % eval_ep == 0 and evaluator is not None:
                 trainer.val(state, epoch, test_ds, recorder, log=log)
+            if guard is not None and guard.triggered:
+                # preemption: one atomic latest/ flush (same bracket as the
+                # cadence saves), then a clean exit — the resumed run
+                # restores this exact state bitwise
+                barrier("pre_save")
+                if chief:
+                    save_model_with_retry(cfg, cfg.trained_model_dir, state,
+                                          epoch, recorder.state_dict(),
+                                          latest=True, log=log)
+                barrier("post_save")
+                log("SIGTERM: latest checkpoint flushed; exiting")
+                break
+            epoch += 1
     finally:
+        if guard is not None:
+            guard.uninstall()
         # a window still open at exit (crash mid-capture) must be closed
         # or the xplane file is unreadable
         trainer.profile.stop()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import re
+import sys
 
 
 def parse_platform_pin(value: str) -> tuple[str, int | None]:
@@ -118,8 +119,13 @@ def enable_compilation_cache(path: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         # -1 = no size floor (0 would filter every entry out)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:
-        pass
+    except Exception as exc:
+        # cache is an optimization: runs proceed uncached, but say so once
+        print(
+            f"warning: persistent compilation cache disabled: "
+            f"{type(exc).__name__}: {exc}",
+            file=sys.stderr,
+        )
 
 
 def init_backend_with_retry(
@@ -196,6 +202,7 @@ def init_backend_with_retry(
         def attach():
             try:
                 result["devices"] = jax.devices()
+            # graftlint: ok(swallow: error is returned to the retry loop, which logs it)
             except Exception as exc:
                 result["error"] = exc
 

@@ -1,0 +1,308 @@
+"""Chaos runner: drive a real train or serve loop under a seeded FaultPlan.
+
+Boots the same self-contained tiny scene the benches use (procedural
+scene, randomly-initialized network — no downloads), installs a
+deterministic :class:`~nerf_replication_tpu.resil.FaultPlan`, runs the
+REAL production loop (``train.fit`` or the engine + micro-batcher stack),
+and then summarizes the run's ``fault``/``retry``/``breaker`` telemetry
+next to its recovery status. The same ``--seed`` + ``--fault`` specs
+always produce the same failure schedule, so a chaos reproduction is a
+command line, not a war story.
+
+    python scripts/chaos_run.py train --fault checkpoint.save:io_error
+    python scripts/chaos_run.py train --fault train.loss:nan_loss:30 \
+        --epochs 3
+    python scripts/chaos_run.py serve --fault serve.flush:kill:4 \
+        --fault occupancy.load:truncate --requests 40
+
+Fault spec grammar: ``point:kind[:after[:times]]`` — inject ``kind`` at
+``point`` after letting ``after`` hits through, on up to ``times`` hits
+(``-1`` = every hit). Points/kinds: ``resil.FAULT_POINTS`` /
+``resil.FAULT_KINDS`` (catalog: docs/robustness.md).
+
+Exit code 0 = the run RECOVERED: it completed, no retry ladder was
+exhausted, and (serve) the steady-state stream triggered zero recompiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+NEAR, FAR = 2.0, 6.0
+
+
+def parse_fault(spec: str):
+    """``point:kind[:after[:times]]`` → FaultSpec kwargs."""
+    parts = spec.split(":")
+    if len(parts) < 2 or len(parts) > 4:
+        raise argparse.ArgumentTypeError(
+            f"bad fault spec {spec!r} (want point:kind[:after[:times]])"
+        )
+    kw = {"point": parts[0], "kind": parts[1]}
+    if len(parts) > 2:
+        kw["after"] = int(parts[2])
+    if len(parts) > 3:
+        times = int(parts[3])
+        kw["times"] = None if times < 0 else times
+    return kw
+
+
+def _tiny_cfg(scene_root: str, workdir: str, extra=()):
+    from nerf_replication_tpu.config import make_cfg
+
+    return make_cfg(
+        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        [
+            "scene", "procedural",
+            "exp_name", "chaos",
+            "train_dataset.data_root", scene_root,
+            "test_dataset.data_root", scene_root,
+            "train_dataset.H", "16", "train_dataset.W", "16",
+            "test_dataset.H", "16", "test_dataset.W", "16",
+            "task_arg.N_rays", "128",
+            "task_arg.N_samples", "24",
+            "task_arg.N_importance", "24",
+            "task_arg.chunk_size", "256",
+            "task_arg.precrop_iters", "0",
+            "network.nerf.W", "64",
+            "network.nerf.D", "3",
+            "network.nerf.skips", "[1]",
+            "network.xyz_encoder.freq", "6",
+            "network.dir_encoder.freq", "2",
+            "ep_iter", "25",
+            "trained_model_dir", os.path.join(workdir, "trained"),
+            "record_dir", os.path.join(workdir, "record"),
+            *extra,
+        ],
+    )
+
+
+def _scene(workdir: str) -> str:
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+
+    root = os.path.join(workdir, "scene")
+    if not os.path.exists(os.path.join(root, "transforms_train.json")):
+        generate_scene(root, scene="procedural", H=16, W=16,
+                       n_train=6, n_test=2)
+    return root
+
+
+def run_train(args, plan) -> dict:
+    """fit() on the tiny scene under the plan; survives injected faults
+    the library is supposed to absorb, reports the ones it isn't."""
+    from nerf_replication_tpu.resil import (
+        DivergenceError,
+        SimulatedKill,
+        injecting,
+    )
+    from nerf_replication_tpu.train import fit
+
+    cfg = _tiny_cfg(
+        _scene(args.workdir), args.workdir,
+        ["train.epoch", str(args.epochs),
+         "save_ep", "1",
+         "skip_eval", "True",
+         "log_interval", "5"],
+    )
+    outcome = {"mode": "train", "completed": False, "died": None}
+    t0 = time.perf_counter()
+    with injecting(plan):
+        try:
+            fit(cfg)
+            outcome["completed"] = True
+        except SimulatedKill as k:
+            outcome["died"] = f"SimulatedKill({k})"
+        except DivergenceError as err:
+            outcome["died"] = f"DivergenceError(step={err.step})"
+    outcome["wall_s"] = round(time.perf_counter() - t0, 2)
+    outcome["telemetry"] = os.path.join(str(cfg.record_dir),
+                                        "telemetry.jsonl")
+    return outcome
+
+
+def run_serve(args, plan) -> dict:
+    """Engine + micro-batcher under the plan: the worker watchdog and the
+    breaker must keep the stream flowing with zero steady recompiles."""
+    import numpy as np
+
+    import jax
+
+    from nerf_replication_tpu.models import init_params_for, make_network
+    from nerf_replication_tpu.obs import init_run
+    from nerf_replication_tpu.resil import (
+        BreakerOpenError,
+        CircuitBreaker,
+        injecting,
+    )
+    from nerf_replication_tpu.serve import (
+        MicroBatcher,
+        RenderEngine,
+        ServeTimeoutError,
+    )
+
+    scene_root = _scene(args.workdir)
+    cfg = _tiny_cfg(
+        scene_root, args.workdir,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "64",
+         "serve.buckets", "[128, 256]",
+         "serve.max_batch_rays", "256",
+         "serve.max_delay_ms", "5.0",
+         "serve.request_timeout_s", "10.0",
+         "serve.shed_queue_depths", "[8, 16, 32, 64]"],
+    )
+    telem = os.path.join(args.workdir, "record", "telemetry.jsonl")
+    init_run(cfg, component="serve", path=telem)
+    network = make_network(cfg)
+    params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox)
+    batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg))
+
+    rng = np.random.default_rng(args.seed)
+    steady_base = engine.tracker.total_compiles()
+    ok = rejected = failed = 0
+    t0 = time.perf_counter()
+    with injecting(plan):
+        for _ in range(args.requests):
+            n = int(rng.integers(32, 257))
+            d = np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3))
+            rays = np.concatenate(
+                [np.tile([0.0, 0.0, 4.0], (n, 1)), d], -1
+            ).astype(np.float32)
+            try:
+                batcher.submit(rays, NEAR, FAR).result(timeout=30.0)
+                ok += 1
+            except BreakerOpenError:
+                rejected += 1
+                time.sleep(0.05)
+            except (ServeTimeoutError, TimeoutError, RuntimeError, OSError):
+                # the batcher scatters the original dispatch exception onto
+                # the futures: RuntimeError for a crashed worker, OSError
+                # for an injected/organic I/O failure
+                failed += 1
+    wall = time.perf_counter() - t0
+    health = batcher.health()
+    batcher.close(drain=False)
+    return {
+        "mode": "serve",
+        "completed": True,
+        "died": None,
+        "wall_s": round(wall, 2),
+        "n_ok": ok,
+        "n_rejected_503": rejected,
+        "n_failed": failed,
+        "worker_restarts": health["worker_restarts"],
+        "breaker": health["breaker"],
+        "recompiles_steady": engine.tracker.total_compiles() - steady_base,
+        "telemetry": telem,
+    }
+
+
+def summarize_telemetry(path: str) -> dict:
+    """fault/retry/breaker row counts from one run's telemetry stream."""
+    out = {
+        "faults_injected": 0, "faults_detected": 0,
+        "faults_by_point": {}, "retries": 0, "retries_exhausted": 0,
+        "breaker_transitions": {}, "rows": 0,
+    }
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a torn tail line is itself chaos-expected
+            out["rows"] += 1
+            kind = row.get("kind")
+            if kind == "fault":
+                key = "faults_injected" if row.get("injected") \
+                    else "faults_detected"
+                out[key] += 1
+                pt = f"{row.get('point')}:{row.get('fault')}"
+                out["faults_by_point"][pt] = \
+                    out["faults_by_point"].get(pt, 0) + 1
+            elif kind == "retry":
+                if row.get("status") == "exhausted":
+                    out["retries_exhausted"] += 1
+                elif row.get("status") == "retry":
+                    out["retries"] += 1
+            elif kind == "breaker":
+                st = row.get("state")
+                out["breaker_transitions"][st] = \
+                    out["breaker_transitions"].get(st, 0) + 1
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="deterministic chaos runner (docs/robustness.md)"
+    )
+    p.add_argument("mode", choices=("train", "serve"))
+    p.add_argument("--fault", type=parse_fault, action="append", default=[],
+                   metavar="point:kind[:after[:times]]")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--backend", default="cpu",
+                   help="platform pin ('cpu', 'cpu:8'; '' = inherit)")
+    p.add_argument("--workdir",
+                   default=os.path.join(_REPO, "data", "chaos_run"))
+    p.add_argument("--keep", action="store_true",
+                   help="keep the workdir (default: wiped before the run)")
+    args = p.parse_args(argv)
+
+    if args.backend:
+        from nerf_replication_tpu.utils.platform import (
+            force_platform,
+            parse_platform_pin,
+        )
+
+        force_platform(*parse_platform_pin(args.backend))
+
+    if not args.keep and os.path.isdir(args.workdir):
+        shutil.rmtree(args.workdir)
+    os.makedirs(args.workdir, exist_ok=True)
+
+    from nerf_replication_tpu.resil import FaultPlan
+
+    plan = FaultPlan(seed=args.seed)
+    for kw in args.fault:
+        plan.add(**kw)
+    specs = [f"{s.point}:{s.kind}(after={s.after}, times={s.times})"
+             for s in plan.specs]
+    print(f"chaos plan (seed {args.seed}): "
+          + ("; ".join(specs) if specs else "no faults (baseline run)"))
+
+    outcome = (run_train if args.mode == "train" else run_serve)(args, plan)
+    outcome["faults_injected_by_plan"] = plan.injected()
+    summary = summarize_telemetry(outcome["telemetry"])
+
+    recovered = bool(
+        outcome["completed"]
+        and summary["retries_exhausted"] == 0
+        and outcome.get("recompiles_steady", 0) == 0
+    )
+    print(json.dumps({"outcome": outcome, "telemetry_summary": summary,
+                      "recovered": recovered}, indent=2))
+    print(f"chaos: {'RECOVERED' if recovered else 'UNRECOVERED'} — "
+          f"{plan.injected()} injected, "
+          f"{summary['retries_exhausted']} exhausted retries")
+    return 0 if recovered else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,7 +68,7 @@ def emit_lint_run(path: str, *, n_findings: int, n_new: int, n_baselined: int,
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
-        description="JAX-aware static analysis gate (rules R1-R7; "
+        description="JAX-aware static analysis gate (rules R1-R8; "
                     "docs/static_analysis.md)"
     )
     p.add_argument(

@@ -5,12 +5,15 @@
     python scripts/tlm_report.py <run_dir> --json
 
 Summary: p50/p95/max per-step time, steps/s, compile count (+ total
-compile seconds), peak device memory / host RSS, final PSNR. ``--diff``
-compares run A (baseline) against run B (candidate) and flags
-regressions past ``--gate`` percent (step-time p50, peak memory) or any
-compile-count increase / PSNR drop > 0.1 dB; with ``--gate`` the exit
-code is nonzero when a regression is flagged, so a bench battery can use
-it as its gate against a saved baseline run (e.g. the run behind
+compile seconds), peak device memory / host RSS, final PSNR, and — when
+the run carries resil rows — injected/detected faults, retry-ladder
+outcomes, and circuit-breaker opens. ``--diff`` compares run A
+(baseline) against run B (candidate) and flags regressions past
+``--gate`` percent (step-time p50, peak memory) or any compile-count
+increase / PSNR drop > 0.1 dB / growth in unrecovered faults (exhausted
+retry ladders) or breaker opens; with ``--gate`` the exit code is
+nonzero when a regression is flagged, so a bench battery can use it as
+its gate against a saved baseline run (e.g. the run behind
 ``BASELINE.json``).
 
 A file holds every run ever appended to it (one ``run_meta`` row each);
@@ -217,6 +220,41 @@ def summarize(rows: list[dict]) -> dict:
             {r.get("mode", "packed") for r in marches}
         )
 
+    # resilience rows (nerf_replication_tpu/resil): injected vs detected
+    # faults, the retry ladder's outcomes, breaker transitions. An
+    # ``exhausted`` retry row is an UNRECOVERED fault — the count --diff
+    # gates on. Keys present only when the stream carries resil rows.
+    faults = [r for r in rows if r.get("kind") == "fault"]
+    retries = [r for r in rows if r.get("kind") == "retry"]
+    breakers = [r for r in rows if r.get("kind") == "breaker"]
+    if faults or retries or breakers:
+        by_point: dict = {}
+        for r in faults:
+            k = f"{r.get('point')}:{r.get('fault')}"
+            by_point[k] = by_point.get(k, 0) + 1
+        summary["faults_injected"] = sum(
+            1 for r in faults if r.get("injected")
+        )
+        summary["faults_detected"] = sum(
+            1 for r in faults if not r.get("injected")
+        )
+        summary["fault_points"] = by_point
+        summary["retry_backoffs"] = sum(
+            1 for r in retries if r.get("status") == "retry"
+        )
+        summary["retry_recovered"] = sum(
+            1 for r in retries if r.get("status") == "ok"
+        )
+        summary["faults_unrecovered"] = sum(
+            1 for r in retries if r.get("status") == "exhausted"
+        )
+        summary["breaker_opens"] = sum(
+            1 for r in breakers if r.get("state") == "open"
+        )
+        summary["breaker_last_state"] = (
+            breakers[-1].get("state") if breakers else "closed"
+        )
+
     # static-analysis rows (scripts/graftlint.py): the latest run's
     # new-vs-baselined split and rule mix — keys present only when the
     # stream carries lint_run rows (logs/graftlint/telemetry.jsonl)
@@ -303,6 +341,18 @@ def print_summary(summary: dict, label: str = "") -> None:
               + (f"{occ * 100:.1f}%" if occ is not None else "n/a")
               + "  overflow max: "
               + (f"{over * 100:.1f}%" if over is not None else "n/a"))
+    if summary.get("fault_points") is not None:
+        mix = " ".join(
+            f"{k}:{v}" for k, v in sorted(summary["fault_points"].items())
+        )
+        print(f"  faults:        {summary['faults_injected']} injected / "
+              f"{summary['faults_detected']} detected"
+              + (f"  ({mix})" if mix else ""))
+        print(f"    retries:     {summary['retry_backoffs']} backoffs, "
+              f"{summary['retry_recovered']} recovered, "
+              f"{summary['faults_unrecovered']} UNRECOVERED")
+        print(f"    breaker:     {summary['breaker_opens']} open(s), "
+              f"last state {summary['breaker_last_state']}")
     if summary.get("lint_runs"):
         rule_mix = " ".join(
             f"{k}:{v}"
@@ -344,6 +394,17 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     a, b = base.get("lint_new"), cand.get("lint_new")
     if a is not None and b is not None and b > a:
         flags.append(f"graftlint new findings grew {a} -> {b}")
+    # an exhausted retry ladder means a load path gave up — a candidate
+    # run growing these has faults the resil machinery no longer absorbs
+    a = base.get("faults_unrecovered") or 0
+    b = cand.get("faults_unrecovered")
+    if b is not None and b > a:
+        flags.append(f"unrecovered faults grew {a} -> {b} "
+                     f"(exhausted retry ladders)")
+    a = base.get("breaker_opens") or 0
+    b = cand.get("breaker_opens")
+    if b is not None and b > a:
+        flags.append(f"circuit-breaker opens grew {a} -> {b}")
     # sweep efficiency DROPPING means the coarse DDA is admitting more
     # dead candidate rows into the sort per useful sample — a traversal
     # regression even when step time hasn't moved yet

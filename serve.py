@@ -19,7 +19,14 @@ API (all JSON):
   base64 of the raw uint8 [h, w, 3] buffer.
 * ``GET /stats`` — engine + batcher + cache counters (compile inventory,
   occupancy, shed/timeout counts, queue depth).
-* ``GET /healthz`` — liveness.
+* ``GET /healthz`` — supervision view: queue depth, last-dispatch age,
+  circuit-breaker state, worker liveness/restarts. 200 while healthy,
+  503 when the breaker is open or the worker cannot be kept alive.
+
+Errors are structured JSON, never stack traces (docs/robustness.md):
+bad pose / out-of-bounds request → 400, batcher timeout → 504, breaker
+open → 503 with a ``Retry-After`` header, anything else → 500
+``{"error": "internal error"}``.
 """
 
 from __future__ import annotations
@@ -83,23 +90,28 @@ def render_pose(engine, batcher, body: dict) -> dict:
 def make_server(engine, batcher, host: str = "127.0.0.1",
                 port: int = 8008) -> ThreadingHTTPServer:
     """A ready-to-serve ThreadingHTTPServer (port 0 = ephemeral, tests)."""
+    from nerf_replication_tpu.resil import BreakerOpenError, report
     from nerf_replication_tpu.serve.batcher import ServeTimeoutError
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet: telemetry is the record
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: dict | None = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
             if self.path == "/healthz":
-                return self._reply(200, {"ok": True})
+                health = batcher.health() if batcher is not None else {"ok": True}
+                return self._reply(200 if health["ok"] else 503, health)
             if self.path == "/stats":
                 stats = engine.stats()
                 if batcher is not None:
@@ -114,10 +126,25 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 return self._reply(200, render_pose(engine, batcher, body))
-            except ServeTimeoutError as err:
-                return self._reply(503, {"error": str(err)})
+            except BreakerOpenError as err:
+                return self._reply(
+                    503, {"error": str(err),
+                          "retry_after_s": err.retry_after_s},
+                    headers={"Retry-After": str(max(1, round(err.retry_after_s)))},
+                )
+            except (ServeTimeoutError, TimeoutError) as err:
+                return self._reply(
+                    504, {"error": str(err) or "render timed out"})
             except (ValueError, KeyError) as err:
+                # BakedBoundsError is a ValueError: caller asked for a view
+                # outside the baked near/far — a client error, not ours
                 return self._reply(400, {"error": str(err)})
+            except Exception as err:
+                # structured 500: the detail goes to telemetry, never to
+                # the client (no stack traces on the wire)
+                report("serve.request", "error",
+                       detail=f"{type(err).__name__}: {err}"[:200])
+                return self._reply(500, {"error": "internal error"})
 
     return ThreadingHTTPServer((host, port), Handler)
 
@@ -139,7 +166,9 @@ def main(argv=None) -> int:
     configure_runtime(cfg)
     emitter = init_run(cfg, component="serve")
     engine = engine_from_cfg(cfg, cfg_file=args.cfg_file)
-    batcher = MicroBatcher(engine)
+    from nerf_replication_tpu.resil import CircuitBreaker
+
+    batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg))
     server = make_server(engine, batcher, host=args.host, port=args.port)
     print(
         f"serving on http://{args.host}:{server.server_address[1]} "

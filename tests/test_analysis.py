@@ -373,6 +373,98 @@ def test_aot_inline_suppressible():
 
 
 # --------------------------------------------------------------------------
+# R8 swallow
+# --------------------------------------------------------------------------
+
+
+def test_swallow_silent_broad_except_flagged():
+    src = (
+        "def load(path):\n"
+        "    try:\n"
+        "        return open(path).read()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    return None\n"
+    )
+    assert "swallow" in _rules_of(lint_source(src, path=_LIB_PATH))
+
+
+def test_swallow_bare_except_and_tuple_flagged():
+    src = (
+        "def a():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except:\n"
+        "        return None\n"
+        "def b():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except (ValueError, Exception):\n"
+        "        return None\n"
+    )
+    found = lint_source(src, path=_LIB_PATH)
+    assert sum(1 for f in found if f.rule == "swallow") == 2
+
+
+def test_swallow_negative_reraise_or_telemetry():
+    src = (
+        "def a():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "def b():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as exc:\n"
+        "        report('artifact.load', 'error', detail=str(exc))\n"
+        "        return None\n"
+        "def c():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as exc:\n"
+        "        log.warning('failed: %s', exc)\n"
+    )
+    assert "swallow" not in _rules_of(lint_source(src, path=_LIB_PATH))
+
+
+def test_swallow_narrow_handler_out_of_scope():
+    src = (
+        "def a():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except (OSError, ValueError):\n"
+        "        return None\n"
+    )
+    assert "swallow" not in _rules_of(lint_source(src, path=_LIB_PATH))
+
+
+def test_swallow_exempt_outside_library_code():
+    src = (
+        "def a():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    for path in ("scripts/chaos_run.py", "tests/test_foo.py", "serve.py",
+                 "nerf_replication_tpu/analysis/core.py"):
+        assert "swallow" not in _rules_of(lint_source(src, path=path)), path
+
+
+def test_swallow_suppressible_with_reason():
+    src = (
+        "def a():\n"
+        "    try:\n"
+        "        work()\n"
+        "    # graftlint: ok(swallow: best-effort probe)\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert "swallow" not in _rules_of(lint_source(src, path=_LIB_PATH))
+
+
+# --------------------------------------------------------------------------
 # suppression + baseline workflow
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,660 @@
+"""Resilience subsystem (nerf_replication_tpu/resil + its integrations):
+deterministic fault plans, the retry ladder, artifact checksums, the
+circuit breaker's state machine, the serve worker watchdog, torn-artifact
+degradation at every load path, divergence rollback, and SIGTERM
+preemption with bitwise resume. The fast subset is marked ``chaos`` and
+rides in tier-1; the kill/resume matrix is additionally ``slow``."""
+
+import json
+import os
+import signal
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.config import make_cfg
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.obs import validate_row
+from nerf_replication_tpu.obs import emit as emit_mod
+from nerf_replication_tpu.resil import (
+    BreakerOpenError,
+    CircuitBreaker,
+    DivergenceError,
+    FaultPlan,
+    FaultSpec,
+    PreemptionGuard,
+    SimulatedKill,
+    check_finite,
+    file_sha256,
+    injecting,
+    verify_checksum,
+    with_retry,
+    write_checksum,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- harness -----------------------------------------------------------------
+
+
+@pytest.fixture
+def telem(tmp_path, monkeypatch):
+    """Route the process emitter at a scratch JSONL; yields its path."""
+    path = str(tmp_path / "telemetry.jsonl")
+    em = emit_mod.Emitter(path, chief=True)
+    monkeypatch.setattr(emit_mod, "_active", em)
+    yield path
+    em.close()
+
+
+def rows_of(path, kind=None):
+    if not os.path.exists(path):
+        return []
+    out = [json.loads(line) for line in open(path)]
+    for r in out:
+        assert validate_row(r) == [], r
+    return [r for r in out if kind is None or r["kind"] == kind]
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- fault plans -------------------------------------------------------------
+
+
+def test_fault_plan_is_deterministic_per_seed():
+    def schedule(seed):
+        plan = FaultPlan(seed=seed)
+        plan.add("artifact.load", "io_error", times=None, prob=0.5)
+        return [plan.hit("artifact.load") is not None for _ in range(40)]
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7) != schedule(8)  # the seed IS the schedule
+
+
+def test_fault_spec_after_times_windows():
+    plan = FaultPlan()
+    plan.add("checkpoint.save", "io_error", after=2, times=2)
+    fired = [plan.hit("checkpoint.save") is not None for _ in range(6)]
+    assert fired == [False, False, True, True, False, False]
+    assert plan.injected() == 2
+    assert plan.counts() == {"checkpoint.save": 6}
+
+
+def test_fault_spec_rejects_unknown_point_and_kind():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultSpec("not.a.point", "io_error")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec("artifact.load", "segfault")
+
+
+def test_injecting_context_uninstalls_across_kill(telem):
+    from nerf_replication_tpu.resil import active, fault_point
+
+    plan = FaultPlan().add("serve.flush", "kill")
+    with pytest.raises(SimulatedKill):
+        with injecting(plan):
+            fault_point("serve.flush")
+    assert active() is None  # uninstalled even across a BaseException
+    (row,) = rows_of(telem, "fault")
+    assert row["point"] == "serve.flush" and row["injected"] is True
+
+
+# -- retry ladder ------------------------------------------------------------
+
+
+def test_with_retry_recovers_and_emits_rows(telem):
+    calls, naps = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = with_retry(flaky, point="artifact.load", attempts=3,
+                     base_s=0.05, max_s=2.0, sleep=naps.append)
+    assert out == "ok" and len(calls) == 3
+    assert naps == [0.05, 0.1]  # capped exponential backoff
+    got = rows_of(telem, "retry")
+    assert [r["status"] for r in got] == ["retry", "retry", "ok"]
+    assert got[0]["point"] == "artifact.load"
+
+
+def test_with_retry_exhausted_reraises_after_row(telem):
+    def broken():
+        raise OSError("gone")
+
+    with pytest.raises(OSError, match="gone"):
+        with_retry(broken, point="occupancy.load", attempts=2,
+                   sleep=lambda s: None)
+    got = rows_of(telem, "retry")
+    assert [r["status"] for r in got] == ["retry", "exhausted"]
+
+
+def test_with_retry_never_absorbs_a_kill(telem):
+    def killed():
+        raise SimulatedKill("checkpoint.save")
+
+    with pytest.raises(SimulatedKill):
+        with_retry(killed, point="checkpoint.save", sleep=lambda s: None)
+    assert rows_of(telem, "retry") == []  # a kill is not a retry decision
+
+
+# -- checksums ---------------------------------------------------------------
+
+
+def test_checksum_roundtrip_mismatch_and_unknown(tmp_path):
+    path = str(tmp_path / "artifact.bin")
+    with open(path, "wb") as fh:
+        fh.write(os.urandom(4096))
+    assert verify_checksum(path) is None  # no sidecar yet
+    digest = write_checksum(path)
+    assert digest == file_sha256(path)
+    assert verify_checksum(path) is True
+    with open(path, "r+b") as fh:  # tear the artifact
+        fh.truncate(1024)
+    assert verify_checksum(path) is False
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_full_state_cycle(telem):
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=clock)
+    assert br.state == "closed" and br.allow()
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == "open" and not br.allow()
+    assert br.retry_after_s() > 0
+    clock.advance(5.1)
+    assert br.state == "half_open" and br.allow()  # one probe through
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    states = [r["state"] for r in rows_of(telem, "breaker")]
+    assert states == ["open", "half_open", "closed"]
+
+
+def test_breaker_half_open_failure_reopens(telem):
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=2, cooldown_s=1.0, clock=clock)
+    br.record_failure(), br.record_failure()
+    clock.advance(1.1)
+    assert br.state == "half_open"
+    br.record_failure()  # the probe failed: straight back to open
+    assert br.state == "open" and not br.allow()
+    assert br.snapshot()["opens"] == 2
+
+
+def test_breaker_degrade_steps_pre_open():
+    br = CircuitBreaker(threshold=4, cooldown_s=1.0, clock=FakeClock())
+    assert br.degrade_steps() == 0
+    br.record_failure()
+    assert br.degrade_steps() == 1  # shed-tier pressure before opening
+    br.record_success()
+    assert br.degrade_steps() == 0
+
+
+def test_breaker_from_cfg_reads_resil_block():
+    cfg = make_cfg(
+        os.path.join(ROOT, "configs", "nerf", "lego.yaml"),
+        ["resil.breaker_threshold", "2", "resil.breaker_cooldown_s", "0.5"],
+    )
+    br = CircuitBreaker.from_cfg(cfg, clock=FakeClock())
+    assert br.threshold == 2 and br.cooldown_s == 0.5
+
+
+# -- finite guard + preemption primitives ------------------------------------
+
+
+def test_check_finite_raises_divergence_with_report(telem):
+    stats = {"loss": float("nan"), "psnr": 10.0}
+    with pytest.raises(DivergenceError) as err:
+        check_finite(stats, step=17)
+    assert err.value.step == 17
+    (row,) = rows_of(telem, "fault")
+    assert row["fault"] == "nan_loss" and row["injected"] is False
+
+
+def test_check_finite_nan_injection_poisons_copy(telem):
+    plan = FaultPlan().add("train.loss", "nan_loss")
+    clean = {"loss": 0.25}
+    with injecting(plan):
+        with pytest.raises(DivergenceError):
+            check_finite(clean, step=3)
+    assert clean["loss"] == 0.25  # caller's dict untouched
+    (row,) = rows_of(telem, "fault")
+    assert row["injected"] is True
+
+
+def test_preemption_guard_sigterm_sets_event_only():
+    guard = PreemptionGuard.install()
+    assert guard is not None and not guard.triggered
+    try:
+        signal.raise_signal(signal.SIGTERM)
+        assert guard.triggered  # flag set; no exception, no exit
+        guard.clear()
+        assert not guard.triggered
+    finally:
+        guard.uninstall()
+
+
+# -- serve: watchdog + breaker under chaos (FakeEngine harness) --------------
+
+
+class FakeEngine:
+    """MicroBatcher's engine surface with one real fixed-shape executable:
+    requests pad to BUCKET rows, so a chaos stream must hit exactly one
+    compile — the zero-steady-state-recompile invariant, cheaply."""
+
+    BUCKET = 128
+
+    def __init__(self, fail_times=0):
+        from nerf_replication_tpu.obs.hooks import CompileTracker
+
+        self.options = SimpleNamespace(
+            max_batch_rays=self.BUCKET, max_delay_s=0.0,
+            request_timeout_s=5.0, shed_queue_depths=[4, 8, 16, 32],
+        )
+        self.near, self.far = 2.0, 6.0
+        self.n_requests = 0
+        self.fail_times = fail_times
+        self.tracker = CompileTracker()
+        self._fn = self.tracker.wrap(
+            "fake_render", jax.jit(lambda x: x * 0.5)
+        )
+
+    def render_flat(self, flat, family):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("synthetic dispatch failure")
+        padded = np.zeros((self.BUCKET, flat.shape[1]), np.float32)
+        padded[: flat.shape[0]] = flat
+        out = np.asarray(self._fn(padded))[: flat.shape[0]]
+        return {"rgb_map_f": out[:, :3]}, {
+            "occupancy": flat.shape[0] / self.BUCKET,
+            "bucket_rays": self.BUCKET,
+        }
+
+
+def _rays(n, seed=0):
+    rng = np.random.default_rng(seed)
+    d = np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.1, (n, 3))
+    return np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (n, 1)), d], -1
+    ).astype(np.float32)
+
+
+@pytest.mark.chaos
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)  # the kill fault dies in the worker thread BY DESIGN; watchdog recovers
+def test_watchdog_restarts_worker_and_fails_inflight_fast(telem):
+    from nerf_replication_tpu.serve import MicroBatcher
+
+    engine = FakeEngine()
+    batcher = MicroBatcher(engine)
+    try:
+        batcher.submit(_rays(8), 2.0, 6.0).result(timeout=5.0)
+        plan = FaultPlan().add("serve.flush", "kill")
+        with injecting(plan):
+            fut = batcher.submit(_rays(8), 2.0, 6.0)
+            # the dying worker fails its in-flight batch immediately —
+            # no blocking out the full request timeout
+            with pytest.raises(RuntimeError, match="crashed mid-batch"):
+                fut.result(timeout=5.0)
+        # next submit trips the watchdog restart and completes normally
+        out = batcher.submit(_rays(8), 2.0, 6.0).result(timeout=5.0)
+        assert out["rgb_map_f"].shape == (8, 3)
+        assert batcher.worker_restarts == 1
+        health = batcher.health()
+        assert health["ok"] and health["worker_alive"]
+    finally:
+        batcher.close(drain=False)
+    kinds = {(r["point"], r["fault"]) for r in rows_of(telem, "fault")}
+    assert ("serve.flush", "kill") in kinds  # the injection
+    assert ("serve.flush", "crash") in kinds  # the watchdog's detection
+
+
+@pytest.mark.chaos
+def test_breaker_opens_sheds_and_recovers_compile_free(telem):
+    from nerf_replication_tpu.serve import MicroBatcher
+
+    clock = FakeClock()
+    engine = FakeEngine(fail_times=2)
+    batcher = MicroBatcher(
+        engine, clock=clock, start=False,
+        breaker=CircuitBreaker(threshold=2, cooldown_s=1.0, clock=clock),
+    )
+    # two consecutive dispatch failures (one per pumped batch)
+    for i in range(2):
+        fut = batcher.submit(_rays(4, seed=i), 2.0, 6.0)
+        batcher.pump()
+        with pytest.raises(RuntimeError, match="synthetic"):
+            fut.result(timeout=0)
+    # breaker open: submission fast-fails before touching the queue
+    with pytest.raises(BreakerOpenError) as exc:
+        batcher.submit(_rays(4), 2.0, 6.0)
+    assert exc.value.retry_after_s > 0
+    clock.advance(1.1)  # cooldown: half-open lets one probe through
+    fut = batcher.submit(_rays(4), 2.0, 6.0)
+    batcher.pump()
+    assert fut.result(timeout=0)["rgb_map_f"].shape == (4, 3)
+    assert batcher.breaker.state == "closed"
+    warm = engine.tracker.total_compiles()
+    for i in range(6):  # steady chaos-free stream after recovery
+        fut = batcher.submit(_rays(4 + i, seed=i), 2.0, 6.0)
+        batcher.pump()
+        fut.result(timeout=0)
+    assert engine.tracker.total_compiles() == warm  # zero recompiles
+    states = [r["state"] for r in rows_of(telem, "breaker")]
+    assert states == ["open", "half_open", "closed"]
+    assert any(r["point"] == "serve.dispatch"
+               for r in rows_of(telem, "fault"))  # errors were reported
+
+
+@pytest.mark.chaos
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)  # the kill fault dies in the worker thread BY DESIGN; watchdog recovers
+def test_chaos_smoke_mixed_faults_zero_steady_recompiles(telem):
+    """The tier-1 chaos smoke: kills, io_errors, and latency across a
+    request stream — the stream keeps completing, recovery is visible in
+    telemetry, and the executable never rebuilds."""
+    from nerf_replication_tpu.serve import MicroBatcher
+
+    engine = FakeEngine()
+    batcher = MicroBatcher(engine)
+    try:
+        batcher.submit(_rays(8), 2.0, 6.0).result(timeout=5.0)  # warm
+        warm = engine.tracker.total_compiles()
+        assert warm == 1
+        plan = FaultPlan(seed=3)
+        plan.add("serve.flush", "kill", after=2, times=1)
+        plan.add("serve.flush", "io_error", after=6, times=1)
+        plan.add("serve.flush", "latency", after=9, times=1)
+        ok = failed = 0
+        with injecting(plan):
+            for i in range(14):
+                try:
+                    batcher.submit(_rays(4 + i, seed=i), 2.0, 6.0) \
+                        .result(timeout=5.0)
+                    ok += 1
+                except (RuntimeError, OSError):
+                    failed += 1
+        assert plan.injected() == 3
+        assert ok >= 11 and failed <= 3  # only faulted flushes fail
+        assert batcher.worker_restarts == 1
+        assert engine.tracker.total_compiles() == warm  # the invariant
+    finally:
+        batcher.close(drain=False)
+    faults = rows_of(telem, "fault")
+    assert {r["fault"] for r in faults} >= {"kill", "io_error", "latency"}
+
+
+# -- torn artifacts degrade, never load garbage ------------------------------
+
+
+@pytest.mark.chaos
+def test_torn_aot_artifact_degrades_to_build(tmp_path, telem):
+    from nerf_replication_tpu.compile.artifacts import (
+        artifact_key,
+        artifact_path,
+        load_artifact,
+        save_artifact,
+    )
+
+    abstract = (jax.ShapeDtypeStruct((8,), np.float32),)
+    compiled = jax.jit(lambda x: x + 1).lower(*abstract).compile()
+    key = artifact_key("resil_fixture", abstract)
+    cache = str(tmp_path / "aot")
+    if not save_artifact(cache, key, compiled, name="resil_fixture"):
+        pytest.skip("backend cannot serialize executables")
+    assert load_artifact(cache, key) is not None
+    path = artifact_path(cache, key)
+    with open(path, "r+b") as fh:  # truncate the executable blob
+        fh.truncate(max(1, os.path.getsize(path) // 2))
+    # checksum catches the tear; caller gets None -> normal lazy build
+    assert load_artifact(cache, key) is None
+    (row,) = [r for r in rows_of(telem, "fault")
+              if r["fault"] == "checksum"]
+    assert row["point"] == "artifact.load" and row["injected"] is False
+
+
+@pytest.mark.chaos
+def test_torn_occupancy_npz_falls_back_to_slow_mode(tmp_path, telem):
+    from nerf_replication_tpu.renderer.occupancy import (
+        load_occupancy_pyramid,
+        save_occupancy_grid,
+    )
+
+    path = str(tmp_path / "grid.npz")
+    grid = np.zeros((16, 16, 16), bool)
+    grid[2:9, 3:11, 4:12] = True
+    save_occupancy_grid(path, grid, [[-1.5] * 3, [1.5] * 3], 0.5)
+    levels, _ = load_occupancy_pyramid(path)
+    assert np.array_equal(levels[0], grid)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(OSError):
+        load_occupancy_pyramid(path)
+    assert any(r["point"] == "occupancy.load"
+               for r in rows_of(telem, "fault"))
+
+
+@pytest.mark.chaos
+def test_torn_occupancy_renderer_surface_returns_false(tmp_path, telem,
+                                                       capsys):
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.renderer.occupancy import save_occupancy_grid
+    from nerf_replication_tpu.renderer.volume import make_renderer
+
+    root = str(tmp_path / "scene")
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=2,
+                   n_test=1)
+    cfg = tiny_cfg(root)
+    renderer = make_renderer(cfg, make_network(cfg))
+    path = str(tmp_path / "grid.npz")
+    save_occupancy_grid(path, np.ones((16, 16, 16), bool),
+                        [[-1.5] * 3, [1.5] * 3], 0.5)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    assert renderer.load_occupancy_grid(path) is False  # slow-mode fallback
+    assert renderer.occupancy_grid is None
+    assert "slow mode" in capsys.readouterr().out
+
+
+@pytest.mark.chaos
+def test_torn_latest_checkpoint_falls_back_to_numbered(tmp_path, telem):
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.train import make_train_state
+    from nerf_replication_tpu.train.checkpoint import (
+        load_model,
+        save_model,
+    )
+
+    root = str(tmp_path / "scene")
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=2,
+                   n_test=1)
+    cfg = tiny_cfg(root)
+    net = make_network(cfg)
+    state, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    model_dir = str(tmp_path / "ckpt")
+    save_model(model_dir, state, 0, None, latest=False)
+    stepped = state.replace(step=state.step + 7)
+    save_model(model_dir, stepped, 1, None, latest=True)
+
+    # tear latest/: delete part of the orbax bundle (save killed mid-write)
+    latest = os.path.join(model_dir, "latest")
+    victims = [os.path.join(dirpath, f)
+               for dirpath, _, files in os.walk(latest) for f in files]
+    assert victims
+    for v in victims:
+        os.remove(v)
+
+    template, _ = make_train_state(cfg, net, jax.random.PRNGKey(9))
+    restored, begin_epoch, _ = load_model(model_dir, template)
+    assert begin_epoch == 1  # fell back to the numbered epoch-0 bundle
+    assert int(restored.step) == int(state.step)
+    assert any(r["fault"] == "torn" and r["point"] == "checkpoint.load"
+               for r in rows_of(telem, "fault"))
+
+
+# -- training: rollback + SIGTERM preemption (full fit loop) -----------------
+
+
+def _fit_cfg(scene_root, tmp_path, extra=()):
+    """test_fit_dp-sized config: tiny net, short epochs, every step hits
+    the finite guard (log_interval 1), every epoch flushes latest/."""
+    return make_cfg(
+        os.path.join(ROOT, "configs", "nerf", "lego.yaml"),
+        [
+            "scene", "procedural",
+            "train_dataset.data_root", str(scene_root),
+            "test_dataset.data_root", str(scene_root),
+            "train_dataset.H", "16", "train_dataset.W", "16",
+            "test_dataset.H", "16", "test_dataset.W", "16",
+            "task_arg.N_rays", "128",
+            "task_arg.N_samples", "16",
+            "task_arg.N_importance", "16",
+            "task_arg.chunk_size", "256",
+            "task_arg.precrop_iters", "0",
+            "network.nerf.W", "32",
+            "network.nerf.D", "2",
+            "network.nerf.skips", "[1]",
+            "network.xyz_encoder.freq", "4",
+            "network.dir_encoder.freq", "2",
+            "ep_iter", "4",
+            "train.epoch", "2",
+            "eval_ep", "100",
+            "save_ep", "100",
+            "save_latest_ep", "1",
+            "log_interval", "1",
+            "skip_eval", "True",
+            "result_dir", str(tmp_path / "result"),
+            "trained_model_dir", str(tmp_path / "model"),
+            "trained_config_dir", str(tmp_path / "config"),
+            "record_dir", str(tmp_path / "record"),
+            *extra,
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def fit_scene(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_resil"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=6,
+                   n_test=2)
+    return root
+
+
+@pytest.mark.chaos
+def test_divergence_rolls_back_to_last_good_checkpoint(fit_scene, tmp_path):
+    """A NaN loss mid-epoch-1 must roll training back to the epoch-0
+    checkpoint and still finish the run — not crash, not train on NaNs."""
+    from nerf_replication_tpu.train.trainer import fit
+
+    cfg = _fit_cfg(fit_scene, tmp_path)
+    # with log_interval=1 every step is a finite-guard check; the 5th
+    # check sits inside epoch 1, after epoch 0's latest/ flush
+    plan = FaultPlan().add("train.loss", "nan_loss", after=4, times=1)
+    with injecting(plan):
+        state = fit(cfg)
+    assert plan.injected() == 1
+    leaves = jax.tree.leaves(state.params)
+    assert all(np.all(np.isfinite(np.asarray(leaf))) for leaf in leaves)
+    telem = os.path.join(str(cfg.record_dir), "telemetry.jsonl")
+    faults = rows_of(telem, "fault")
+    assert any(r["fault"] == "nan_loss" and r["injected"] for r in faults)
+    assert any(r["fault"] == "rollback" for r in faults)
+
+
+@pytest.mark.chaos
+def test_divergence_without_checkpoint_reraises(fit_scene, tmp_path):
+    """Nothing on disk to roll back to -> the failure must surface, not
+    silently restart from the poisoned state."""
+    from nerf_replication_tpu.train.trainer import fit
+
+    cfg = _fit_cfg(fit_scene, tmp_path, ["save_latest_ep", "100"])
+    plan = FaultPlan().add("train.loss", "nan_loss", after=1, times=1)
+    with injecting(plan):
+        with pytest.raises(DivergenceError):
+            fit(cfg)
+
+
+@pytest.mark.chaos
+def test_sigterm_preemption_flushes_atomic_latest_and_resumes_bitwise(
+    fit_scene, tmp_path
+):
+    """The production preemption path end-to-end: a real SIGTERM lands
+    mid-epoch, the loop exits at the next burst boundary after flushing
+    one atomic latest/, and the flushed bundle equals the returned live
+    state bitwise (parity seat: test_ngp_warm_start_resume_bitwise_parity
+    covers the NGP phase sidecar side of the same contract)."""
+    from nerf_replication_tpu.train import make_train_state
+    from nerf_replication_tpu.train.checkpoint import load_model
+    from nerf_replication_tpu.train.trainer import fit
+    from nerf_replication_tpu.models import make_network
+
+    cfg = _fit_cfg(fit_scene, tmp_path, ["train.epoch", "3"])
+    calls = []
+
+    def preempting_log(msg):
+        calls.append(msg)
+        if len(calls) == 2:  # mid-epoch-0: a real signal, not a mock
+            signal.raise_signal(signal.SIGTERM)
+
+    state = fit(cfg, log=preempting_log)
+    assert any("SIGTERM" in str(m) for m in calls)
+    steps_done = int(state.step)
+    assert 0 < steps_done < 3 * 4  # preempted before the full run
+
+    # the flushed latest/ IS the returned state, bitwise
+    net = make_network(cfg)
+    template, _ = make_train_state(cfg, net, jax.random.PRNGKey(5))
+    restored, begin_epoch, _ = load_model(cfg.trained_model_dir, template)
+    assert begin_epoch >= 1
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    # resume completes the remaining epochs from the flushed state
+    resumed = fit(cfg)
+    assert int(resumed.step) > steps_done
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # kill/resume matrix: several full fit() runs
+@pytest.mark.parametrize("kill_point", ["checkpoint.save",
+                                        "checkpoint.save.sidecar"])
+def test_kill_during_save_then_resume_completes(fit_scene, tmp_path,
+                                                kill_point):
+    """A kill landing inside the save window must leave a resumable dir:
+    the rerun restores whatever epoch survived and completes."""
+    from nerf_replication_tpu.train.trainer import fit
+
+    cfg = _fit_cfg(fit_scene, tmp_path, ["train.epoch", "3"])
+    plan = FaultPlan().add(kill_point, "kill", after=1, times=1)
+    with injecting(plan):
+        with pytest.raises(SimulatedKill):
+            fit(cfg)
+    assert plan.injected() == 1
+    state = fit(cfg)  # resume from whatever the kill left behind
+    assert int(state.step) == 3 * 4  # full trajectory completed

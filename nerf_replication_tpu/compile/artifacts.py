@@ -15,10 +15,14 @@ invalidate them:
 
 Not every executable serializes: the payload pickles the input/output
 pytree *treedefs*, and optax optimizer states close over local functions
-that cannot pickle. ``save_artifact`` therefore degrades silently to "no
-artifact" (the persistent XLA compilation cache still makes the rebuild
-cheap) — serve-engine executables, whose trees are plain dicts, round-trip
-fine and are the case the zero-build serve restart depends on.
+that cannot pickle. Executables materialized FROM the persistent XLA
+compilation cache are a subtler failure — they serialize without error
+but the payload omits their jitted symbols, so every later deserialize
+fails with "Symbols not found". ``save_artifact`` round-trip-verifies the
+payload before writing and degrades to "no artifact" on any failure (the
+persistent XLA compilation cache still makes the rebuild cheap) —
+serve-engine executables compiled fresh, whose trees are plain dicts,
+round-trip fine and are the case the zero-build serve restart depends on.
 
 Layout: one ``<key>.aot`` file per artifact under the artifact dir
 (default: ``<repo>/data/jax_cache/aot``, riding next to the persistent XLA
@@ -107,6 +111,13 @@ def save_artifact(cache_dir: str, key: str, compiled, name: str = "") -> bool:
 
         payload, in_tree, out_tree = serialize_executable.serialize(compiled)
         blob = pickle.dumps((payload, in_tree, out_tree))
+        # round-trip gate: an executable the persistent XLA compilation
+        # cache materialized (rather than compiled fresh) serializes a
+        # payload whose jitted symbols are not embedded — it pickles fine
+        # but every later deserialize fails with "Symbols not found".
+        # Verifying here keeps poisoned blobs off disk entirely, so a warm
+        # restart can trust any artifact that exists.
+        serialize_executable.deserialize_and_load(payload, in_tree, out_tree)
     # graftlint: ok(swallow: _skip emits the compile row with skipped_reason)
     except Exception as exc:
         _skip(name, key, f"unserializable: {type(exc).__name__}: {exc}")

@@ -163,6 +163,24 @@ def default_cfg() -> ConfigNode:
         }
     )
 
+    # multi-scene serving fleet (nerf_replication_tpu/fleet, docs/fleet.md):
+    # a manifest (or scan_dir) names the scenes; the residency manager
+    # keeps an LRU of device-resident scenes under hbm_budget_mb — sized
+    # from real leaf nbytes — with pinned leases for in-flight batches and
+    # async host->device prefetch. Both discovery knobs empty = classic
+    # single-scene serving; default_scene is the request alias for the
+    # engine's own checkpoint.
+    cfg.fleet = ConfigNode(
+        {
+            "manifest": "",             # scene manifest JSON (docs/fleet.md)
+            "scan_dir": "",             # or: discover scenes by directory scan
+            "hbm_budget_mb": 256.0,     # resident-scene byte budget
+            "prefetch": True,           # background h2d on first sight
+            "verify_checksums": True,   # tree-sha256 gate on scene checkpoints
+            "default_scene": "default",  # alias for the engine's own scene
+        }
+    )
+
     # AOT compile registry (nerf_replication_tpu/compile, docs/compilation.md):
     # aot routes every registered jitted entrypoint through
     # lower().compile() up front on host threads (overlapping dataset /

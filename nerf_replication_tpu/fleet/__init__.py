@@ -1,0 +1,73 @@
+"""fleet/: multi-scene serving — scene registry + HBM-budgeted residency.
+
+One trained scene per :class:`~nerf_replication_tpu.serve.RenderEngine`
+was the last single-tenant assumption in the serving stack. This package
+removes it: a :class:`SceneRegistry` names every scene's artifacts
+(manifest or directory scan), and a :class:`ResidencyManager` keeps an
+LRU of device-resident scenes under a byte budget with pinned leases and
+async prefetch — all rendered through the engine's ONE prewarmed
+bucket×tier executable family, zero per-scene compiles (docs/fleet.md).
+
+``fleet_from_cfg`` is the wiring surface: it reads the ``fleet:`` config
+block, builds the registry + residency, and attaches them to an engine.
+"""
+
+from __future__ import annotations
+
+from .errors import (
+    ResidencyOverloadError,
+    SceneCompatError,
+    SceneError,
+    SceneLoadError,
+    UnknownSceneError,
+)
+from .registry import SceneRecord, SceneRegistry, checkpoint_loader
+from .residency import ResidencyManager, SceneData
+
+__all__ = [
+    "ResidencyManager",
+    "ResidencyOverloadError",
+    "SceneCompatError",
+    "SceneData",
+    "SceneError",
+    "SceneLoadError",
+    "SceneRecord",
+    "SceneRegistry",
+    "UnknownSceneError",
+    "checkpoint_loader",
+    "fleet_from_cfg",
+]
+
+
+def fleet_from_cfg(cfg, engine):
+    """Build + attach the fleet for ``engine`` from the ``fleet:`` block.
+
+    Returns the :class:`ResidencyManager`, or None when no manifest or
+    scan directory is configured (single-scene serving, the API-compatible
+    default). The byte budget comes from ``fleet.hbm_budget_mb`` and is
+    enforced against real leaf ``nbytes`` at load time."""
+    from ..resil import retry_params
+
+    f = cfg.get("fleet", {})
+    manifest = str(f.get("manifest", ""))
+    scan_dir = str(f.get("scan_dir", ""))
+    if not manifest and not scan_dir:
+        return None
+    registry = (SceneRegistry.from_manifest(manifest) if manifest
+                else SceneRegistry.scan(scan_dir))
+    loader = checkpoint_loader(
+        engine.params, default_near=engine.near, default_far=engine.far
+    )
+    residency = ResidencyManager(
+        registry, loader,
+        budget_bytes=int(float(f.get("hbm_budget_mb", 256.0)) * (1 << 20)),
+        prefetch=bool(f.get("prefetch", True)),
+        verify_checksums=bool(f.get("verify_checksums", True)),
+        cache_entries=engine.options.cache_entries,
+        pose_decimals=engine.options.pose_decimals,
+        retry_kw=retry_params(cfg),
+    )
+    engine.attach_fleet(
+        residency, default_scene=str(f.get("default_scene", "default"))
+    )
+    return residency

@@ -1,0 +1,421 @@
+"""HBM-budgeted scene residency: which scenes live on the device.
+
+The serve executables already take ``(params, chunks, grid, bbox)`` as
+runtime arguments, so ONE prewarmed bucket×tier family can render every
+scene — the scaling bottleneck is device memory, not compile time (the
+NerfAcc observation: occupancy-grid rendering makes per-ray compute
+cheap, so a fleet is bounded by how many representations fit on-chip).
+The :class:`ResidencyManager` turns that bottleneck into a managed
+budget:
+
+* ``acquire(scene_id)`` returns device-resident ``SceneData`` (params +
+  grid + bbox), loading on miss and **evicting LRU scenes** when the
+  configured byte budget — sized from the real leaf ``nbytes``, not an
+  estimate — would overflow;
+* acquire/release are **pin/unpin refcounts**: an in-flight batch holds
+  a lease, and a pinned scene can never be evicted under it. If every
+  resident scene is pinned and the budget is full, admission fails with
+  :class:`ResidencyOverloadError` (503 + Retry-After at the HTTP edge)
+  rather than deadlocking or over-committing;
+* ``prefetch(scene_id)`` starts the host load + h2d on a background
+  thread, so the first request for a new scene overlaps its transfer
+  with the batch currently rendering — an ``acquire`` that lands on an
+  in-flight prefetch joins it instead of double-loading;
+* each scene keeps its own :class:`~..serve.cache.PoseCache` (host-side,
+  so it survives eviction cycles — a re-admitted scene's landmark views
+  are still warm).
+
+Loads run through the ``fleet.load`` fault point with bounded retry, and
+checkpoint directories are gated by a tree SHA-256 (resil/checksum): a
+torn scene emits a ``torn`` fault row and fails THAT scene's requests
+only. Every materialization emits a ``scene_load`` row and every
+eviction a ``scene_evict`` row (obs/schema.py), so ``/stats`` and
+``tlm_report`` see residency churn directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+from dataclasses import dataclass, replace
+
+from ..obs import get_emitter
+from ..resil import fault_point, report, verify_tree_checksum, with_retry
+from ..serve.cache import PoseCache
+from .errors import ResidencyOverloadError, SceneLoadError
+
+# LRU recency is a monotone counter, not a wall clock: eviction order is
+# a pure function of the acquire sequence (deterministic under test)
+_TOUCH = 0
+
+
+@dataclass(frozen=True)
+class SceneData:
+    """One scene's render inputs (host- or device-side; same fields the
+    engine's executables take at dispatch). ``nbytes`` is filled by the
+    manager from the real leaf sizes once known."""
+
+    scene_id: str
+    params: object
+    grid: object = None
+    bbox: object = None
+    near: float = 2.0
+    far: float = 6.0
+    nbytes: int = 0
+
+
+class _Resident:
+    """Book-keeping wrapper around one device-resident scene."""
+
+    __slots__ = ("data", "refcount", "touch", "source", "ever_acquired")
+
+    def __init__(self, data: SceneData, source: str):
+        self.data = data
+        self.refcount = 0
+        self.touch = 0
+        self.source = source          # "cold" | "prefetch"
+        self.ever_acquired = False
+
+
+class _Load:
+    """One in-flight load (cold or prefetch) other threads can join."""
+
+    __slots__ = ("event", "error", "source")
+
+    def __init__(self, source: str):
+        self.event = threading.Event()
+        self.error: BaseException | None = None
+        self.source = source
+
+
+def _tree_nbytes(data: SceneData) -> int:
+    """Real byte footprint: every params leaf + grid + bbox."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(data.params):
+        total += int(getattr(leaf, "nbytes", 0))
+    for aux in (data.grid, data.bbox):
+        if aux is not None:
+            total += int(getattr(aux, "nbytes", 0))
+    return total
+
+
+class ResidencyManager:
+    """Byte-budgeted LRU of device-resident scenes with pinned leases."""
+
+    def __init__(self, registry, loader, budget_bytes: int, *,
+                 prefetch: bool = True, verify_checksums: bool = True,
+                 cache_entries: int = 64, pose_decimals: int = 3,
+                 validate=None, retry_kw: dict | None = None):
+        self.registry = registry
+        self.loader = loader
+        self.budget_bytes = int(budget_bytes)
+        self.prefetch_enabled = bool(prefetch)
+        self.verify_checksums = bool(verify_checksums)
+        self.cache_entries = int(cache_entries)
+        self.pose_decimals = int(pose_decimals)
+        self.validate = validate
+        self.retry_kw = dict(retry_kw or {})
+        self._cond = threading.Condition()
+        self._resident: OrderedDict[str, _Resident] = OrderedDict()
+        self._loading: dict[str, _Load] = {}
+        self._reserved = 0            # bytes admitted but not yet committed
+        self._pose_caches: dict[str, PoseCache] = {}
+        # counters (read via stats(); mutated under the lock)
+        self.loads = 0
+        self.cold_loads = 0
+        self.prefetch_issued = 0
+        self.prefetch_hits = 0
+        self.warm_hits = 0
+        self.evictions = 0
+        self.overloads = 0
+        self.load_errors = 0
+        self.bytes_loaded = 0
+        self.bytes_evicted = 0
+
+    # -- acquire / release ----------------------------------------------------
+
+    def acquire(self, scene_id: str) -> SceneData:
+        """Pin ``scene_id`` on the device and return its SceneData.
+
+        Loads on miss (joining an in-flight prefetch when one is
+        running); the caller MUST :meth:`release` — ``lease`` is the
+        safe surface."""
+        global _TOUCH
+        while True:
+            with self._cond:
+                resident = self._resident.get(scene_id)
+                if resident is not None:
+                    resident.refcount += 1
+                    _TOUCH += 1
+                    resident.touch = _TOUCH
+                    self._resident.move_to_end(scene_id)
+                    if not resident.ever_acquired:
+                        # first pin after materialization: a prefetch hit,
+                        # or the tail of this thread's own cold load
+                        # (already counted at load start)
+                        if resident.source == "prefetch":
+                            self.prefetch_hits += 1
+                    else:
+                        self.warm_hits += 1
+                    resident.ever_acquired = True
+                    return resident.data
+                load = self._loading.get(scene_id)
+                if load is None:
+                    # miss with no in-flight load: this thread cold-loads
+                    load = _Load("cold")
+                    self._loading[scene_id] = load
+                    self.cold_loads += 1
+                    started_here = True
+                else:
+                    started_here = False
+            if not started_here:
+                load.event.wait()
+                if load.error is not None:
+                    raise load.error
+                continue  # committed by the loader thread; loop to pin
+            try:
+                self._load_and_commit(scene_id, source="cold")
+            except BaseException as err:
+                load.error = err
+                raise
+            finally:
+                with self._cond:
+                    self._loading.pop(scene_id, None)
+                load.event.set()
+
+    def release(self, scene_id: str) -> None:
+        with self._cond:
+            resident = self._resident.get(scene_id)
+            if resident is not None and resident.refcount > 0:
+                resident.refcount -= 1
+                self._cond.notify_all()
+
+    @contextmanager
+    def lease(self, scene_id: str):
+        """``with residency.lease(sid) as data:`` — pinned for the block."""
+        data = self.acquire(scene_id)
+        try:
+            yield data
+        finally:
+            self.release(scene_id)
+
+    # -- prefetch -------------------------------------------------------------
+
+    def prefetch(self, scene_id: str) -> bool:
+        """Start a background load of ``scene_id``; True if one was
+        actually started (False: disabled / resident / already loading /
+        unknown scene — prefetch never raises, errors surface on the
+        eventual acquire)."""
+        if not self.prefetch_enabled or scene_id not in self.registry:
+            return False
+        with self._cond:
+            if scene_id in self._resident or scene_id in self._loading:
+                return False
+            load = _Load("prefetch")
+            self._loading[scene_id] = load
+            self.prefetch_issued += 1
+
+        def _main():
+            try:
+                self._load_and_commit(scene_id, source="prefetch")
+            # graftlint: ok(swallow: error re-raised on the joining acquire; load_errors counted here)
+            except BaseException as err:
+                load.error = err
+                with self._cond:
+                    self.load_errors += 1
+            finally:
+                with self._cond:
+                    self._loading.pop(scene_id, None)
+                load.event.set()
+
+        threading.Thread(
+            target=_main, name=f"fleet-prefetch-{scene_id}", daemon=True
+        ).start()
+        return True
+
+    def wait_loaded(self, scene_id: str, timeout: float | None = None) -> bool:
+        """Block until no load is in flight for ``scene_id`` (test/bench
+        barrier; True unless the wait timed out)."""
+        with self._cond:
+            load = self._loading.get(scene_id)
+        return load.event.wait(timeout) if load is not None else True
+
+    # -- load / evict core ----------------------------------------------------
+
+    def _load_and_commit(self, scene_id: str, source: str) -> None:
+        global _TOUCH
+        record = self.registry.get(scene_id)
+        t0 = time.perf_counter()
+        host = self._load_host(record)
+        if self.validate is not None:
+            self.validate(host)       # SceneCompatError on mismatch
+        nbytes = _tree_nbytes(host)
+        if nbytes > self.budget_bytes:
+            raise ResidencyOverloadError(
+                scene_id,
+                f"scene {scene_id!r} needs {nbytes} bytes, over the whole "
+                f"fleet budget ({self.budget_bytes})",
+            )
+        self._admit(scene_id, nbytes)
+        try:
+            import jax
+
+            device = jax.tree.map(jax.device_put, (
+                host.params, host.grid, host.bbox
+            ))
+        except BaseException:
+            with self._cond:
+                self._reserved -= nbytes
+                self._cond.notify_all()
+            raise
+        params, grid, bbox = device
+        data = replace(host, params=params, grid=grid, bbox=bbox,
+                       nbytes=nbytes)
+        with self._cond:
+            self._reserved -= nbytes
+            self._cond.notify_all()
+            resident = _Resident(data, source)
+            _TOUCH += 1
+            resident.touch = _TOUCH
+            self._resident[scene_id] = resident
+            self._resident.move_to_end(scene_id)
+            self.loads += 1
+            self.bytes_loaded += nbytes
+            n_res, res_bytes = len(self._resident), self._resident_bytes()
+        get_emitter().emit(
+            "scene_load", scene=scene_id, bytes=nbytes, source=source,
+            load_s=round(time.perf_counter() - t0, 4),
+            resident=n_res, resident_bytes=res_bytes,
+        )
+
+    def _load_host(self, record) -> SceneData:
+        """Host-side artifact load: fault point + checksum gate + retry."""
+        def _attempt():
+            fault_point("fleet.load", path=record.checkpoint or None)
+            if self.verify_checksums and record.checkpoint:
+                ok = verify_tree_checksum(record.checkpoint)
+                if ok is False:
+                    report("fleet.load", "torn", path=record.checkpoint,
+                           detail=f"scene {record.scene_id!r}: checkpoint "
+                                  "tree checksum mismatch")
+                    raise SceneLoadError(
+                        record.scene_id,
+                        f"scene {record.scene_id!r}: torn checkpoint "
+                        f"(tree checksum mismatch at {record.checkpoint})",
+                    )
+            return self.loader(record)
+
+        try:
+            return with_retry(_attempt, point="fleet.load", **self.retry_kw)
+        except SceneLoadError:
+            with self._cond:
+                self.load_errors += 1
+            raise
+        except OSError as err:
+            with self._cond:
+                self.load_errors += 1
+            report("fleet.load", "io_error", path=record.checkpoint or None,
+                   detail=f"{type(err).__name__}: {err}"[:200])
+            raise SceneLoadError(
+                record.scene_id,
+                f"scene {record.scene_id!r}: load failed ({err})",
+            ) from err
+
+    def _resident_bytes(self) -> int:
+        return sum(r.data.nbytes for r in self._resident.values())
+
+    def _admit(self, scene_id: str, nbytes: int) -> None:
+        """Reserve ``nbytes`` of budget, evicting cold LRU scenes first.
+
+        Eviction happens BEFORE the h2d transfer so the budget is never
+        transiently over-committed; pinned scenes are skipped, and if
+        nothing evictable remains the admission fails."""
+        with self._cond:
+            while (self._resident_bytes() + self._reserved + nbytes
+                   > self.budget_bytes):
+                victim_id = next(
+                    (sid for sid, r in self._resident.items()
+                     if r.refcount == 0),
+                    None,
+                )
+                if victim_id is None:
+                    if self._reserved > 0:
+                        # a concurrent load holds the missing bytes; once
+                        # it commits (or fails) its scene is evictable
+                        # (or its reservation returns) — wait, don't fail
+                        self._cond.wait(timeout=0.1)
+                        continue
+                    self.overloads += 1
+                    raise ResidencyOverloadError(
+                        scene_id,
+                        f"cannot admit scene {scene_id!r} ({nbytes} bytes): "
+                        f"all {len(self._resident)} resident scenes are "
+                        "pinned by in-flight batches",
+                    )
+                victim = self._resident.pop(victim_id)
+                self.evictions += 1
+                self.bytes_evicted += victim.data.nbytes
+                n_res, res_bytes = len(self._resident), self._resident_bytes()
+                get_emitter().emit(
+                    "scene_evict", scene=victim_id,
+                    bytes=victim.data.nbytes, reason="budget",
+                    resident=n_res, resident_bytes=res_bytes,
+                )
+            self._reserved += nbytes
+
+    # -- per-scene pose caches ------------------------------------------------
+
+    def pose_cache(self, scene_id: str) -> PoseCache:
+        """The scene's pose->image LRU (host-side: survives eviction, so
+        a re-admitted scene's landmark views stay warm)."""
+        with self._cond:
+            cache = self._pose_caches.get(scene_id)
+            if cache is None:
+                cache = PoseCache(capacity=self.cache_entries,
+                                  decimals=self.pose_decimals)
+                self._pose_caches[scene_id] = cache
+            return cache
+
+    # -- introspection --------------------------------------------------------
+
+    def resident_ids(self) -> list[str]:
+        """LRU -> MRU order (index 0 is the next eviction candidate)."""
+        with self._cond:
+            return list(self._resident)
+
+    def pinned_ids(self) -> list[str]:
+        with self._cond:
+            return [sid for sid, r in self._resident.items() if r.refcount]
+
+    def stats(self) -> dict:
+        with self._cond:
+            loads = self.loads
+            cold = self.cold_loads
+            hits = self.prefetch_hits
+            first_loads = hits + cold
+            return {
+                "known_scenes": len(self.registry),
+                "resident": list(self._resident),
+                "pinned": [s for s, r in self._resident.items() if r.refcount],
+                "resident_bytes": self._resident_bytes(),
+                "budget_bytes": self.budget_bytes,
+                "loads": loads,
+                "cold_loads": cold,
+                "warm_hits": self.warm_hits,
+                "prefetch_issued": self.prefetch_issued,
+                "prefetch_hits": hits,
+                "prefetch_hit_rate": (hits / first_loads) if first_loads
+                                     else 0.0,
+                "evictions": self.evictions,
+                "overloads": self.overloads,
+                "load_errors": self.load_errors,
+                "bytes_loaded": self.bytes_loaded,
+                "bytes_evicted": self.bytes_evicted,
+                "pose_caches": {
+                    sid: c.stats() for sid, c in self._pose_caches.items()
+                },
+            }

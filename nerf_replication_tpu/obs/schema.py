@@ -95,14 +95,31 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
     "serve_request": (
         {"latency_s": _NUM, "n_rays": _NUM, "tier": (str,)},
         {"queue_s": _NUM, "status": (str,), "cache_hit": (bool, int),
-         "n_buckets": _NUM, "bucket_rays": _NUM},
+         "n_buckets": _NUM, "bucket_rays": _NUM, "scene": (str,)},
     ),
     # one per coalesced engine dispatch: how many requests/rays rode the
-    # batch and how full the padded buckets were (occupancy = real/padded)
+    # batch and how full the padded buckets were (occupancy = real/padded).
+    # scene: which registry scene the batch rendered (multi-tenant serving;
+    # absent on default-scene batches)
     "serve_batch": (
         {"n_requests": _NUM, "n_rays": _NUM, "occupancy": _NUM},
         {"tier": (str,), "render_s": _NUM, "queue_depth": _NUM,
-         "bucket_rays": _NUM},
+         "bucket_rays": _NUM, "scene": (str,)},
+    ),
+    # -- fleet rows (nerf_replication_tpu/fleet, docs/fleet.md) --------------
+    # one per scene materialization onto the device: how it arrived
+    # (source: cold = a request blocked on the load, prefetch = the
+    # background thread had it ready), the REAL byte footprint charged
+    # against fleet.hbm_budget_mb, and the residency set after commit
+    "scene_load": (
+        {"scene": (str,), "bytes": _NUM, "source": (str,)},
+        {"load_s": _NUM, "resident": _NUM, "resident_bytes": _NUM},
+    ),
+    # one per budget eviction: the LRU unpinned scene dropped to admit a
+    # new one (reason is "budget" today; kept open for TTL/manual evicts)
+    "scene_evict": (
+        {"scene": (str,), "bytes": _NUM},
+        {"reason": (str,), "resident": _NUM, "resident_bytes": _NUM},
     ),
     # one per load-shed decision: the backlog that triggered a degraded tier
     "serve_shed": (
@@ -231,6 +248,14 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # discriminator key (bench_family is first-match), hence the
     # traversal-specific field names.
     "traversal_mode": ("grid_occ", "candidates_per_ray", "rays_per_s"),
+    # scripts/serve_bench.py --scenes/--churn rows (BENCH_FLEET.jsonl): one
+    # row per multi-scene churn run — residency churn (evictions, prefetch
+    # hit rate) next to the scene-switch latency penalty (p95 of requests
+    # that switched scenes vs stayed on one). NOTE: must not carry any
+    # earlier discriminator key (bench_family is first-match), hence
+    # fleet_mode rather than reusing serve_mode.
+    "fleet_mode": ("n_scenes", "evictions", "prefetch_hit_rate",
+                   "p95_same_ms", "p95_switch_ms"),
 }
 
 

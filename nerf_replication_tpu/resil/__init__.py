@@ -11,8 +11,11 @@ from .breaker import BreakerOpenError, CircuitBreaker
 from .checksum import (
     SIDECAR_SUFFIX,
     file_sha256,
+    tree_sha256,
     verify_checksum,
+    verify_tree_checksum,
     write_checksum,
+    write_tree_checksum,
 )
 from .faults import (
     FAULT_KINDS,
@@ -51,9 +54,12 @@ __all__ = [
     "install",
     "report",
     "retry_params",
+    "tree_sha256",
     "truncate_file",
     "uninstall",
     "verify_checksum",
+    "verify_tree_checksum",
     "with_retry",
     "write_checksum",
+    "write_tree_checksum",
 ]

@@ -55,6 +55,7 @@ FAULT_POINTS: tuple[str, ...] = (
     "serve.dispatch",           # serve/engine.py: per-bucket dispatch
     "serve.flush",              # serve/batcher.py: worker batch flush
     "train.loss",               # train loop's fetched loss scalar (nan_loss)
+    "fleet.load",               # fleet/residency.py: before a scene load
 )
 
 FAULT_KINDS: tuple[str, ...] = (
@@ -210,10 +211,25 @@ def fault_point(point: str, path: str | None = None,
 
 
 def truncate_file(path: str, frac: float = 0.5) -> None:
-    """Tear a file on disk: keep the leading ``frac`` of its bytes."""
+    """Tear a file on disk: keep the leading ``frac`` of its bytes.
+
+    A directory path (a scene checkpoint, an orbax bundle) tears its
+    largest file — deterministic, and the most likely victim of a real
+    torn write — so ``truncate`` faults compose with dir-level artifacts
+    and their tree checksums."""
     try:
         import os
 
+        if os.path.isdir(path):
+            files = sorted(
+                (os.path.getsize(os.path.join(d, f)),
+                 os.path.join(d, f))
+                for d, _dirs, fnames in os.walk(path) for f in fnames
+                if not f.endswith(".sha256")
+            )
+            if not files:
+                return
+            path = files[-1][1]
         size = os.path.getsize(path)
         with open(path, "r+b") as fh:
             fh.truncate(max(0, int(size * frac)))

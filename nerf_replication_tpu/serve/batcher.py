@@ -26,10 +26,12 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..fleet.errors import SceneError
 from ..obs import get_emitter
 from ..renderer.gate import check_baked_bounds
 from ..resil import BreakerOpenError, CircuitBreaker, fault_point, report
@@ -76,6 +78,7 @@ class _Pending:
     rays: np.ndarray
     future: ServeFuture
     t_enqueued: float
+    scene: str | None = None
     n_rays: int = field(init=False)
 
     def __post_init__(self):
@@ -104,6 +107,7 @@ class MicroBatcher:
         self.n_timeouts = 0
         self.n_completed = 0
         self.n_dispatch_errors = 0
+        self.n_scene_errors = 0
         self.worker_restarts = 0
         self._inflight: list[_Pending] = []
         self._worker_dead = False
@@ -164,25 +168,37 @@ class MicroBatcher:
 
     # -- submission -----------------------------------------------------------
 
-    def submit(self, rays, near, far) -> ServeFuture:
+    def submit(self, rays, near, far, scene: str | None = None) -> ServeFuture:
         """Enqueue a [N, C] ray request; returns a future.
 
         Bounds are validated HERE (BakedBoundsError raises to the caller
-        synchronously) so a bad request never occupies queue capacity.
-        With the circuit breaker open, submission fast-fails with
-        :class:`BreakerOpenError` (503 + Retry-After at the HTTP edge)
-        instead of queueing work onto a known-bad dispatch path."""
+        synchronously) so a bad request never occupies queue capacity,
+        and an unknown ``scene`` raises :class:`UnknownSceneError` (404)
+        the same way. A known non-resident scene kicks an async prefetch
+        immediately, overlapping its host->device transfer with whatever
+        batch is currently rendering. With the circuit breaker open,
+        submission fast-fails with :class:`BreakerOpenError` (503 +
+        Retry-After at the HTTP edge) instead of queueing work onto a
+        known-bad dispatch path."""
         if not self.breaker.allow():
             raise BreakerOpenError(self.breaker.retry_after_s())
         self.ensure_worker()
         check_baked_bounds(self.engine.near, self.engine.far, near, far,
                            surface="serve micro-batcher")
+        # scene=None short-circuits before any fleet-era engine method so
+        # duck-typed engines without multi-scene support still batch.
+        if scene is None or self.engine._is_default_scene(scene):
+            scene = None
+        else:
+            self.engine.require_scene(scene)   # 404 before queueing
+            self.engine.prefetch_scene(scene)  # overlap h2d with current work
         rays = np.asarray(rays, np.float32)
         if rays.ndim != 2 or rays.shape[0] == 0:
             raise ValueError(
                 f"rays must be a non-empty [N, C] array, got {rays.shape}"
             )
-        pending = _Pending(rays, ServeFuture(rays.shape[0]), self.clock())
+        pending = _Pending(rays, ServeFuture(rays.shape[0]), self.clock(),
+                           scene=scene)
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is closed")
@@ -214,7 +230,13 @@ class MicroBatcher:
 
     def _cut_batch(self) -> tuple[list[_Pending], int] | None:
         """Block until a batch edge fires; pop and return (batch, depth
-        left behind). None only on close with an empty queue."""
+        left behind). None only on close with an empty queue.
+
+        A batch is cut for ONE scene — the queue head's — because the
+        engine dispatches one (params, grid, bbox) set per flat call.
+        Requests for other scenes stay queued in arrival order, so a
+        mixed-tenant stream coalesces per-scene instead of fragmenting
+        into single-request batches."""
         with self._cond:
             while not self._queue and not self._stop:
                 self._cond.wait()
@@ -222,25 +244,37 @@ class MicroBatcher:
                 return None
             max_rays = self.options.max_batch_rays
             while not self._stop:
-                total = sum(p.n_rays for p in self._queue)
+                head_scene = self._queue[0].scene
+                total = sum(p.n_rays for p in self._queue
+                            if p.scene == head_scene)
                 if total >= max_rays:
-                    break  # max-batch edge
+                    break  # max-batch edge (for the head scene)
                 remaining = self.options.max_delay_s - (
                     self.clock() - self._queue[0].t_enqueued
                 )
                 if remaining <= 0:
                     break  # max-delay edge
                 self._cond.wait(timeout=remaining)
-            # pop whole requests up to the ray budget (always >= 1, so an
-            # oversize single request still renders — the engine splits it)
+            # pop whole head-scene requests up to the ray budget (always
+            # >= 1, so an oversize single request still renders — the
+            # engine splits it); other scenes and over-budget stragglers
+            # keep their relative order
+            scene = self._queue[0].scene
             batch: list[_Pending] = []
+            kept: list[_Pending] = []
             total = 0
-            while self._queue and (
-                not batch or total + self._queue[0].n_rays <= max_rays
-            ):
-                p = self._queue.popleft()
-                batch.append(p)
-                total += p.n_rays
+            budget_full = False
+            for p in self._queue:
+                if p.scene != scene or budget_full:
+                    kept.append(p)
+                elif not batch or total + p.n_rays <= max_rays:
+                    batch.append(p)
+                    total += p.n_rays
+                else:
+                    budget_full = True
+                    kept.append(p)
+            self._queue.clear()
+            self._queue.extend(kept)
             return batch, len(self._queue)
 
     def pump(self) -> int:
@@ -314,17 +348,46 @@ class MicroBatcher:
             else np.concatenate([p.rays[::stride] for p in live], axis=0)
         )
 
+        scene = live[0].scene
+        scene_fields = {} if scene is None else {"scene": str(scene)}
         t0 = self.clock()
         # deliberately no try/finally around _inflight: a kill must LEAVE
         # it populated so the watchdog can fail the stranded futures
         with self._cond:
             self._inflight = live
         try:
-            # chaos hook: the flush-level fault point (a kill here is a
-            # BaseException — it escapes this handler, dies with the
-            # worker thread, and the watchdog restarts it)
-            fault_point("serve.flush")
-            out, info = self.engine.render_flat(flat, family)
+            # the lease pins the scene's residency for the whole render —
+            # the manager cannot evict it under an in-flight batch. The
+            # default scene (None) takes no lease and the legacy two-arg
+            # render_flat call, so pre-fleet engine doubles keep working.
+            with (nullcontext() if scene is None
+                  else self.engine.scene_lease(scene)) as scene_data:
+                # chaos hook: the flush-level fault point (a kill here is a
+                # BaseException — it escapes this handler, dies with the
+                # worker thread, and the watchdog restarts it)
+                fault_point("serve.flush")
+                out, info = (
+                    self.engine.render_flat(flat, family)
+                    if scene_data is None
+                    else self.engine.render_flat(flat, family, scene_data)
+                )
+        except SceneError as err:
+            # scene-scoped failure (torn checkpoint, residency overload):
+            # fail THIS scene's requests only and leave the breaker alone —
+            # other scenes' dispatch path is healthy and must keep serving
+            self.n_scene_errors += 1
+            self._last_dispatch_t = self.clock()
+            for p in live:
+                p.future.set_exception(err)
+                get_emitter().emit(
+                    "serve_request",
+                    latency_s=self.clock() - p.t_enqueued,
+                    n_rays=p.n_rays, tier=tier, status="scene_error",
+                    queue_s=t0 - p.t_enqueued, **scene_fields,
+                )
+            with self._cond:
+                self._inflight = []
+            return 0
         except Exception as err:  # scatter the failure; don't kill the loop
             self.n_dispatch_errors += 1
             self._last_dispatch_t = self.clock()
@@ -336,7 +399,7 @@ class MicroBatcher:
                     "serve_request",
                     latency_s=self.clock() - p.t_enqueued,
                     n_rays=p.n_rays, tier=tier, status="error",
-                    queue_s=t0 - p.t_enqueued,
+                    queue_s=t0 - p.t_enqueued, **scene_fields,
                 )
             report("serve.dispatch", "error", detail=detail[:200])
             with self._cond:
@@ -356,6 +419,7 @@ class MicroBatcher:
             render_s=float(render_s),
             queue_depth=queue_depth,
             bucket_rays=int(info["bucket_rays"]),
+            **scene_fields,
         )
 
         t_done = self.clock()
@@ -376,6 +440,7 @@ class MicroBatcher:
                 tier=tier,
                 status="ok",
                 queue_s=t0 - p.t_enqueued,
+                **scene_fields,
             )
             p.future.set_result(sliced)
         with self._cond:
@@ -390,6 +455,7 @@ class MicroBatcher:
             "n_shed": self.n_shed,
             "n_timeouts": self.n_timeouts,
             "n_dispatch_errors": self.n_dispatch_errors,
+            "n_scene_errors": self.n_scene_errors,
             "worker_restarts": self.worker_restarts,
             "breaker": self.breaker.snapshot(),
         }

@@ -32,10 +32,12 @@ rows (tests/test_serve.py proves it).
 from __future__ import annotations
 
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, replace
 
 import numpy as np
 
+from ..fleet.errors import SceneCompatError, UnknownSceneError
 from ..obs import CompileTracker, get_emitter
 from ..renderer.gate import check_baked_bounds
 from ..resil import fault_point
@@ -157,6 +159,11 @@ class RenderEngine:
         self.warmup_wall_s = 0.0
         # camera defaults for pose-only surfaces; engine_from_cfg fills it
         self.default_camera: dict | None = None
+        # multi-scene residency (fleet/): attach_fleet installs it; None =
+        # classic single-tenant serving, and requests without a scene (or
+        # naming default_scene) always render the engine's own checkpoint
+        self.fleet = None
+        self.default_scene = "default"
         if self.options.warmup:
             self.warm_up(warmup_families)
 
@@ -340,10 +347,99 @@ class RenderEngine:
 
         self.params = jax.device_put(params)
 
+    # -- multi-scene residency (fleet/) --------------------------------------
+
+    def attach_fleet(self, residency, default_scene: str = "default") -> None:
+        """Install a :class:`~nerf_replication_tpu.fleet.ResidencyManager`.
+
+        Every admitted scene is validated against the engine's warmed
+        signatures FIRST (param-tree structure, grid shape, baked
+        near/far) — a scene that would force a per-scene compile is
+        rejected at load, so the zero-steady-state-recompile invariant
+        holds across arbitrary scene churn."""
+        residency.validate = self._check_scene_compat
+        self.fleet = residency
+        self.default_scene = str(default_scene)
+
+    def _is_default_scene(self, scene_id) -> bool:
+        return scene_id is None or scene_id == self.default_scene
+
+    def require_scene(self, scene_id) -> None:
+        """Synchronous existence check (submission edge: 404 before a
+        bad scene id ever occupies queue capacity)."""
+        if self._is_default_scene(scene_id):
+            return
+        if self.fleet is None:
+            raise UnknownSceneError(
+                scene_id, f"scene {scene_id!r} requested but multi-scene "
+                          "serving is not configured (fleet.manifest / "
+                          "fleet.scan_dir)")
+        self.fleet.registry.get(scene_id)
+
+    def prefetch_scene(self, scene_id) -> bool:
+        """Kick a background host->device load so the first batch for a
+        new scene overlaps its transfer with current work (no-op when
+        resident, loading, default, or fleet-less)."""
+        if self.fleet is None or self._is_default_scene(scene_id):
+            return False
+        return self.fleet.prefetch(scene_id)
+
+    @contextmanager
+    def scene_lease(self, scene_id):
+        """Pin ``scene_id`` for a render block, yielding its SceneData
+        (None = the engine's own checkpoint). The pin guarantees the
+        residency manager cannot evict the scene mid-batch."""
+        if self._is_default_scene(scene_id):
+            yield None
+            return
+        self.require_scene(scene_id)
+        with self.fleet.lease(scene_id) as data:
+            yield data
+
+    def _check_scene_compat(self, data) -> None:
+        """Reject scenes the warmed executables cannot serve as-is."""
+        import jax
+
+        sid = data.scene_id
+        if (data.grid is not None) != self.use_grid:
+            raise SceneCompatError(
+                sid, f"scene {sid!r}: grid presence ({data.grid is not None}) "
+                     f"does not match the engine's path (use_grid="
+                     f"{self.use_grid})")
+        if abs(data.near - self.near) > 1e-6 or abs(data.far - self.far) > 1e-6:
+            # near/far are baked into the executables as constants — a
+            # scene with different bounds needs its own engine family
+            raise SceneCompatError(
+                sid, f"scene {sid!r}: bounds ({data.near}, {data.far}) differ "
+                     f"from the baked ({self.near}, {self.far})")
+        if jax.tree.structure(data.params) != jax.tree.structure(self.params):
+            raise SceneCompatError(
+                sid, f"scene {sid!r}: param tree structure differs from the "
+                     "engine's network")
+        eng_leaves = jax.tree.leaves(self.params)
+        for ours, theirs in zip(eng_leaves, jax.tree.leaves(data.params)):
+            if (tuple(ours.shape) != tuple(theirs.shape)
+                    or str(ours.dtype) != str(theirs.dtype)):
+                raise SceneCompatError(
+                    sid, f"scene {sid!r}: param leaf {theirs.shape}/"
+                         f"{theirs.dtype} vs engine {ours.shape}/{ours.dtype}")
+        if self.use_grid and (
+            tuple(data.grid.shape) != tuple(self.grid.shape)
+            or str(data.grid.dtype) != str(self.grid.dtype)
+        ):
+            raise SceneCompatError(
+                sid, f"scene {sid!r}: grid {data.grid.shape}/{data.grid.dtype}"
+                     f" vs engine {self.grid.shape}/{self.grid.dtype}")
+
     # -- rendering -----------------------------------------------------------
 
-    def _dispatch(self, rays_b: np.ndarray, bucket: int, family: str) -> dict:
-        """One executable call on exactly ``bucket`` rays (already padded)."""
+    def _dispatch(self, rays_b: np.ndarray, bucket: int, family: str,
+                  scene=None) -> dict:
+        """One executable call on exactly ``bucket`` rays (already padded).
+
+        ``scene`` (a pinned SceneData) swaps the runtime arguments —
+        params/grid/bbox — under the SAME executable: scene switching is
+        an argument change, never a compile."""
         import jax
 
         # chaos hook: injected dispatch failures exercise the batcher's
@@ -356,15 +452,18 @@ class RenderEngine:
         # stream clean under jax.transfer_guard / analysis.sanitizer()
         chunks = jax.device_put(chunks)
         fn = self._get_fn(bucket, family)
+        params = self.params if scene is None else scene.params
         if self.use_grid:
-            return fn(self.params, chunks, self.grid, self.bbox)
-        return fn(self.params, chunks)
+            grid = self.grid if scene is None else scene.grid
+            bbox = self.bbox if scene is None else scene.bbox
+            return fn(params, chunks, grid, bbox)
+        return fn(params, chunks)
 
     def _render_bucket(self, rays: np.ndarray, bucket: int,
-                       family: str) -> dict:
+                       family: str, scene=None) -> dict:
         n = rays.shape[0]
         rays_b = np.pad(rays, ((0, bucket - n), (0, 0)))
-        out = dict(self._dispatch(rays_b, bucket, family))
+        out = dict(self._dispatch(rays_b, bucket, family, scene))
         # traversal diagnostics are PER-CHUNK scalars ([n_chunks] under the
         # lax.map), not per-ray maps — fold them into the serving counters
         # before the per-ray reshape below would garble them
@@ -399,13 +498,16 @@ class RenderEngine:
                 return b
         return self.buckets[-1]
 
-    def render_flat(self, rays, family: str = "full") -> tuple[dict, dict]:
+    def render_flat(self, rays, family: str = "full",
+                    scene=None) -> tuple[dict, dict]:
         """Render a flat [N, C] ray array through the bucketed executables.
 
         Oversize requests stream through repeated largest-bucket calls; the
-        tail lands in the smallest bucket that holds it. Returns
-        ``(outputs, info)`` — outputs are host numpy [N, ...] arrays, info
-        reports the padded-ray accounting the occupancy telemetry needs.
+        tail lands in the smallest bucket that holds it. ``scene`` (a
+        pinned SceneData from :meth:`scene_lease`) renders a fleet scene
+        through the same executables. Returns ``(outputs, info)`` —
+        outputs are host numpy [N, ...] arrays, info reports the
+        padded-ray accounting the occupancy telemetry needs.
         """
         # host-side input normalization (requests arrive as numpy/lists)
         rays = np.asarray(rays, np.float32)  # graftlint: ok(host-sync)
@@ -417,11 +519,11 @@ class RenderEngine:
         i = 0
         while n - i > largest:
             pieces.append(self._render_bucket(rays[i:i + largest], largest,
-                                              family))
+                                              family, scene))
             used.append(largest)
             i += largest
         bucket = self.bucket_for(n - i)
-        pieces.append(self._render_bucket(rays[i:], bucket, family))
+        pieces.append(self._render_bucket(rays[i:], bucket, family, scene))
         used.append(bucket)
 
         out = pieces[0] if len(pieces) == 1 else {
@@ -441,13 +543,14 @@ class RenderEngine:
 
     # graftlint: hot
     def render_request(self, rays, near, far, tier: str = "full",
-                       emit: bool = True) -> dict:
+                       emit: bool = True, scene=None) -> dict:
         """Render one request at ``tier``; bounds must match the baked ones.
 
         ``half_res`` renders every 2nd ray and nearest-neighbor expands the
         outputs back to the request length, so callers always get [N, ...]
-        arrays regardless of tier. The served tier rides in the returned
-        dict under ``"tier"``."""
+        arrays regardless of tier. ``scene`` names a registry scene (None
+        = the engine's own checkpoint); the lease pins it for the render.
+        The served tier rides in the returned dict under ``"tier"``."""
         check_baked_bounds(self.near, self.far, near, far,
                            surface="serve engine")
         family, stride = TIER_IMPL[tier]
@@ -455,7 +558,8 @@ class RenderEngine:
         rays = np.asarray(rays, np.float32)  # graftlint: ok(host-sync)
         n = rays.shape[0]
         t0 = time.perf_counter()
-        out, info = self.render_flat(rays[::stride], family)
+        with self.scene_lease(scene) as scene_data:
+            out, info = self.render_flat(rays[::stride], family, scene_data)
         if stride > 1:
             out = {
                 k: np.repeat(v, stride, axis=0)[:n] for k, v in out.items()
@@ -463,6 +567,8 @@ class RenderEngine:
         latency = time.perf_counter() - t0
         self.n_requests += 1
         if emit:
+            fields = {} if self._is_default_scene(scene) \
+                else {"scene": str(scene)}
             get_emitter().emit(
                 "serve_request",
                 latency_s=latency,
@@ -471,24 +577,34 @@ class RenderEngine:
                 status="ok",
                 n_buckets=len(info["buckets"]),
                 bucket_rays=info["bucket_rays"],
+                **fields,
             )
         out["tier"] = tier
         return out
 
     # graftlint: hot
     def render_view(self, c2w, H: int, W: int, focal: float,
-                    tier: str = "full", via=None) -> tuple[np.ndarray, dict]:
+                    tier: str = "full", via=None,
+                    scene=None) -> tuple[np.ndarray, dict]:
         """Pose -> uint8 [H, W, 3] image through the pose LRU cache.
 
         ``via(rays, near, far) -> out dict`` overrides the render path —
         the HTTP entrypoint passes the micro-batcher's submitting closure
         so concurrent views coalesce; default is a direct engine render at
-        ``tier``."""
-        key = self.cache.key(c2w, H, W, focal)
+        ``tier``. ``scene`` selects the per-scene pose cache and render
+        target (a view is a pure function of pose AND scene, so caches
+        never alias across scenes)."""
+        if self._is_default_scene(scene):
+            cache, scene = self.cache, None
+        else:
+            self.require_scene(scene)
+            cache = self.fleet.pose_cache(scene)
+        key = cache.key(c2w, H, W, focal)
         t0 = time.perf_counter()
-        cached = self.cache.get(key)
+        cached = cache.get(key)
         if cached is not None:
             image, served_tier = cached
+            fields = {} if scene is None else {"scene": str(scene)}
             get_emitter().emit(
                 "serve_request",
                 latency_s=time.perf_counter() - t0,
@@ -496,6 +612,7 @@ class RenderEngine:
                 tier=served_tier,
                 status="ok",
                 cache_hit=True,
+                **fields,
             )
             return image, {"tier": served_tier, "cache_hit": True}
 
@@ -508,13 +625,13 @@ class RenderEngine:
             out = via(rays, self.near, self.far)
         else:
             out = self.render_request(rays, self.near, self.far, tier=tier,
-                                      emit=True)
+                                      emit=True, scene=scene)
         served_tier = out.get("tier", tier)
         rgb_key = "rgb_map_f" if "rgb_map_f" in out else "rgb_map_c"
         # image assembly IS the response; render_flat already scattered to host
         rgb = np.clip(np.asarray(out[rgb_key]).reshape(H, W, 3), 0.0, 1.0)  # graftlint: ok(host-sync)
         image = (rgb * 255).astype(np.uint8)
-        self.cache.put(key, (image, served_tier))
+        cache.put(key, (image, served_tier))
         return image, {"tier": served_tier, "cache_hit": False}
 
     # -- introspection --------------------------------------------------------
@@ -552,6 +669,8 @@ class RenderEngine:
             "warm_source": self.warm_source,
             "warmup_wall_s": round(self.warmup_wall_s, 3),
             "cache": self.cache.stats(),
+            # multi-scene residency (None = single-tenant serving)
+            "fleet": None if self.fleet is None else self.fleet.stats(),
         }
 
 
@@ -633,4 +752,12 @@ def engine_from_cfg(cfg, cfg_file: str | None = None) -> RenderEngine:
     engine.default_camera = {
         "H": int(test_ds.H), "W": int(test_ds.W), "focal": float(test_ds.focal),
     }
+    # multi-scene residency: attaches only when the fleet: block names a
+    # manifest/scan_dir — default config keeps single-tenant behavior
+    from ..fleet import fleet_from_cfg
+
+    residency = fleet_from_cfg(cfg, engine)
+    if residency is not None:
+        print(f"fleet: {len(residency.registry)} scenes registered, "
+              f"budget {residency.budget_bytes / (1 << 20):.0f} MB")
     return engine

@@ -14,6 +14,18 @@ command line, not a war story.
         --epochs 3
     python scripts/chaos_run.py serve --fault serve.flush:kill:4 \
         --fault occupancy.load:truncate --requests 40
+    python scripts/chaos_run.py serve --scenes 3 \
+        --fault fleet.load:io_error:2
+    python scripts/chaos_run.py serve --scenes 3 \
+        --fault fleet.load:truncate:3:1
+
+``--scenes N`` puts the serve mode behind a multi-scene fleet
+(nerf_replication_tpu/fleet) with an HBM budget of about half the
+scenes, so the request stream churns eviction/reload while faults land
+on the ``fleet.load`` point: an injected ``io_error`` must be absorbed
+by the retry ladder, while a ``truncate`` (torn checkpoint, caught by
+the tree checksum) must fail ONLY that scene's requests — every other
+scene keeps serving and the run still counts as recovered.
 
 Fault spec grammar: ``point:kind[:after[:times]]`` — inject ``kind`` at
 ``point`` after letting ``after`` hits through, on up to ``times`` hits
@@ -128,6 +140,53 @@ def run_train(args, plan) -> dict:
     return outcome
 
 
+def _build_chaos_fleet(engine, args):
+    """N scenes with REAL checkpoint dirs (dummy blob + tree checksum —
+    a target for truncate/io_error faults) over an in-memory loader,
+    budgeted to ~half the fleet so the stream churns eviction/reload."""
+    import numpy as np
+
+    import jax
+
+    from nerf_replication_tpu.fleet import (
+        ResidencyManager,
+        SceneData,
+        SceneRecord,
+        SceneRegistry,
+    )
+    from nerf_replication_tpu.resil import write_tree_checksum
+
+    scene_ids = [f"scene{i:02d}" for i in range(args.scenes)]
+    datas, records = {}, []
+    for i, sid in enumerate(scene_ids):
+        ckpt = os.path.join(args.workdir, "fleet", sid)
+        os.makedirs(os.path.join(ckpt, "latest"), exist_ok=True)
+        with open(os.path.join(ckpt, "latest", "params.bin"), "wb") as fh:
+            fh.write(os.urandom(4096))
+        write_tree_checksum(ckpt)
+        perturbed = jax.tree.map(
+            lambda a, s=1.0 + 0.01 * (i + 1): np.asarray(a) * np.float32(s),
+            engine.params,
+        )
+        datas[sid] = SceneData(scene_id=sid, params=perturbed,
+                               grid=np.asarray(engine.grid),
+                               bbox=np.asarray(engine.bbox),
+                               near=NEAR, far=FAR)
+        records.append(SceneRecord(scene_id=sid, checkpoint=ckpt))
+    one = (sum(leaf.nbytes for leaf in jax.tree.leaves(engine.params))
+           + engine.grid.nbytes + engine.bbox.nbytes)
+    residency = ResidencyManager(
+        SceneRegistry(records), lambda rec: datas[rec.scene_id],
+        budget_bytes=int(one * max(1.5, args.scenes / 2.0)),
+        # no background prefetch: the fault schedule stays a pure
+        # function of the (deterministic) request-loop hit order
+        prefetch=False,
+        retry_kw={"attempts": 3, "base_s": 0.01, "max_s": 0.05},
+    )
+    engine.attach_fleet(residency)
+    return residency, scene_ids
+
+
 def run_serve(args, plan) -> dict:
     """Engine + micro-batcher under the plan: the worker watchdog and the
     breaker must keep the stream flowing with zero steady recompiles."""
@@ -171,23 +230,42 @@ def run_serve(args, plan) -> dict:
                           grid=grid, bbox=bbox)
     batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg))
 
+    from nerf_replication_tpu.fleet import SceneError
+
+    residency = scene_ids = None
+    if args.scenes > 0:
+        residency, scene_ids = _build_chaos_fleet(engine, args)
+
     rng = np.random.default_rng(args.seed)
     steady_base = engine.tracker.total_compiles()
-    ok = rejected = failed = 0
+    ok = rejected = failed = scene_failed = 0
+    ok_by_scene: dict = {}
     t0 = time.perf_counter()
     with injecting(plan):
-        for _ in range(args.requests):
+        for i in range(args.requests):
             n = int(rng.integers(32, 257))
             d = np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3))
             rays = np.concatenate(
                 [np.tile([0.0, 0.0, 4.0], (n, 1)), d], -1
             ).astype(np.float32)
+            # runs of 4 same-scene requests cycling the fleet: residency
+            # churn under fault, not one scene absorbing every hit
+            scene = scene_ids[(i // 4) % len(scene_ids)] if scene_ids \
+                else None
             try:
-                batcher.submit(rays, NEAR, FAR).result(timeout=30.0)
+                batcher.submit(rays, NEAR, FAR, scene=scene).result(
+                    timeout=30.0
+                )
                 ok += 1
+                if scene is not None:
+                    ok_by_scene[scene] = ok_by_scene.get(scene, 0) + 1
             except BreakerOpenError:
                 rejected += 1
                 time.sleep(0.05)
+            except SceneError:
+                # scene-scoped failure (torn/unloadable): 503 for THAT
+                # scene only — the stream itself keeps flowing
+                scene_failed += 1
             except (ServeTimeoutError, TimeoutError, RuntimeError, OSError):
                 # the batcher scatters the original dispatch exception onto
                 # the futures: RuntimeError for a crashed worker, OSError
@@ -196,7 +274,7 @@ def run_serve(args, plan) -> dict:
     wall = time.perf_counter() - t0
     health = batcher.health()
     batcher.close(drain=False)
-    return {
+    out = {
         "mode": "serve",
         "completed": True,
         "died": None,
@@ -209,6 +287,20 @@ def run_serve(args, plan) -> dict:
         "recompiles_steady": engine.tracker.total_compiles() - steady_base,
         "telemetry": telem,
     }
+    if residency is not None:
+        stats = residency.stats()
+        out["n_scene_failed"] = scene_failed
+        out["ok_by_scene"] = ok_by_scene
+        out["scenes_still_serving"] = sum(1 for v in ok_by_scene.values()
+                                          if v > 0)
+        out["fleet"] = {
+            "n_scenes": len(scene_ids),
+            "evictions": stats["evictions"],
+            "cold_loads": stats["cold_loads"],
+            "load_errors": stats["load_errors"],
+            "overloads": stats["overloads"],
+        }
+    return out
 
 
 def summarize_telemetry(path: str) -> dict:
@@ -257,6 +349,9 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--scenes", type=int, default=0,
+                   help="serve mode: N > 0 runs the stream over an "
+                        "N-scene fleet (fleet.load fault coverage)")
     p.add_argument("--backend", default="cpu",
                    help="platform pin ('cpu', 'cpu:8'; '' = inherit)")
     p.add_argument("--workdir",
@@ -295,6 +390,9 @@ def main(argv=None) -> int:
         outcome["completed"]
         and summary["retries_exhausted"] == 0
         and outcome.get("recompiles_steady", 0) == 0
+        # fleet mode: a torn scene may 503 scene-scoped, but the stream
+        # only counts as recovered if other scenes actually kept serving
+        and (args.scenes == 0 or outcome.get("scenes_still_serving", 0) > 0)
     )
     print(json.dumps({"outcome": outcome, "telemetry_summary": summary,
                       "recovered": recovered}, indent=2))

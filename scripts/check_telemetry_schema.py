@@ -5,8 +5,10 @@
     python scripts/check_telemetry_schema.py          # repo-default file set
 
 The default file set covers every committed measurement trail, including
-the serving load generator's ``BENCH_SERVE.jsonl`` (family ``serve_mode``;
-written by scripts/serve_bench.py) via the ``BENCH_*.jsonl`` pattern.
+the serving load generator's ``BENCH_SERVE.jsonl`` (family ``serve_mode``)
+and its multi-scene fleet trail ``BENCH_FLEET.jsonl`` (family
+``fleet_mode``; both written by scripts/serve_bench.py) via the
+``BENCH_*.jsonl`` pattern.
 
 Files named ``telemetry*.jsonl`` are checked row-by-row against the typed
 telemetry schema (``obs/schema.py:ROW_KINDS``); every other JSONL is

@@ -20,8 +20,21 @@ down. The headline acceptance number is ``compiles_steady``: the obs
 CompileTracker count accumulated AFTER warmup across the whole mixed-shape
 stream — it must be zero (the shape buckets absorb every request shape).
 
+* **fleet churn** (``--scenes N``) — multi-tenant mode: N synthetic
+  scenes (same architecture, perturbed weights) behind a
+  :class:`~nerf_replication_tpu.fleet.ResidencyManager`, driven as runs
+  of same-scene requests cycling round-robin with the NEXT scene
+  prefetched one run ahead. ``--churn`` shrinks the HBM budget to about
+  half the fleet so every cycle forces eviction/reload; without it the
+  whole fleet stays resident. The summary row (family ``fleet_mode``,
+  appended to ``BENCH_FLEET.jsonl``) splits latency into same-scene vs
+  scene-switch percentiles — the acceptance number is the prefetched
+  switch p95 staying within 2x of the same-scene p95, at
+  ``compiles_steady == 0`` across all scene churn.
+
     python scripts/serve_bench.py --backend cpu
     python scripts/serve_bench.py --backend cpu --mode open --rate 200
+    python scripts/serve_bench.py --backend cpu --scenes 3 --churn
     python scripts/tlm_report.py data/record/serve_bench
 """
 
@@ -115,6 +128,109 @@ def _request_stream(rng, n_requests: int, min_rays: int, max_rays: int):
         d = np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (int(n), 3))
         o = np.tile([0.0, 0.0, 4.0], (int(n), 1))
         yield np.concatenate([o, d], -1).astype(np.float32)
+
+
+def _build_fleet(engine, args):
+    """N synthetic scenes over an in-memory loader: same architecture
+    (one executable family serves all), per-scene perturbed weights.
+
+    ``--churn`` sizes the byte budget to about half the fleet, so the
+    round-robin stream below forces an eviction/reload every cycle —
+    the worst-case residency pattern the bench is meant to price."""
+    import numpy as np
+
+    import jax
+
+    from nerf_replication_tpu.fleet import (
+        ResidencyManager,
+        SceneData,
+        SceneRecord,
+        SceneRegistry,
+    )
+
+    scene_ids = [f"scene{i:02d}" for i in range(args.scenes)]
+    datas = {}
+    for i, sid in enumerate(scene_ids):
+        perturbed = jax.tree.map(
+            lambda a, s=1.0 + 0.01 * (i + 1): np.asarray(a) * np.float32(s),
+            engine.params,
+        )
+        datas[sid] = SceneData(scene_id=sid, params=perturbed,
+                               grid=np.asarray(engine.grid),
+                               bbox=np.asarray(engine.bbox),
+                               near=NEAR, far=FAR)
+    registry = SceneRegistry(SceneRecord(scene_id=s) for s in scene_ids)
+    one = (sum(leaf.nbytes for leaf in jax.tree.leaves(engine.params))
+           + engine.grid.nbytes + engine.bbox.nbytes)
+    budget_scenes = (
+        max(1.5, args.scenes / 2.0) if args.churn else args.scenes + 0.5
+    )
+    residency = ResidencyManager(
+        registry, lambda rec: datas[rec.scene_id],
+        budget_bytes=int(one * budget_scenes),
+        verify_checksums=False,
+    )
+    engine.attach_fleet(residency)
+    return residency, scene_ids
+
+
+def _run_fleet(engine, batcher, residency, scene_ids, rng, args) -> dict:
+    """Round-robin scene runs with one-run-ahead prefetch.
+
+    Each scene serves ``--run-len`` requests back to back, then the
+    stream switches; the upcoming scene's load was issued at the START
+    of the previous run, so the switch request finds it resident (or
+    joins the in-flight transfer) instead of cold-loading inline."""
+    same, switch = [], []
+    total = 0
+    prev_sid = None
+    t_start = time.perf_counter()
+    stream = _request_stream(rng, args.requests, args.min_rays,
+                             args.max_rays)
+    run_idx = 0
+    while total < args.requests:
+        sid = scene_ids[run_idx % len(scene_ids)]
+        residency.prefetch(scene_ids[(run_idx + 1) % len(scene_ids)])
+        for i in range(min(args.run_len, args.requests - total)):
+            rays = next(stream)
+            t0 = time.perf_counter()
+            batcher.submit(rays, NEAR, FAR, scene=sid).result(timeout=60.0)
+            lat = time.perf_counter() - t0
+            total += 1
+            # the first request after a scene change pays the switch
+            # (residency pin + possible load join); the rest are warm
+            (switch if i == 0 and sid != prev_sid else same).append(lat)
+        prev_sid = sid
+        run_idx += 1
+    return {"same_s": same, "switch_s": switch,
+            "wall_s": time.perf_counter() - t_start}
+
+
+def _fleet_row(run: dict, engine, residency, args,
+               compiles_steady: int) -> dict:
+    stats = residency.stats()
+    n = len(run["same_s"]) + len(run["switch_s"])
+    return {
+        "fleet_mode": "churn" if args.churn else "resident",
+        "n_scenes": args.scenes,
+        "n_requests": n,
+        "run_len": args.run_len,
+        "evictions": stats["evictions"],
+        "cold_loads": stats["cold_loads"],
+        "prefetch_hit_rate": stats["prefetch_hit_rate"],
+        "p50_same_ms": (_percentile(run["same_s"], 50) or 0.0) * 1e3,
+        "p95_same_ms": (_percentile(run["same_s"], 95) or 0.0) * 1e3,
+        "p50_switch_ms": (_percentile(run["switch_s"], 50) or 0.0) * 1e3,
+        "p95_switch_ms": (_percentile(run["switch_s"], 95) or 0.0) * 1e3,
+        "rps": n / run["wall_s"] if run["wall_s"] else 0.0,
+        "budget_bytes": stats["budget_bytes"],
+        "bytes_loaded": stats["bytes_loaded"],
+        "compiles_warmup": engine.warmup_compiles,
+        "compiles_steady": compiles_steady,
+        "backend": args.backend,
+        "buckets": list(engine.buckets),
+        "seed": args.seed,
+    }
 
 
 def _percentile(values, q):
@@ -234,6 +350,16 @@ def main(argv=None) -> int:
                                                         "record",
                                                         "serve_bench"))
     p.add_argument("--out", default=os.path.join(_REPO, "BENCH_SERVE.jsonl"))
+    p.add_argument("--scenes", type=int, default=0,
+                   help="N > 0: multi-tenant fleet mode over N synthetic "
+                        "scenes (replaces closed/open modes)")
+    p.add_argument("--churn", action="store_true",
+                   help="shrink the HBM budget to ~half the fleet so "
+                        "every scene cycle forces eviction/reload")
+    p.add_argument("--run-len", type=int, default=4,
+                   help="same-scene requests per run before switching")
+    p.add_argument("--out-fleet",
+                   default=os.path.join(_REPO, "BENCH_FLEET.jsonl"))
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--strict", action="store_true",
                    help="exit nonzero if any post-warmup recompile happened")
@@ -255,8 +381,40 @@ def main(argv=None) -> int:
     print(f"engine warm: buckets {list(engine.buckets)}, "
           f"{engine.warmup_compiles} executables in {warmup_s:.1f}s")
 
-    modes = ("closed", "open") if args.mode == "both" else (args.mode,)
     failed = False
+    if args.scenes > 0:
+        try:
+            residency, scene_ids = _build_fleet(engine, args)
+            print(f"fleet: {args.scenes} scenes, budget "
+                  f"{residency.budget_bytes / 2**20:.1f} MiB "
+                  f"({'churn' if args.churn else 'fully resident'})")
+            rng = np.random.default_rng(args.seed)
+            steady_base = engine.tracker.total_compiles()
+            run = _run_fleet(engine, batcher, residency, scene_ids, rng,
+                             args)
+            compiles_steady = engine.tracker.total_compiles() - steady_base
+            row = _fleet_row(run, engine, residency, args, compiles_steady)
+            append_jsonl(args.out_fleet, row)
+            print(
+                f"fleet[{row['fleet_mode']}]: n={row['n_requests']} "
+                f"same p95={row['p95_same_ms']:.1f}ms "
+                f"switch p95={row['p95_switch_ms']:.1f}ms "
+                f"evictions={row['evictions']} "
+                f"prefetch_hit_rate={row['prefetch_hit_rate']:.2f} "
+                f"recompiles_after_warmup={compiles_steady}"
+            )
+            if compiles_steady:
+                print(f"WARNING: {compiles_steady} post-warmup recompiles "
+                      "(a scene switch forced a build)")
+                failed = True
+        finally:
+            batcher.close()
+            get_emitter().close()
+        print(f"row appended to {args.out_fleet}; "
+              f"telemetry in {args.record_dir}")
+        return 1 if (failed and args.strict) else 0
+
+    modes = ("closed", "open") if args.mode == "both" else (args.mode,)
     try:
         for mode in modes:
             rng = np.random.default_rng(args.seed)

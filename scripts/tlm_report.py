@@ -196,6 +196,37 @@ def summarize(rows: list[dict]) -> dict:
         )
         summary["serve_tiers"] = tiers
 
+    # fleet residency rows (nerf_replication_tpu/fleet): scene
+    # materializations split cold vs prefetched, eviction churn, and the
+    # last resident set — keys present only when the run served a
+    # multi-scene fleet (single-tenant runs stay unchanged)
+    scene_loads = [r for r in rows if r.get("kind") == "scene_load"]
+    scene_evicts = [r for r in rows if r.get("kind") == "scene_evict"]
+    if scene_loads or scene_evicts:
+        cold = sum(1 for r in scene_loads if r.get("source") == "cold")
+        pre = sum(1 for r in scene_loads if r.get("source") == "prefetch")
+        summary["fleet_scene_loads"] = len(scene_loads)
+        summary["fleet_cold_loads"] = cold
+        summary["fleet_prefetch_loads"] = pre
+        summary["fleet_prefetch_share"] = (
+            pre / (pre + cold) if (pre + cold) else None
+        )
+        summary["fleet_evictions"] = len(scene_evicts)
+        summary["fleet_scenes"] = sorted(
+            {r.get("scene") for r in scene_loads if r.get("scene")}
+        )
+        summary["fleet_bytes_loaded"] = sum(
+            int(r.get("bytes", 0)) for r in scene_loads
+        )
+        last = next(
+            (r for r in reversed(rows)
+             if r.get("kind") in ("scene_load", "scene_evict")),
+            None,
+        )
+        summary["fleet_resident_last"] = (
+            last.get("resident") if last else None
+        )
+
     # traversal rows (renderer/packed_march.py hierarchical coarse-DDA):
     # sweep efficiency = occupied samples surviving the fine test per
     # candidate row entering the global sort — the number the mip-pyramid
@@ -329,6 +360,18 @@ def print_summary(summary: dict, label: str = "") -> None:
         print(f"    cache hits:  "
               + (f"{hit * 100:.1f}%" if hit is not None else "n/a")
               + f"  tiers: {tiers or 'n/a'}")
+    if summary.get("fleet_scene_loads") is not None:
+        share = summary.get("fleet_prefetch_share")
+        scenes = summary.get("fleet_scenes") or []
+        print(f"  fleet:         {summary['fleet_scene_loads']} scene "
+              f"load(s) over {len(scenes)} scene(s)  "
+              f"({summary['fleet_cold_loads']} cold / "
+              f"{summary['fleet_prefetch_loads']} prefetched"
+              + (f", {share * 100:.0f}% prefetched" if share is not None
+                 else "") + ")")
+        print(f"    evictions:   {summary['fleet_evictions']}  "
+              f"bytes loaded: {_fmt_bytes(summary['fleet_bytes_loaded'])}  "
+              f"resident at end: {summary['fleet_resident_last']}")
     if summary.get("march_rows"):
         eff = summary.get("march_sweep_efficiency")
         occ = summary.get("march_coarse_occ")
@@ -405,6 +448,19 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     b = cand.get("breaker_opens")
     if b is not None and b > a:
         flags.append(f"circuit-breaker opens grew {a} -> {b}")
+    # residency churn: a candidate run evicting or cold-missing more than
+    # its baseline is thrashing the HBM budget — scenes bounce instead of
+    # staying resident, and every bounce is a reload on the request path
+    a = base.get("fleet_evictions") or 0
+    b = cand.get("fleet_evictions")
+    if b is not None and b > a:
+        flags.append(f"fleet evictions grew {a} -> {b} "
+                     f"(residency budget thrash)")
+    a = base.get("fleet_cold_loads") or 0
+    b = cand.get("fleet_cold_loads")
+    if b is not None and b > a:
+        flags.append(f"fleet cold scene loads grew {a} -> {b} "
+                     f"(prefetch misses on the request path)")
     # sweep efficiency DROPPING means the coarse DDA is admitting more
     # dead candidate rows into the sort per useful sample — a traversal
     # regression even when step time hasn't moved yet

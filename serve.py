@@ -14,18 +14,23 @@ API (all JSON):
 
 * ``POST /render`` — body carries a spherical pose (``theta``/``phi``/
   ``radius`` degrees, degrees, world units) OR a ``c2w`` 3x4/4x4 matrix;
-  optional ``H``/``W``/``focal`` override the dataset camera. Response:
-  ``{h, w, tier, cache_hit, latency_ms, rgb_b64}`` with ``rgb_b64`` the
-  base64 of the raw uint8 [h, w, 3] buffer.
+  optional ``H``/``W``/``focal`` override the dataset camera; optional
+  ``scene`` names a registry scene when the ``fleet:`` block is
+  configured (absent = the engine's own checkpoint, API-compatible).
+  Response: ``{h, w, tier, cache_hit, latency_ms, rgb_b64}`` with
+  ``rgb_b64`` the base64 of the raw uint8 [h, w, 3] buffer.
 * ``GET /stats`` — engine + batcher + cache counters (compile inventory,
-  occupancy, shed/timeout counts, queue depth).
+  occupancy, shed/timeout counts, queue depth) plus, multi-scene, the
+  ``fleet`` residency block (resident set, evictions, prefetch hits).
 * ``GET /healthz`` — supervision view: queue depth, last-dispatch age,
   circuit-breaker state, worker liveness/restarts. 200 while healthy,
   503 when the breaker is open or the worker cannot be kept alive.
 
 Errors are structured JSON, never stack traces (docs/robustness.md):
-bad pose / out-of-bounds request → 400, batcher timeout → 504, breaker
-open → 503 with a ``Retry-After`` header, anything else → 500
+bad pose / out-of-bounds request → 400, unknown scene → 404, batcher
+timeout → 504, breaker open → 503 with a ``Retry-After`` header, a
+torn/unloadable/over-budget scene → 503 for THAT scene only (every other
+resident scene keeps serving), anything else → 500
 ``{"error": "internal error"}``.
 """
 
@@ -67,17 +72,19 @@ def render_pose(engine, batcher, body: dict) -> dict:
     H = int(body.get("H", camera["H"]))
     W = int(body.get("W", camera["W"]))
     focal = float(body.get("focal", camera["focal"]))
+    scene = body.get("scene")
+    scene = None if scene is None else str(scene)
     c2w = _resolve_pose(body)
 
     timeout = engine.options.request_timeout_s + 30.0  # queue + render slack
     via = None
     if batcher is not None:
         via = lambda rays, near, far: (  # noqa: E731
-            batcher.submit(rays, near, far).result(timeout)
+            batcher.submit(rays, near, far, scene=scene).result(timeout)
         )
     t0 = time.perf_counter()
-    image, info = engine.render_view(c2w, H, W, focal, via=via)
-    return {
+    image, info = engine.render_view(c2w, H, W, focal, via=via, scene=scene)
+    out = {
         "h": H,
         "w": W,
         "tier": info["tier"],
@@ -85,11 +92,19 @@ def render_pose(engine, batcher, body: dict) -> dict:
         "latency_ms": (time.perf_counter() - t0) * 1e3,
         "rgb_b64": base64.b64encode(image.tobytes()).decode("ascii"),
     }
+    if scene is not None:
+        out["scene"] = scene
+    return out
 
 
 def make_server(engine, batcher, host: str = "127.0.0.1",
                 port: int = 8008) -> ThreadingHTTPServer:
     """A ready-to-serve ThreadingHTTPServer (port 0 = ephemeral, tests)."""
+    from nerf_replication_tpu.fleet import (
+        ResidencyOverloadError,
+        SceneError,
+        UnknownSceneError,
+    )
     from nerf_replication_tpu.resil import BreakerOpenError, report
     from nerf_replication_tpu.serve.batcher import ServeTimeoutError
 
@@ -132,6 +147,20 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                           "retry_after_s": err.retry_after_s},
                     headers={"Retry-After": str(max(1, round(err.retry_after_s)))},
                 )
+            except UnknownSceneError as err:
+                return self._reply(
+                    404, {"error": str(err), "scene": err.scene_id})
+            except ResidencyOverloadError as err:
+                # every resident scene is pinned: momentary, retryable
+                return self._reply(
+                    503, {"error": str(err), "scene": err.scene_id},
+                    headers={"Retry-After": "1"},
+                )
+            except SceneError as err:
+                # torn/unloadable scene: 503 for THIS scene only — the
+                # fault row is already in telemetry, other scenes serve on
+                return self._reply(
+                    503, {"error": str(err), "scene": err.scene_id})
             except (ServeTimeoutError, TimeoutError) as err:
                 return self._reply(
                     504, {"error": str(err) or "render timed out"})

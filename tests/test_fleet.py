@@ -1,0 +1,797 @@
+"""Multi-scene fleet subsystem (nerf_replication_tpu/fleet): registry
+discovery round-trips, the residency manager evicts deterministically
+under a byte budget, pinned leases survive admission pressure, prefetch
+joins are bitwise-identical to cold loads, a mixed scene stream renders
+through the SAME prewarmed executables with zero steady-state compiles
+and bitwise-matches a dedicated single-scene engine, torn scenes fail
+scene-scoped (other scenes keep serving), and the AOT artifact store
+warm-restarts a fleet engine from disk with zero builds. All CPU, tiny
+fake network — no real training."""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.fleet import (
+    ResidencyManager,
+    ResidencyOverloadError,
+    SceneData,
+    SceneLoadError,
+    SceneRecord,
+    SceneRegistry,
+    UnknownSceneError,
+    checkpoint_loader,
+    fleet_from_cfg,
+)
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.obs import init_run, validate_row
+from nerf_replication_tpu.resil import write_tree_checksum
+from nerf_replication_tpu.serve import MicroBatcher, RenderEngine
+
+NEAR, FAR = 2.0, 6.0
+
+# shared by the module fixture and the warm-restart child process, which
+# must rebuild a config-identical engine to hit the same artifact keys
+_CFG_OPTS = [
+    "task_arg.render_step_size", "0.25",
+    "task_arg.max_march_samples", "16",
+    "task_arg.march_chunk_size", "64",
+    "serve.buckets", "[128, 256]",
+    "serve.max_batch_rays", "256",
+    "serve.max_delay_ms", "40.0",
+    "serve.request_timeout_s", "5.0",
+    "serve.cache_entries", "4",
+    # keep every fleet batch on the full tier: only the full family
+    # is prewarmed here, and tier parity is not under test
+    "serve.shed_queue_depths", "[50, 60, 70, 80]",
+]
+
+
+def _rays(n: int, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.concatenate(
+        [
+            np.tile([0.0, 0.0, 4.0], (n, 1)),
+            np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3)),
+        ],
+        -1,
+    ).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_fleet"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4, n_test=1)
+    cfg = tiny_cfg(root, _CFG_OPTS)
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox, warmup_families=("full",))
+    return cfg, network, params, grid, bbox, engine
+
+
+def _synthetic_fleet(engine, params, grid, bbox, scene_ids=("a", "b", "c"),
+                     budget_scenes=2.5, **kw):
+    """A fleet of per-scene perturbed checkpoints over an in-memory
+    loader: same architecture (one executable family serves all), but
+    bitwise-distinguishable weights per scene."""
+    datas = {}
+    for i, sid in enumerate(scene_ids):
+        perturbed = jax.tree.map(
+            lambda a, s=1.0 + 0.01 * (i + 1): np.asarray(a) * np.float32(s),
+            params,
+        )
+        datas[sid] = SceneData(scene_id=sid, params=perturbed, grid=grid,
+                               bbox=bbox, near=NEAR, far=FAR)
+    registry = SceneRegistry(SceneRecord(scene_id=sid) for sid in scene_ids)
+    one = (sum(leaf.nbytes for leaf in jax.tree.leaves(params))
+           + grid.nbytes + bbox.nbytes)
+    mgr = ResidencyManager(
+        registry, lambda rec: datas[rec.scene_id],
+        budget_bytes=int(one * budget_scenes),
+        verify_checksums=False, **kw,
+    )
+    return mgr, datas, one
+
+
+def _np_fleet(scene_ids=("a", "b", "c"), budget_scenes=2.0, **kw):
+    """Engine-free fleet over trivially-sized numpy params (4000 B each):
+    byte accounting and LRU order are exact, no jax compile cost."""
+    datas = {
+        sid: SceneData(scene_id=sid,
+                       params={"w": np.full((1000,), i, np.float32)})
+        for i, sid in enumerate(scene_ids)
+    }
+    registry = SceneRegistry(SceneRecord(scene_id=sid) for sid in scene_ids)
+    mgr = ResidencyManager(
+        registry, lambda rec: datas[rec.scene_id],
+        budget_bytes=int(4000 * budget_scenes),
+        verify_checksums=False, **kw,
+    )
+    return mgr, datas
+
+
+class _attached:
+    """Attach a residency manager to the shared module engine for one
+    test, restoring single-tenant mode on exit."""
+
+    def __init__(self, engine, mgr, default_scene="default"):
+        self.engine, self.mgr, self.default = engine, mgr, default_scene
+
+    def __enter__(self):
+        self.engine.attach_fleet(self.mgr, default_scene=self.default)
+        return self.mgr
+
+    def __exit__(self, *exc):
+        self.engine.fleet = None
+        self.engine.default_scene = "default"
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_manifest_round_trip(tmp_path):
+    reg = SceneRegistry([
+        SceneRecord("lego", checkpoint="/ckpts/lego", grid="/ckpts/lego.npz",
+                    near=2.0, far=6.0,
+                    bbox=((-1.5, -1.5, -1.5), (1.5, 1.5, 1.5)),
+                    epoch=3, meta={"note": "unit"}),
+        SceneRecord("ship", checkpoint="/ckpts/ship"),
+    ])
+    path = str(tmp_path / "manifest.json")
+    reg.to_manifest(path)
+    back = SceneRegistry.from_manifest(path)
+    assert back.ids() == ["lego", "ship"]
+    assert back.get("lego") == reg.get("lego")
+    assert back.get("ship").near is None and back.get("ship").grid == ""
+
+
+def test_manifest_relative_paths_resolve_against_manifest_dir(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "scenes": [
+            {"scene_id": "lego", "checkpoint": "lego/ckpt",
+             "grid": "lego/occupancy_grid.npz"},
+        ]}, fh)
+    rec = SceneRegistry.from_manifest(path).get("lego")
+    assert rec.checkpoint == str(tmp_path / "lego" / "ckpt")
+    assert rec.grid == str(tmp_path / "lego" / "occupancy_grid.npz")
+
+
+def test_manifest_rejects_future_version_and_bad_shape(tmp_path):
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump({"version": 99, "scenes": []}, fh)
+    with pytest.raises(ValueError, match="version"):
+        SceneRegistry.from_manifest(bad)
+    with open(bad, "w") as fh:
+        json.dump(["not", "a", "manifest"], fh)
+    with pytest.raises(ValueError, match="scenes"):
+        SceneRegistry.from_manifest(bad)
+
+
+def test_scan_discovers_checkpoint_layouts(tmp_path):
+    root = tmp_path / "scenes"
+    (root / "alpha" / "latest").mkdir(parents=True)
+    (root / "beta" / "0").mkdir(parents=True)
+    (root / "beta" / "occupancy_grid.npz").write_bytes(b"x")
+    (root / "noise").mkdir()  # no checkpoint layout: not a scene
+    reg = SceneRegistry.scan(str(root))
+    assert reg.ids() == ["alpha", "beta"]
+    assert reg.get("alpha").grid == ""  # no grid artifact beside it
+    assert reg.get("beta").grid == str(root / "beta" / "occupancy_grid.npz")
+    assert len(SceneRegistry.scan(str(tmp_path / "missing"))) == 0
+
+
+def test_unknown_scene_names_the_known_set():
+    reg = SceneRegistry([SceneRecord("lego")])
+    with pytest.raises(UnknownSceneError, match="lego") as exc:
+        reg.get("shpi")
+    assert exc.value.scene_id == "shpi"
+
+
+# -- residency: LRU, pins, budget --------------------------------------------
+
+
+def test_lru_eviction_order_is_the_acquire_order():
+    mgr, _ = _np_fleet(budget_scenes=2.0)
+    with mgr.lease("a"):
+        pass
+    with mgr.lease("b"):
+        pass
+    with mgr.lease("a"):  # touch: a is now MRU, b is the LRU victim
+        pass
+    with mgr.lease("c"):
+        pass
+    assert mgr.resident_ids() == ["a", "c"]  # b evicted, a survived
+    s = mgr.stats()
+    assert s["evictions"] == 1 and s["cold_loads"] == 3
+    assert s["warm_hits"] == 1  # the second lease of a
+    assert s["resident_bytes"] == 8000 and s["budget_bytes"] == 8000
+
+    with mgr.lease("b"):  # reload: evicts a (LRU after the c admit)
+        pass
+    assert mgr.resident_ids() == ["c", "b"]
+    assert mgr.stats()["evictions"] == 2
+
+
+def test_pinned_scenes_cannot_be_evicted_under_pressure():
+    mgr, _ = _np_fleet(budget_scenes=2.0)
+    with mgr.lease("a"), mgr.lease("b"):
+        assert sorted(mgr.pinned_ids()) == ["a", "b"]
+        with pytest.raises(ResidencyOverloadError) as exc:
+            mgr.acquire("c")  # everything pinned: fail, don't evict
+        assert exc.value.scene_id == "c"
+        assert mgr.resident_ids() == ["a", "b"]  # both survived intact
+        assert mgr.stats()["overloads"] == 1
+    # pins dropped: the same admission now evicts the LRU scene (a)
+    with mgr.lease("c"):
+        assert "c" in mgr.resident_ids() and "a" not in mgr.resident_ids()
+
+
+def test_scene_larger_than_whole_budget_is_rejected():
+    mgr, _ = _np_fleet(budget_scenes=0.5)
+    with pytest.raises(ResidencyOverloadError):
+        mgr.acquire("a")
+    assert mgr.resident_ids() == []
+
+
+def test_loader_error_leaves_no_residue_and_joiners_see_it():
+    calls = {"n": 0}
+
+    def loader(rec):
+        calls["n"] += 1
+        raise SceneLoadError(rec.scene_id, "artifact store down")
+
+    reg = SceneRegistry([SceneRecord("a")])
+    mgr = ResidencyManager(reg, loader, budget_bytes=1 << 20,
+                           verify_checksums=False)
+    for _ in range(2):
+        with pytest.raises(SceneLoadError):
+            mgr.acquire("a")
+    assert calls["n"] == 2  # the failed load is not cached as in-flight
+    assert mgr.resident_ids() == [] and mgr.stats()["load_errors"] == 2
+
+
+def test_transient_oserror_is_retried_to_success():
+    calls = {"n": 0}
+    good = SceneData("a", params={"w": np.zeros(8, np.float32)})
+
+    def loader(rec):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient NFS hiccup")
+        return good
+
+    reg = SceneRegistry([SceneRecord("a")])
+    mgr = ResidencyManager(reg, loader, budget_bytes=1 << 20,
+                           verify_checksums=False,
+                           retry_kw={"attempts": 3, "base_s": 0.0,
+                                     "max_s": 0.0})
+    with mgr.lease("a") as data:
+        assert data.scene_id == "a"
+    assert calls["n"] == 2 and mgr.stats()["load_errors"] == 0
+
+
+def test_pose_cache_is_per_scene_and_survives_eviction():
+    mgr, _ = _np_fleet(budget_scenes=1.0)
+    cache_a = mgr.pose_cache("a")
+    assert mgr.pose_cache("b") is not cache_a
+    with mgr.lease("a"):
+        pass
+    with mgr.lease("b"):  # evicts a
+        pass
+    assert "a" not in mgr.resident_ids()
+    assert mgr.pose_cache("a") is cache_a  # host-side: eviction-proof
+
+
+def test_prefetch_overlaps_and_acquire_joins_it():
+    mgr, datas = _np_fleet(budget_scenes=2.0)
+    assert mgr.prefetch("a") is True
+    assert mgr.prefetch("a") is False       # already in flight (or resident)
+    assert mgr.prefetch("ghost") is False   # unknown scenes never raise here
+    assert mgr.wait_loaded("a", timeout=10.0)
+    with mgr.lease("a") as data:
+        assert np.array_equal(np.asarray(data.params["w"]),
+                              datas["a"].params["w"])
+    s = mgr.stats()
+    assert s["prefetch_issued"] == 1 and s["prefetch_hits"] == 1
+    assert s["cold_loads"] == 0 and s["prefetch_hit_rate"] == 1.0
+
+
+# -- residency + engine: parity and zero recompiles --------------------------
+
+
+def test_prefetch_vs_cold_acquire_bitwise_parity(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    rays = _rays(128)
+
+    mgr_cold, _, _ = _synthetic_fleet(engine, params, grid, bbox)
+    with _attached(engine, mgr_cold):
+        cold = engine.render_request(rays, NEAR, FAR, emit=False, scene="b")
+    assert mgr_cold.stats()["cold_loads"] == 1
+
+    mgr_pre, _, _ = _synthetic_fleet(engine, params, grid, bbox)
+    with _attached(engine, mgr_pre):
+        assert engine.prefetch_scene("b") is True
+        assert mgr_pre.wait_loaded("b", timeout=30.0)
+        warm = engine.render_request(rays, NEAR, FAR, emit=False, scene="b")
+    assert mgr_pre.stats()["prefetch_hits"] == 1
+    assert mgr_pre.stats()["cold_loads"] == 0
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        assert np.array_equal(np.asarray(cold[k]), np.asarray(warm[k])), k
+
+
+def test_scene_switch_stream_zero_recompiles_and_matches_dedicated(setup):
+    """The acceptance contract: a mixed stream over 3 scenes under a
+    budget that forces eviction/reload cycles adds ZERO compiles, and
+    every scene's pixels are bitwise-identical to a dedicated
+    single-scene engine holding that scene's checkpoint directly."""
+    cfg, network, params, grid, bbox, engine = setup
+    mgr, datas, _ = _synthetic_fleet(engine, params, grid, bbox,
+                                     budget_scenes=2.5)
+    rays = _rays(200)  # pads into b256: exercises the padded path too
+    before = engine.tracker.total_compiles()
+    outs = {}
+    with _attached(engine, mgr):
+        for sid in ("a", "b", "c", "a", "c", "b", "a"):
+            outs[sid] = engine.render_request(rays, NEAR, FAR, emit=False,
+                                              scene=sid)
+    assert engine.tracker.total_compiles() == before  # zero steady-state
+    assert mgr.stats()["evictions"] >= 1  # the budget actually churned
+
+    dedicated = RenderEngine(cfg, network, datas["b"].params, near=NEAR,
+                             far=FAR, grid=grid, bbox=bbox,
+                             warmup_families=("full",))
+    ref = dedicated.render_request(rays, NEAR, FAR, emit=False)
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        assert np.array_equal(np.asarray(ref[k]), np.asarray(outs["b"][k])), k
+
+
+def test_default_scene_still_renders_engine_checkpoint(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    rays = _rays(100)
+    solo = engine.render_request(rays, NEAR, FAR, emit=False)
+    mgr, _, _ = _synthetic_fleet(engine, params, grid, bbox)
+    with _attached(engine, mgr):
+        for sid in (None, "default"):  # absent OR named: API-compatible
+            out = engine.render_request(rays, NEAR, FAR, emit=False,
+                                        scene=sid)
+            for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+                assert np.array_equal(np.asarray(solo[k]),
+                                      np.asarray(out[k])), (sid, k)
+    assert mgr.stats()["loads"] == 0  # default never touches the fleet
+
+
+def test_incompatible_scene_rejected_at_load(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    from nerf_replication_tpu.fleet import SceneCompatError
+
+    bad = {
+        "wrong_bounds": SceneData("wrong_bounds", params=params, grid=grid,
+                                  bbox=bbox, near=NEAR, far=FAR + 1.0),
+        "no_grid": SceneData("no_grid", params=params, grid=None, bbox=bbox,
+                             near=NEAR, far=FAR),
+        "wrong_grid": SceneData("wrong_grid", params=params,
+                                grid=np.zeros((8, 8, 8), bool), bbox=bbox,
+                                near=NEAR, far=FAR),
+    }
+    reg = SceneRegistry(SceneRecord(scene_id=s) for s in bad)
+    mgr = ResidencyManager(reg, lambda rec: bad[rec.scene_id],
+                           budget_bytes=1 << 30, verify_checksums=False)
+    with _attached(engine, mgr):
+        for sid in bad:
+            with pytest.raises(SceneCompatError):
+                mgr.acquire(sid)
+        assert mgr.resident_ids() == []  # nothing incompatible committed
+
+
+# -- batcher integration -----------------------------------------------------
+
+
+def test_batcher_coalesces_per_scene(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    mgr, _, _ = _synthetic_fleet(engine, params, grid, bbox)
+    with _attached(engine, mgr):
+        batcher = MicroBatcher(engine, start=False)
+        f1 = batcher.submit(_rays(64), NEAR, FAR, scene="a")
+        f2 = batcher.submit(_rays(64), NEAR, FAR, scene="b")
+        f3 = batcher.submit(_rays(64), NEAR, FAR, scene="a")
+        # one flush = one scene: both a-requests coalesce past the queued
+        # b-request; b renders on the next pump, order preserved
+        assert batcher.pump() == 2
+        assert batcher.queue_depth() == 1
+        assert f1.done() and f3.done() and not f2.done()
+        assert batcher.pump() == 1
+        out_b = f2.result(timeout=5.0)
+
+        direct = engine.render_request(_rays(64), NEAR, FAR, emit=False,
+                                       scene="b")
+        assert np.array_equal(np.asarray(direct["rgb_map_f"]),
+                              np.asarray(out_b["rgb_map_f"]))
+
+
+def test_batcher_scene_error_is_scoped_and_skips_breaker(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    good = SceneData("good", params=jax.tree.map(np.asarray, params),
+                     grid=grid, bbox=bbox, near=NEAR, far=FAR)
+
+    def loader(rec):
+        if rec.scene_id == "bad":
+            raise SceneLoadError("bad", "scene 'bad': torn checkpoint")
+        return good
+
+    reg = SceneRegistry([SceneRecord("good"), SceneRecord("bad")])
+    mgr = ResidencyManager(reg, loader, budget_bytes=1 << 30,
+                           verify_checksums=False, prefetch=False)
+    with _attached(engine, mgr):
+        batcher = MicroBatcher(engine, start=False)
+        f_bad = batcher.submit(_rays(64), NEAR, FAR, scene="bad")
+        f_good = batcher.submit(_rays(64), NEAR, FAR, scene="good")
+        while batcher.queue_depth():
+            batcher.pump()
+        with pytest.raises(SceneLoadError):
+            f_bad.result(timeout=5.0)
+        assert f_good.result(timeout=5.0)["rgb_map_f"].shape == (64, 3)
+        assert batcher.n_scene_errors == 1
+        assert batcher.stats()["n_scene_errors"] == 1
+        # a torn SCENE is not a serving fault: the breaker stays closed
+        assert batcher.breaker.snapshot()["state"] == "closed"
+
+    with pytest.raises(UnknownSceneError):  # 404 at the submission edge
+        batcher.submit(_rays(8), NEAR, FAR, scene="bad")
+
+
+# -- torn checkpoints + HTTP edge --------------------------------------------
+
+
+def _torn_checkpoint_dir(tmp_path) -> str:
+    """A checkpoint dir whose tree checksum no longer matches (a save
+    torn by a mid-write kill after the sidecar landed)."""
+    ckpt = tmp_path / "torn_scene"
+    (ckpt / "latest").mkdir(parents=True)
+    blob = ckpt / "latest" / "data.bin"
+    blob.write_bytes(b"weights" * 128)
+    write_tree_checksum(str(ckpt))
+    blob.write_bytes(b"weights" * 64)  # torn after the checksum landed
+    return str(ckpt)
+
+
+def test_torn_scene_fails_scoped_with_fault_row(setup, tmp_path):
+    cfg, network, params, grid, bbox, engine = setup
+    good = SceneData("good", params=jax.tree.map(np.asarray, params),
+                     grid=grid, bbox=bbox, near=NEAR, far=FAR)
+    reg = SceneRegistry([
+        SceneRecord("good"),
+        SceneRecord("torn", checkpoint=_torn_checkpoint_dir(tmp_path)),
+    ])
+    # checksum gate fires BEFORE the loader: the loader never sees "torn"
+    mgr = ResidencyManager(reg, lambda rec: good, budget_bytes=1 << 30,
+                           verify_checksums=True)
+    path = str(tmp_path / "telemetry.jsonl")
+    emitter = init_run(cfg, component="fleet_test", path=path)
+    try:
+        with pytest.raises(SceneLoadError, match="torn"):
+            mgr.acquire("torn")
+        with mgr.lease("good") as data:  # other scenes keep loading
+            assert data.scene_id == "good"
+    finally:
+        emitter.close()
+        init_run(cfg, component="noop",
+                 path=str(tmp_path / "t2.jsonl")).close()
+    rows = [json.loads(line) for line in open(path)]
+    assert any(r["kind"] == "fault" and r["point"] == "fleet.load"
+               and r["fault"] == "torn" for r in rows)
+    assert mgr.stats()["load_errors"] == 1
+
+
+def test_http_scene_routing_404_503_and_stats(setup, tmp_path):
+    import serve as serve_cli
+
+    cfg, network, params, grid, bbox, engine = setup
+    good = SceneData("good", params=jax.tree.map(np.asarray, params),
+                     grid=grid, bbox=bbox, near=NEAR, far=FAR)
+    reg = SceneRegistry([
+        SceneRecord("good"),
+        SceneRecord("torn", checkpoint=_torn_checkpoint_dir(tmp_path)),
+    ])
+    mgr = ResidencyManager(reg, lambda rec: good, budget_bytes=1 << 30,
+                           verify_checksums=True)
+    engine.default_camera = {"H": 16, "W": 16, "focal": 20.0}
+    with _attached(engine, mgr):
+        server = serve_cli.make_server(engine, None, port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+
+            def post(body):
+                conn.request("POST", "/render", json.dumps(body),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read())
+
+            status, out = post({"theta": 30.0, "scene": "good"})
+            assert status == 200 and out["scene"] == "good"
+
+            status, out = post({"theta": 30.0, "scene": "nope"})
+            assert status == 404 and out["scene"] == "nope"
+
+            # the torn scene 503s; the good scene keeps serving after it
+            status, out = post({"theta": 30.0, "scene": "torn"})
+            assert status == 503 and out["scene"] == "torn"
+            status, out = post({"theta": 31.0, "scene": "good"})
+            assert status == 200
+
+            conn.request("GET", "/stats")
+            resp = conn.getresponse()
+            stats = json.loads(resp.read())
+            assert resp.status == 200
+            fleet = stats["fleet"]
+            assert fleet["resident"] == ["good"]
+            assert fleet["load_errors"] >= 1 and fleet["known_scenes"] == 2
+        finally:
+            server.shutdown()
+            server.server_close()
+            engine.default_camera = None
+
+
+def test_scene_request_without_fleet_is_unknown(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    assert engine.fleet is None
+    with pytest.raises(UnknownSceneError):
+        engine.render_request(_rays(8), NEAR, FAR, emit=False, scene="lego")
+
+
+# -- telemetry schema --------------------------------------------------------
+
+
+def test_fleet_rows_validate_against_schema(setup, tmp_path):
+    cfg, network, params, grid, bbox, engine = setup
+    mgr, _, _ = _synthetic_fleet(engine, params, grid, bbox,
+                                 budget_scenes=1.5)
+    path = str(tmp_path / "telemetry.jsonl")
+    emitter = init_run(cfg, component="fleet_test", path=path)
+    try:
+        with _attached(engine, mgr):
+            mgr.prefetch("a")
+            mgr.wait_loaded("a", timeout=30.0)
+            batcher = MicroBatcher(engine, start=False)
+            futures = [batcher.submit(_rays(64), NEAR, FAR, scene=s)
+                       for s in ("a", "b")]  # b's admit evicts a
+            while batcher.queue_depth():
+                batcher.pump()
+            for f in futures:
+                f.result(timeout=5.0)
+    finally:
+        emitter.close()
+        init_run(cfg, component="noop",
+                 path=str(tmp_path / "t2.jsonl")).close()
+    rows = [json.loads(line) for line in open(path)]
+    for r in rows:
+        assert validate_row(r) == [], r
+    loads = [r for r in rows if r["kind"] == "scene_load"]
+    assert {r["source"] for r in loads} == {"prefetch", "cold"}
+    assert all(r["bytes"] > 0 and r["resident_bytes"] <= mgr.budget_bytes
+               for r in loads)
+    evicts = [r for r in rows if r["kind"] == "scene_evict"]
+    assert evicts and evicts[0]["scene"] == "a"
+    assert evicts[0]["reason"] == "budget"
+    scened = [r for r in rows if r["kind"] == "serve_request"
+              and "scene" in r]
+    assert {r["scene"] for r in scened} == {"a", "b"}
+    assert any(r["kind"] == "serve_batch" and r.get("scene") == "a"
+               for r in rows)
+
+
+def test_tlm_report_summarizes_and_gates_fleet_rows(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import tlm_report
+
+    from nerf_replication_tpu.obs.emit import Emitter
+
+    def write_run(path, cold, prefetched, evictions):
+        with Emitter(path, chief=True) as em:
+            em.emit("run_meta", run_id=em.run_id, component="serve",
+                    config_hash="x", process_index=0, process_count=1,
+                    device_count=1, local_device_count=1, platform="cpu")
+            for i in range(cold):
+                em.emit("scene_load", scene=f"c{i}", bytes=1000,
+                        source="cold", resident=1, resident_bytes=1000)
+            for i in range(prefetched):
+                em.emit("scene_load", scene=f"p{i}", bytes=1000,
+                        source="prefetch", resident=2, resident_bytes=2000)
+            for i in range(evictions):
+                em.emit("scene_evict", scene=f"c{i}", bytes=1000,
+                        reason="budget", resident=1, resident_bytes=1000)
+
+    base = str(tmp_path / "base.jsonl")
+    cand = str(tmp_path / "cand.jsonl")
+    write_run(base, cold=1, prefetched=3, evictions=2)
+    write_run(cand, cold=4, prefetched=0, evictions=7)
+
+    s = tlm_report.summarize(tlm_report.load_rows(base))
+    assert s["fleet_scene_loads"] == 4
+    assert s["fleet_cold_loads"] == 1 and s["fleet_prefetch_loads"] == 3
+    assert s["fleet_prefetch_share"] == pytest.approx(0.75)
+    assert s["fleet_evictions"] == 2
+    assert s["fleet_bytes_loaded"] == 4000
+
+    s2 = tlm_report.summarize(tlm_report.load_rows(cand))
+    flags = tlm_report.diff(s, s2, gate_pct=10.0)
+    assert any("evictions grew 2 -> 7" in f for f in flags)
+    assert any("cold scene loads grew 1 -> 4" in f for f in flags)
+    assert tlm_report.diff(s, s, gate_pct=10.0) == []
+
+    plain = tlm_report.summarize([])  # non-fleet runs stay unchanged
+    assert "fleet_scene_loads" not in plain
+
+
+def test_fleet_bench_rows_validate_as_bench_family():
+    from nerf_replication_tpu.obs.schema import validate_bench_row
+
+    row = {"fleet_mode": "churn", "n_scenes": 3, "evictions": 4,
+           "prefetch_hit_rate": 0.75, "p95_same_ms": 12.0,
+           "p95_switch_ms": 19.0}
+    assert validate_bench_row(row) == []
+    assert validate_bench_row({"fleet_mode": "churn"})  # missing fields
+
+
+# -- AOT warm restart (docs/compilation.md gap) ------------------------------
+
+
+# Runs in a fresh interpreter, twice over one artifact dir: the first run
+# compiles + serializes, the second deserializes. Both legs MUST be real
+# subprocesses — the pytest process keeps a persistent XLA compilation
+# cache, and a cache-materialized executable does not re-serialize
+# (save_artifact's round-trip gate would skip it), so an in-process build
+# leg could never write the artifacts the warm leg depends on.
+_WARM_RESTART_CHILD = """\
+import json, sys
+import numpy as np
+import jax
+
+tests_dir, repo_dir, root, cache_dir, out_npz = sys.argv[1:6]
+sys.path.insert(0, tests_dir)
+sys.path.insert(0, repo_dir)
+import test_fleet as tf
+from test_train import tiny_cfg
+from nerf_replication_tpu.compile import AOTRegistry
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.obs import CompileTracker
+from nerf_replication_tpu.serve import RenderEngine
+
+cfg = tiny_cfg(root, tf._CFG_OPTS)
+network = make_network(cfg)
+params = init_params(network, jax.random.PRNGKey(0))
+bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+grid = np.zeros((16, 16, 16), bool)
+grid[4:12, 4:12, 4:12] = True
+tracker = CompileTracker()
+reg = AOTRegistry(cache_dir=cache_dir, config_hash="fleet",
+                  tracker=tracker)
+eng = RenderEngine(cfg, network, params, near=tf.NEAR, far=tf.FAR,
+                   grid=grid, bbox=bbox, tracker=tracker,
+                   warmup_families=("full",), aot=reg)
+mgr, _, _ = tf._synthetic_fleet(eng, params, grid, bbox)
+eng.attach_fleet(mgr)
+out = eng.render_request(tf._rays(128), tf.NEAR, tf.FAR, emit=False,
+                         scene="b")
+np.savez(out_npz, **{k: np.asarray(out[k])
+                     for k in ("rgb_map_f", "depth_map_f", "acc_map_f")})
+print(json.dumps({"warm_source": eng.warm_source,
+                  "compiles": tracker.total_compiles(),
+                  "sources": reg.summary()["sources"]}))
+"""
+
+
+def _run_warm_restart_child(cfg, cache_dir: str, out_npz: str) -> dict:
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _WARM_RESTART_CHILD, tests_dir,
+         os.path.dirname(tests_dir), str(cfg.train_dataset.data_root),
+         cache_dir, out_npz],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    report["stderr"] = proc.stderr[-2000:]
+    return report
+
+
+def test_fleet_engine_warm_restarts_from_disk_with_zero_builds(setup,
+                                                               tmp_path):
+    """The compilation-doc satellite: process one pays the compiles and
+    serializes every scene-agnostic serve executable; process two (fresh
+    tracker, fresh registry, same artifact dir) warms the whole inventory
+    from disk — zero builds — and renders fleet scenes bitwise-identically
+    to the process that paid."""
+    cfg = setup[0]
+    cache_dir = str(tmp_path / "aot")
+    ref_npz = str(tmp_path / "build_out.npz")
+    out_npz = str(tmp_path / "warm_out.npz")
+
+    build = _run_warm_restart_child(cfg, cache_dir, ref_npz)
+    assert build["warm_source"] == "compiled", build
+    assert build["compiles"] > 0 and build["sources"] == {"compiled": 2}
+
+    warm = _run_warm_restart_child(cfg, cache_dir, out_npz)
+    assert warm["warm_source"] == "disk", warm
+    assert warm["compiles"] == 0  # the whole inventory deserialized
+    assert warm["sources"] == {"disk": 2}
+
+    with np.load(ref_npz) as ref, np.load(out_npz) as out:
+        for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+            assert np.array_equal(ref[k], out[k]), k
+
+
+# -- real checkpoints: loader + config wiring --------------------------------
+
+
+def test_checkpoint_loader_and_fleet_from_cfg(setup, tmp_path):
+    from nerf_replication_tpu.renderer.occupancy import save_occupancy_grid
+    from nerf_replication_tpu.train import make_train_state
+
+    cfg, network, params, grid, bbox, engine = setup
+    state, _ = make_train_state(cfg, network, jax.random.PRNGKey(3))
+    store = tmp_path / "scenes"
+    ckpt = str(store / "lego")
+    from nerf_replication_tpu.train.checkpoint import save_model
+
+    save_model(ckpt, state, 0, None, latest=True)
+    write_tree_checksum(ckpt)
+    grid_path = str(store / "lego_grid.npz")
+    save_occupancy_grid(grid_path, grid, np.asarray(bbox), 0.5)
+    manifest = str(store / "manifest.json")
+    SceneRegistry([
+        SceneRecord("lego", checkpoint=ckpt, grid=grid_path),
+    ]).to_manifest(manifest)
+
+    root = str(cfg.train_dataset.data_root)
+    cfg2 = tiny_cfg(root, ["fleet.manifest", manifest,
+                           "fleet.hbm_budget_mb", "64.0"])
+    mgr = fleet_from_cfg(cfg2, engine)
+    try:
+        assert mgr is not None and engine.fleet is mgr
+        assert mgr.registry.ids() == ["lego"]
+        with engine.scene_lease("lego") as data:
+            for ours, theirs in zip(jax.tree.leaves(state.params),
+                                    jax.tree.leaves(data.params)):
+                assert np.array_equal(np.asarray(ours), np.asarray(theirs))
+            assert data.near == NEAR and data.far == FAR
+            assert tuple(data.grid.shape) == grid.shape
+    finally:
+        engine.fleet = None
+        engine.default_scene = "default"
+
+    # no fleet block configured -> single-tenant serving, no manager
+    cfg3 = tiny_cfg(root, [])
+    assert fleet_from_cfg(cfg3, engine) is None
+    assert engine.fleet is None
+
+
+def test_checkpoint_loader_requires_a_checkpoint(setup, tmp_path):
+    cfg, network, params, grid, bbox, engine = setup
+    loader = checkpoint_loader(params, default_near=NEAR, default_far=FAR)
+    with pytest.raises(SceneLoadError, match="no checkpoint"):
+        loader(SceneRecord("ghost", checkpoint=str(tmp_path / "nope")))

@@ -50,6 +50,7 @@ RULE_IDS = (
     "config-key",   # R6
     "aot",          # R7
     "swallow",      # R8
+    "emit-hot",     # R9
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ok(?:\(([^)]*)\))?")

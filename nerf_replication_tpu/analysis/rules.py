@@ -1,4 +1,4 @@
-"""graftlint rules R1-R8 — JAX hazards tuned to this codebase's idioms.
+"""graftlint rules R1-R9 — JAX hazards tuned to this codebase's idioms.
 
 Each rule encodes one of the failure modes PR 1's telemetry made observable
 at runtime (obs/: CompileTracker retraces, dispatch-vs-block stalls, HBM
@@ -27,6 +27,10 @@ rule id                hazard
 ``swallow``     (R8)   ``except Exception`` / bare ``except`` in library
                        code that neither re-raises nor emits telemetry —
                        the failure disappears from every record
+``emit-hot``    (R9)   ``Emitter.emit`` / metrics-registry calls inside a
+                       jit-traced or dispatch-hot body — telemetry runs at
+                       trace time (traced) or per dispatch (hot); move to
+                       batch cadence or suppress with a reason
 =====================  ==========================================================
 """
 
@@ -1152,3 +1156,96 @@ class SwallowRule(Rule):
                 if chain and chain[-1] in _SWALLOW_SIGNALS:
                     return True
         return False
+
+
+# --------------------------------------------------------------------------
+# R9 emit-hot
+# --------------------------------------------------------------------------
+
+
+@register
+class EmitHotRule(Rule):
+    """R9: telemetry/metrics writes inside traced or dispatch-hot bodies.
+
+    Inside a **jit-traced** body an ``emit``/metrics call runs at TRACE
+    time — once per compile, never per step — so the telemetry it appears
+    to produce is a lie, and the file/lock side effects leak into tracing.
+    Inside a **dispatch-hot** body (``# graftlint: hot``) the call is real
+    but rides the latency-critical path on every dispatch; the sanctioned
+    shapes are batch-cadence records and post-sync completion rows, which
+    suppress with a reason (the serve batcher's per-batch rows are the
+    worked example).
+
+    Matched receivers: ``get_emitter().emit`` / ``<...>emitter.emit``
+    (obs/emit.py) and ``get_metrics().counter|gauge|observe`` /
+    ``metrics.*`` / ``mx.*`` (obs/metrics.py). Span context managers are
+    deliberately NOT flagged — obs/trace.py is the sanctioned hot-path
+    instrument and its disabled cost is one null contextmanager.
+    """
+
+    rule_id = "emit-hot"
+    doc = (
+        "Emitter.emit / metrics-registry call inside a jit-traced or "
+        "dispatch-hot body — traced: runs at trace time, not per step; "
+        "hot: telemetry rides the latency-critical path on every "
+        "dispatch; move to batch cadence / post-sync or suppress with "
+        "a reason"
+    )
+
+    _METRIC_METHODS = ("counter", "gauge", "observe")
+    _METRIC_RECEIVERS = ("metrics", "mx", "registry")
+
+    def check(self, module: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for info in module.functions.values():
+            if not (info.traced or info.hot):
+                continue
+            where = "jit-traced" if info.traced else "dispatch-hot"
+            for node in _walk_scope(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                desc = self._classify(node)
+                if desc is None:
+                    continue
+                f = module.finding(
+                    self.rule_id,
+                    node,
+                    f"`{desc}` inside {where} `{info.qualname}` — "
+                    + ("telemetry in a traced body runs at trace time "
+                       "(once per compile), not per step"
+                       if info.traced else
+                       "telemetry on the dispatch-hot path; keep it at "
+                       "batch cadence / post-sync, or suppress with a "
+                       "reason"),
+                )
+                if f is not None:
+                    findings.append(f)
+        return findings
+
+    def _classify(self, node: ast.Call) -> str | None:
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = func.value
+        # get_emitter().emit(...) / get_metrics().observe(...): the chain
+        # helper bottoms out at a Call, so match the inner call directly
+        if isinstance(recv, ast.Call):
+            inner = _attr_chain(recv.func)
+            base = inner[-1] if inner else ""
+            if attr == "emit" and base == "get_emitter":
+                return "get_emitter().emit"
+            if attr in self._METRIC_METHODS and base == "get_metrics":
+                return f"get_metrics().{attr}"
+            return None
+        chain = _attr_chain(recv)
+        if not chain:
+            return None
+        last = chain[-1]
+        if attr == "emit" and last.endswith("emitter"):
+            return ".".join(chain + [attr])
+        if attr in self._METRIC_METHODS and (
+            last in self._METRIC_RECEIVERS or last.endswith("metrics")
+        ):
+            return ".".join(chain + [attr])
+        return None

@@ -213,6 +213,18 @@ def default_cfg() -> ConfigNode:
         }
     )
 
+    # observability knobs (nerf_replication_tpu/obs, docs/observability.md):
+    # request-scoped span tracing, the crash flight recorder's ring size,
+    # and the latency target /healthz's SLO view is computed against
+    cfg.obs = ConfigNode(
+        {
+            "trace": True,           # span tracing on the serve path
+            "trace_ring": 256,       # flight recorder span-ring capacity
+            "flight_dir": "",        # "" -> record_dir (flight_<reason>.json)
+            "slo_target_ms": 100.0,  # /healthz SLO attainment target
+        }
+    )
+
     return cfg
 
 

@@ -42,6 +42,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, replace
 
 from ..obs import get_emitter
+from ..obs.trace import current_ctx, get_tracer
 from ..resil import fault_point, report, verify_tree_checksum, with_retry
 from ..serve.cache import PoseCache
 from .errors import ResidencyOverloadError, SceneLoadError
@@ -145,47 +146,59 @@ class ResidencyManager:
         running); the caller MUST :meth:`release` — ``lease`` is the
         safe surface."""
         global _TOUCH
-        while True:
-            with self._cond:
-                resident = self._resident.get(scene_id)
-                if resident is not None:
-                    resident.refcount += 1
-                    _TOUCH += 1
-                    resident.touch = _TOUCH
-                    self._resident.move_to_end(scene_id)
-                    if not resident.ever_acquired:
-                        # first pin after materialization: a prefetch hit,
-                        # or the tail of this thread's own cold load
-                        # (already counted at load start)
-                        if resident.source == "prefetch":
-                            self.prefetch_hits += 1
-                    else:
-                        self.warm_hits += 1
-                    resident.ever_acquired = True
-                    return resident.data
-                load = self._loading.get(scene_id)
-                if load is None:
-                    # miss with no in-flight load: this thread cold-loads
-                    load = _Load("cold")
-                    self._loading[scene_id] = load
-                    self.cold_loads += 1
-                    started_here = True
-                else:
-                    started_here = False
-            if not started_here:
-                load.event.wait()
-                if load.error is not None:
-                    raise load.error
-                continue  # committed by the loader thread; loop to pin
-            try:
-                self._load_and_commit(scene_id, source="cold")
-            except BaseException as err:
-                load.error = err
-                raise
-            finally:
+        # the acquire span covers the whole pin — a warm hit closes it in
+        # microseconds, a prefetch join waits under it (attributed via
+        # `joined`), and a cold load nests a child "scene.load" span
+        with get_tracer().span("scene.acquire", stage="acquire",
+                               scene=scene_id) as sp:
+            while True:
                 with self._cond:
-                    self._loading.pop(scene_id, None)
-                load.event.set()
+                    resident = self._resident.get(scene_id)
+                    if resident is not None:
+                        resident.refcount += 1
+                        _TOUCH += 1
+                        resident.touch = _TOUCH
+                        self._resident.move_to_end(scene_id)
+                        if not resident.ever_acquired:
+                            # first pin after materialization: a prefetch
+                            # hit, or the tail of this thread's own cold
+                            # load (already counted at load start)
+                            if resident.source == "prefetch":
+                                self.prefetch_hits += 1
+                        else:
+                            self.warm_hits += 1
+                        resident.ever_acquired = True
+                        return resident.data
+                    load = self._loading.get(scene_id)
+                    if load is None:
+                        # miss with no in-flight load: this thread
+                        # cold-loads
+                        load = _Load("cold")
+                        self._loading[scene_id] = load
+                        self.cold_loads += 1
+                        started_here = True
+                    else:
+                        started_here = False
+                if not started_here:
+                    # joining someone else's in-flight load (usually the
+                    # prefetch thread): the wait is queue-shaped, not
+                    # work-shaped — mark whose load we rode
+                    sp.set(joined=load.source)
+                    load.event.wait()
+                    if load.error is not None:
+                        raise load.error
+                    continue  # committed by the loader thread; loop to pin
+                try:
+                    with get_tracer().span("scene.load", stage="load",
+                                           scene=scene_id, source="cold"):
+                        self._load_and_commit(scene_id, source="cold")
+                except BaseException as err:
+                    load.error = err
+                    raise
+                finally:
+                    with self._cond:
+                        self._loading.pop(scene_id, None)
+                    load.event.set()
 
     def release(self, scene_id: str) -> None:
         with self._cond:
@@ -219,9 +232,18 @@ class ResidencyManager:
             self._loading[scene_id] = load
             self.prefetch_issued += 1
 
+        # capture the SUBMITTING thread's span context now: the prefetch
+        # thread has no inherited context, so the load span is explicitly
+        # parented to the request that kicked the prefetch — the
+        # cross-thread attribution tests/test_trace.py pins down
+        ctx = current_ctx()
+
         def _main():
             try:
-                self._load_and_commit(scene_id, source="prefetch")
+                with get_tracer().span("scene.load", parent=ctx,
+                                       stage="load", scene=scene_id,
+                                       source="prefetch"):
+                    self._load_and_commit(scene_id, source="prefetch")
             # graftlint: ok(swallow: error re-raised on the joining acquire; load_errors counted here)
             except BaseException as err:
                 load.error = err

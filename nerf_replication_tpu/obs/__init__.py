@@ -20,19 +20,39 @@ distinguishes latency-bound from compute-bound regressions.
 
 from .emit import Emitter, NullEmitter, append_jsonl, get_emitter, init_run
 from .hooks import CompileTracker, sample_memory
+from .metrics import MetricsRegistry, get_metrics, reset_metrics
 from .profiling import ProfileWindow, annotate
 from .schema import SCHEMA_VERSION, validate_bench_row, validate_row
+from .trace import (
+    Span,
+    SpanContext,
+    Tracer,
+    configure_tracing,
+    current_ctx,
+    current_span,
+    get_tracer,
+)
 
 __all__ = [
     "SCHEMA_VERSION",
     "Emitter",
+    "MetricsRegistry",
     "NullEmitter",
     "CompileTracker",
     "ProfileWindow",
+    "Span",
+    "SpanContext",
+    "Tracer",
     "annotate",
     "append_jsonl",
+    "configure_tracing",
+    "current_ctx",
+    "current_span",
     "get_emitter",
+    "get_metrics",
+    "get_tracer",
     "init_run",
+    "reset_metrics",
     "sample_memory",
     "validate_bench_row",
     "validate_row",

@@ -1,0 +1,224 @@
+"""Lock-cheap live aggregation: counters, gauges, fixed-bucket histograms.
+
+``telemetry.jsonl`` answers questions after a run; an operator curl-ing a
+serving replica needs answers *now*. This registry is the live side:
+every serve-path event also bumps an in-memory aggregate — O(1) dict
+updates under one short-held lock, no allocation proportional to traffic
+— and two read surfaces render it on demand:
+
+* :meth:`MetricsRegistry.render_prometheus` — Prometheus text exposition
+  (``GET /metrics`` on serve.py) with counters, gauges, and cumulative
+  ``_bucket``/``_sum``/``_count`` histogram series.
+* :meth:`MetricsRegistry.slo_view` — the operator's one-glance health
+  verdict folded into ``/healthz``: latency attainment against the
+  configured target plus shed/timeout/error/breaker rates.
+
+Histogram buckets are FIXED at registration (the classic Prometheus
+latency ladder) rather than adaptive: fixed buckets make the hot-path
+update a bisect + increment, and make attainment a cumulative-count read
+with no quantile estimation. Snapshots serialize as the
+``metrics_snapshot`` row kind for offline diffing.
+
+Host-side only — no jax import, nothing here runs under trace.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+# Prometheus' classic latency ladder, in seconds. serve targets sit
+# around 50-250 ms, so the ladder brackets the SLO from both sides.
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _label_str(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1: the +Inf bucket
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.total += value
+        self.count += 1
+
+    def cumulative(self) -> list[int]:
+        out, acc = [], 0
+        for c in self.counts:
+            acc += c
+            out.append(acc)
+        return out
+
+
+class MetricsRegistry:
+    """Names → labeled series. One lock; every mutation is a dict update."""
+
+    def __init__(self, buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = {}
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._hists: dict[tuple[str, tuple], _Histogram] = {}
+
+    # -- writes (hot path) ---------------------------------------------------
+
+    def counter(self, name: str, value: float = 1.0, **labels) -> None:
+        k = (name, _label_key(labels))
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + value
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        k = (name, _label_key(labels))
+        with self._lock:
+            self._gauges[k] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        k = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = _Histogram(self.buckets)
+            h.observe(float(value))
+
+    # -- reads ---------------------------------------------------------------
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: (h.buckets, h.cumulative(), h.total, h.count)
+                     for k, h in self._hists.items()}
+        lines: list[str] = []
+        seen: set[str] = set()
+        for (name, key), val in sorted(counters.items()):
+            if name not in seen:
+                lines.append(f"# TYPE {name} counter")
+                seen.add(name)
+            lines.append(f"{name}{_label_str(key)} {_fmt(val)}")
+        for (name, key), val in sorted(gauges.items()):
+            if name not in seen:
+                lines.append(f"# TYPE {name} gauge")
+                seen.add(name)
+            lines.append(f"{name}{_label_str(key)} {_fmt(val)}")
+        for (name, key), (buckets, cum, total, count) in sorted(hists.items()):
+            if name not in seen:
+                lines.append(f"# TYPE {name} histogram")
+                seen.add(name)
+            for edge, c in zip(buckets, cum):
+                le = dict(key)
+                le["le"] = _fmt(edge)
+                lines.append(f"{name}_bucket{_label_str(_label_key(le))} {c}")
+            inf = dict(key)
+            inf["le"] = "+Inf"
+            lines.append(
+                f"{name}_bucket{_label_str(_label_key(inf))} {cum[-1]}")
+            lines.append(f"{name}_sum{_label_str(key)} {_fmt(total)}")
+            lines.append(f"{name}_count{_label_str(key)} {count}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able aggregate state — the ``metrics_snapshot`` row body."""
+        with self._lock:
+            return {
+                "counters": {f"{n}{_label_str(k)}": v
+                             for (n, k), v in self._counters.items()},
+                "gauges": {f"{n}{_label_str(k)}": v
+                           for (n, k), v in self._gauges.items()},
+                "histograms": {
+                    f"{n}{_label_str(k)}": {
+                        "buckets": list(h.buckets),
+                        "counts": list(h.counts),
+                        "sum": h.total,
+                        "count": h.count,
+                    }
+                    for (n, k), h in self._hists.items()
+                },
+            }
+
+    def slo_view(self, target_s: float) -> dict:
+        """Attainment vs. the latency target + failure rates, aggregated
+        across labels. Attainment is read at the smallest histogram edge
+        >= target (fixed buckets: no interpolation, no estimator)."""
+        with self._lock:
+            lat_count = 0
+            lat_attained = 0
+            for (name, _key), h in self._hists.items():
+                if name != "serve_request_latency_seconds":
+                    continue
+                cum = h.cumulative()
+                i = bisect.bisect_left(h.buckets, target_s)
+                edge_hits = cum[min(i, len(cum) - 1)] if i < len(h.buckets) \
+                    else cum[-1]
+                lat_attained += edge_hits
+                lat_count += h.count
+            totals: dict[str, float] = {}
+            by_status: dict[str, float] = {}
+            breaker_opens = 0.0
+            for (name, key), v in self._counters.items():
+                totals[name] = totals.get(name, 0.0) + v
+                if name == "serve_requests_total":
+                    status = dict(key).get("status", "")
+                    by_status[status] = by_status.get(status, 0.0) + v
+                elif (name == "serve_breaker_transitions_total"
+                        and dict(key).get("state") == "open"):
+                    breaker_opens += v
+        requests = totals.get("serve_requests_total", 0.0)
+
+        def rate(n: float) -> float:
+            return round(n / requests, 4) if requests else 0.0
+
+        return {
+            "target_ms": round(target_s * 1e3, 3),
+            "requests": int(requests),
+            "attainment": round(lat_attained / lat_count, 4)
+            if lat_count else None,
+            "shed_rate": rate(totals.get("serve_sheds_total", 0.0)),
+            "timeout_rate": rate(by_status.get("timeout", 0.0)),
+            "error_rate": rate(sum(v for s, v in by_status.items()
+                                   if s.startswith("error"))),
+            "breaker_opens": int(breaker_opens),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+_registry = MetricsRegistry()
+
+
+def get_metrics() -> MetricsRegistry:
+    """The process's live registry (always on; writing is cheap enough
+    that there is no disable switch — tracing has one, metrics don't)."""
+    return _registry
+
+
+def reset_metrics() -> None:
+    """Test isolation: wipe the process registry between cases."""
+    _registry.reset()

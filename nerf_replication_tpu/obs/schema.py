@@ -161,6 +161,29 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"point": (str,), "failures": _NUM, "consecutive": _NUM,
          "tier": (str,), "retry_after_s": _NUM},
     ),
+    # -- tracing rows (nerf_replication_tpu/obs/trace.py) --------------------
+    # one per finished span: a timed unit of work in the serve pipeline,
+    # joinable into a per-request tree via (trace_id, parent_id). start_s
+    # is on the tracer's clock (perf_counter), NOT unix time — only
+    # differences and within-run ordering are meaningful. stage tags the
+    # latency taxonomy (queue | acquire | load | dispatch | device |
+    # scatter); joined/source attribute prefetch joins in fleet residency.
+    "span": (
+        {"trace_id": (str,), "span_id": (str,), "name": (str,),
+         "start_s": _NUM, "dur_s": _NUM},
+        {"parent_id": (str, type(None)), "thread": (str,), "stage": (str,),
+         "tier": (str,), "scene": (str, type(None)), "status": (str,),
+         "n_rays": _NUM, "n_requests": _NUM, "joined": (str,),
+         "source": (str,), "family": (str,), "bucket": _NUM,
+         "queue_depth": _NUM, "detail": (str,)},
+    ),
+    # one per live-aggregation dump (obs/metrics.py snapshot()): the
+    # counters/gauges/histograms behind GET /metrics, serialized for
+    # offline diffing; slo is the /healthz attainment view at dump time
+    "metrics_snapshot": (
+        {"counters": (dict,), "gauges": (dict,), "histograms": (dict,)},
+        {"slo": (dict,)},
+    ),
     # -- static analysis (nerf_replication_tpu/analysis) ---------------------
     # one per scripts/graftlint.py run: finding counts split new-vs-baseline
     # so the report can watch the baseline shrink (and flag a lint gate

@@ -31,6 +31,15 @@ from .faults import (
     truncate_file,
     uninstall,
 )
+from .flight import (
+    FlightRecorder,
+    dump_flight,
+    get_flight_recorder,
+    install_flight_recorder,
+    note_flight,
+    uninstall_flight_recorder,
+    validate_flight_dump,
+)
 from .guard import DivergenceError, PreemptionGuard, check_finite
 from .retry import RETRY_ATTEMPTS, retry_params, with_retry
 
@@ -38,6 +47,7 @@ __all__ = [
     "BreakerOpenError",
     "CircuitBreaker",
     "DivergenceError",
+    "FlightRecorder",
     "FAULT_KINDS",
     "FAULT_POINTS",
     "FaultPlan",
@@ -48,12 +58,18 @@ __all__ = [
     "SimulatedKill",
     "active",
     "check_finite",
+    "dump_flight",
     "fault_point",
     "file_sha256",
+    "get_flight_recorder",
     "injecting",
     "install",
+    "install_flight_recorder",
+    "note_flight",
     "report",
     "retry_params",
+    "uninstall_flight_recorder",
+    "validate_flight_dump",
     "tree_sha256",
     "truncate_file",
     "uninstall",

@@ -20,6 +20,8 @@ import threading
 import time
 
 from ..obs.emit import get_emitter
+from ..obs.metrics import get_metrics
+from .flight import dump_flight
 
 
 class BreakerOpenError(RuntimeError):
@@ -78,6 +80,15 @@ class CircuitBreaker:
             failures=self._failures, consecutive=self._consecutive,
             retry_after_s=self.retry_after_s(locked=True),
         )
+        get_metrics().counter("serve_breaker_transitions_total", state=state)
+        if state == "open":
+            # post-mortem snapshot at the moment the dispatch path was
+            # declared dead; the recorder has its own lock, never this one
+            dump_flight(
+                "breaker_open",
+                detail=f"point={self.point} failures={self._failures} "
+                       f"consecutive={self._consecutive}",
+            )
 
     @property
     def state(self) -> str:

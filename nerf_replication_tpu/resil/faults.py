@@ -41,6 +41,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 
 from ..obs.emit import get_emitter
+from .flight import note_flight
 
 # The named fault points the library is instrumented with. A FaultSpec
 # naming anything else is rejected at construction, so a chaos plan can
@@ -197,6 +198,9 @@ def fault_point(point: str, path: str | None = None,
     if spec.kind == "latency":
         fields["delay_s"] = spec.delay_s
     get_emitter().emit("fault", point=point, fault=spec.kind, **fields)
+    # same row into the flight recorder's event ring, so a post-mortem
+    # dump names the injected fault next to the span timeline
+    note_flight(point=point, fault=spec.kind, **fields)
     if spec.kind == "latency":
         time.sleep(spec.delay_s)
     elif spec.kind == "truncate":
@@ -250,3 +254,4 @@ def report(point: str, fault: str, *, path: str | None = None,
     if step is not None:
         fields["step"] = int(step)
     get_emitter().emit("fault", point=point, fault=fault, **fields)
+    note_flight(point=point, fault=fault, **fields)

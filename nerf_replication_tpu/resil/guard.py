@@ -19,6 +19,7 @@ import signal
 import threading
 
 from .faults import fault_point, report
+from .flight import dump_flight
 
 
 class DivergenceError(RuntimeError):
@@ -74,6 +75,11 @@ class PreemptionGuard:
 
     def _on_signal(self, signum, frame):
         self._event.set()
+        try:
+            dump_flight("sigterm", detail=f"signum={signum}")
+        # graftlint: ok(swallow: a signal handler must never raise)
+        except Exception:
+            pass
 
     @property
     def triggered(self) -> bool:

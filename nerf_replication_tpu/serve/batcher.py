@@ -33,8 +33,16 @@ import numpy as np
 
 from ..fleet.errors import SceneError
 from ..obs import get_emitter
+from ..obs.metrics import get_metrics
+from ..obs.trace import current_ctx, get_tracer
 from ..renderer.gate import check_baked_bounds
-from ..resil import BreakerOpenError, CircuitBreaker, fault_point, report
+from ..resil import (
+    BreakerOpenError,
+    CircuitBreaker,
+    dump_flight,
+    fault_point,
+    report,
+)
 from .policy import TIER_IMPL, TIER_NAMES, DegradationPolicy
 
 
@@ -79,6 +87,13 @@ class _Pending:
     future: ServeFuture
     t_enqueued: float
     scene: str | None = None
+    # trace context captured on the submitting (HTTP) thread — the queue
+    # entry is how a request's identity crosses into the worker thread.
+    # t_trace is the enqueue time on the TRACER's clock (the batcher's
+    # own clock is separately injectable), so queue-wait spans share a
+    # timebase with every other span of the trace.
+    ctx: object | None = None
+    t_trace: float = 0.0
     n_rays: int = field(init=False)
 
     def __post_init__(self):
@@ -151,6 +166,10 @@ class MicroBatcher:
             self.worker_restarts += 1
             report("serve.flush", "crash",
                    detail=f"worker dead; restart #{self.worker_restarts}")
+            dump_flight(
+                "watchdog_crash",
+                detail=f"serve worker dead; restart #{self.worker_restarts}",
+            )
             # belt-and-braces: normally the dying worker already failed
             # its own in-flight batch (_worker_main)
             self._fail_inflight()
@@ -197,13 +216,16 @@ class MicroBatcher:
             raise ValueError(
                 f"rays must be a non-empty [N, C] array, got {rays.shape}"
             )
+        trs = get_tracer()
         pending = _Pending(rays, ServeFuture(rays.shape[0]), self.clock(),
-                           scene=scene)
+                           scene=scene, ctx=current_ctx(), t_trace=trs.now())
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is closed")
             self._queue.append(pending)
+            depth = len(self._queue)
             self._cond.notify_all()
+        get_metrics().gauge("serve_queue_depth", depth)
         return pending.future
 
     def queue_depth(self) -> int:
@@ -298,7 +320,10 @@ class MicroBatcher:
     # graftlint: hot
     def _render_batch(self, batch: list[_Pending], queue_depth: int) -> int:
         emitter = get_emitter()
+        trs = get_tracer()
+        mx = get_metrics()
         now = self.clock()
+        t_cut = trs.now()  # queue wait ends here, on the tracer's clock
 
         # fail queued-past-deadline requests before spending compute
         live: list[_Pending] = []
@@ -310,14 +335,31 @@ class MicroBatcher:
                     f"request waited {waited:.3f}s in queue "
                     f"(timeout {self.options.request_timeout_s}s)"
                 ))
+                # graftlint: ok(emit-hot: timeout fail-fast path, not per-ray work)
                 emitter.emit(
                     "serve_request", latency_s=waited, n_rays=p.n_rays,
                     tier="none", status="timeout", queue_s=waited,
                 )
+                trs.record("serve.queue", start_s=p.t_trace, end_s=t_cut,
+                           parent=p.ctx, stage="queue", n_rays=p.n_rays,
+                           status="timeout")
+                # graftlint: ok(emit-hot: timeout fail-fast path, not per-ray work)
+                mx.counter("serve_requests_total", status="timeout",
+                           tier="none")
+                # graftlint: ok(emit-hot: timeout fail-fast path, not per-ray work)
+                mx.observe("serve_request_latency_seconds", waited,
+                           tier="none")
             else:
                 live.append(p)
         if not live:
             return 0
+
+        # close every live request's queue-wait span at the cut: the
+        # HTTP-thread context captured at submit makes it a child of the
+        # request's root span even though this runs on the worker thread
+        for p in live:
+            trs.record("serve.queue", start_s=p.t_trace, end_s=t_cut,
+                       parent=p.ctx, stage="queue", n_rays=p.n_rays)
 
         # failure degrades through the SAME ladder load does: consecutive
         # dispatch failures (pre-open breaker pressure) push the tier pick
@@ -330,11 +372,14 @@ class MicroBatcher:
         family, stride = TIER_IMPL[tier]
         if tier != "full":
             self.n_shed += 1
+            # graftlint: ok(emit-hot: batch-cadence shed record, host-side)
             emitter.emit(
                 "serve_shed", tier=tier, queue_depth=queue_depth,
                 n_requests=len(live),
                 n_rays=sum(p.n_rays for p in live),
             )
+            # graftlint: ok(emit-hot: batch-cadence counter bump, lock-cheap)
+            mx.counter("serve_sheds_total", tier=tier)
 
         # assemble: per-request tier striding, one flat engine call
         segments = []
@@ -356,21 +401,31 @@ class MicroBatcher:
         with self._cond:
             self._inflight = live
         try:
-            # the lease pins the scene's residency for the whole render —
-            # the manager cannot evict it under an in-flight batch. The
-            # default scene (None) takes no lease and the legacy two-arg
-            # render_flat call, so pre-fleet engine doubles keep working.
-            with (nullcontext() if scene is None
-                  else self.engine.scene_lease(scene)) as scene_data:
-                # chaos hook: the flush-level fault point (a kill here is a
-                # BaseException — it escapes this handler, dies with the
-                # worker thread, and the watchdog restarts it)
-                fault_point("serve.flush")
-                out, info = (
-                    self.engine.render_flat(flat, family)
-                    if scene_data is None
-                    else self.engine.render_flat(flat, family, scene_data)
-                )
+            # the batch span runs on the worker thread but is parented to
+            # the FIRST coalesced request's trace (a batch has one
+            # timeline, many riders; per-rider attribution comes from the
+            # queue/scatter spans). Becoming this thread's current span
+            # also nests the acquire/dispatch/device spans underneath.
+            with trs.span("serve.batch", parent=(live[0].ctx), tier=tier,
+                          n_requests=len(live), n_rays=int(flat.shape[0]),
+                          queue_depth=queue_depth, **scene_fields):
+                # the lease pins the scene's residency for the whole
+                # render — the manager cannot evict it under an in-flight
+                # batch. The default scene (None) takes no lease and the
+                # legacy two-arg render_flat call, so pre-fleet engine
+                # doubles keep working.
+                with (nullcontext() if scene is None
+                      else self.engine.scene_lease(scene)) as scene_data:
+                    # chaos hook: the flush-level fault point (a kill here
+                    # is a BaseException — it escapes this handler, dies
+                    # with the worker thread, and the watchdog restarts it)
+                    fault_point("serve.flush")
+                    out, info = (
+                        self.engine.render_flat(flat, family)
+                        if scene_data is None
+                        else self.engine.render_flat(flat, family,
+                                                     scene_data)
+                    )
         except SceneError as err:
             # scene-scoped failure (torn checkpoint, residency overload):
             # fail THIS scene's requests only and leave the breaker alone —
@@ -379,12 +434,19 @@ class MicroBatcher:
             self._last_dispatch_t = self.clock()
             for p in live:
                 p.future.set_exception(err)
+                # graftlint: ok(emit-hot: scene-failure path, not steady-state)
                 get_emitter().emit(
                     "serve_request",
                     latency_s=self.clock() - p.t_enqueued,
                     n_rays=p.n_rays, tier=tier, status="scene_error",
                     queue_s=t0 - p.t_enqueued, **scene_fields,
                 )
+                # graftlint: ok(emit-hot: scene-failure path, not steady-state)
+                mx.counter("serve_requests_total", status="scene_error",
+                           tier=tier)
+            dump_flight("scene_error",
+                        detail=f"scene={scene} {type(err).__name__}: "
+                               f"{err}"[:200])
             with self._cond:
                 self._inflight = []
             return 0
@@ -395,12 +457,15 @@ class MicroBatcher:
             detail = f"{type(err).__name__}: {err}"
             for p in live:
                 p.future.set_exception(err)
+                # graftlint: ok(emit-hot: dispatch-failure path, not steady-state)
                 get_emitter().emit(
                     "serve_request",
                     latency_s=self.clock() - p.t_enqueued,
                     n_rays=p.n_rays, tier=tier, status="error",
                     queue_s=t0 - p.t_enqueued, **scene_fields,
                 )
+                # graftlint: ok(emit-hot: dispatch-failure path, not steady-state)
+                mx.counter("serve_requests_total", status="error", tier=tier)
             report("serve.dispatch", "error", detail=detail[:200])
             with self._cond:
                 self._inflight = []
@@ -410,6 +475,7 @@ class MicroBatcher:
         self.breaker.record_success()
 
         self.n_batches += 1
+        # graftlint: ok(emit-hot: one row per coalesced batch, post-sync)
         emitter.emit(
             "serve_batch",
             n_requests=len(live),
@@ -424,6 +490,7 @@ class MicroBatcher:
 
         t_done = self.clock()
         for p, (start, length) in zip(live, segments):
+            t_sc = trs.now()
             sliced = {k: v[start:start + length] for k, v in out.items()}
             if stride > 1:
                 sliced = {
@@ -433,16 +500,26 @@ class MicroBatcher:
             sliced["tier"] = tier
             self.n_completed += 1
             self.engine.n_requests += 1
+            latency_s = t_done - p.t_enqueued
+            # graftlint: ok(emit-hot: per-request completion record, post-sync host slicing)
             emitter.emit(
                 "serve_request",
-                latency_s=t_done - p.t_enqueued,
+                latency_s=latency_s,
                 n_rays=p.n_rays,
                 tier=tier,
                 status="ok",
                 queue_s=t0 - p.t_enqueued,
                 **scene_fields,
             )
+            trs.record("serve.scatter", start_s=t_sc, parent=p.ctx,
+                       stage="scatter", n_rays=p.n_rays, tier=tier)
+            # graftlint: ok(emit-hot: per-request counter+histogram, lock-cheap post-sync)
+            mx.counter("serve_requests_total", status="ok", tier=tier)
+            # graftlint: ok(emit-hot: per-request counter+histogram, lock-cheap post-sync)
+            mx.observe("serve_request_latency_seconds", latency_s, tier=tier)
             p.future.set_result(sliced)
+        # graftlint: ok(emit-hot: one gauge store per batch)
+        mx.gauge("serve_queue_depth", queue_depth)
         with self._cond:
             self._inflight = []
         return len(live)

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..fleet.errors import SceneCompatError, UnknownSceneError
 from ..obs import CompileTracker, get_emitter
+from ..obs.trace import get_tracer
 from ..renderer.gate import check_baked_bounds
 from ..resil import fault_point
 from .cache import PoseCache
@@ -442,49 +443,63 @@ class RenderEngine:
         an argument change, never a compile."""
         import jax
 
-        # chaos hook: injected dispatch failures exercise the batcher's
-        # circuit breaker / degradation path without touching executables
-        fault_point("serve.dispatch")
-        chunks = rays_b.reshape(bucket // self.chunk, self.chunk,
-                                rays_b.shape[-1])
-        # the request rays' host->device copy is the one INTENDED transfer
-        # of the serving path; explicit device_put keeps the whole request
-        # stream clean under jax.transfer_guard / analysis.sanitizer()
-        chunks = jax.device_put(chunks)
-        fn = self._get_fn(bucket, family)
-        params = self.params if scene is None else scene.params
-        if self.use_grid:
-            grid = self.grid if scene is None else scene.grid
-            bbox = self.bbox if scene is None else scene.bbox
-            return fn(params, chunks, grid, bbox)
-        return fn(params, chunks)
+        # the dispatch span covers HOST time only — reshape, h2d copy,
+        # executable enqueue; the device's async compute lands in the
+        # caller's "serve.device" span at the np.asarray sync point
+        with get_tracer().span("serve.dispatch", stage="dispatch",
+                               family=family, bucket=int(bucket)):
+            # chaos hook: injected dispatch failures exercise the
+            # batcher's circuit breaker / degradation path without
+            # touching executables
+            fault_point("serve.dispatch")
+            chunks = rays_b.reshape(bucket // self.chunk, self.chunk,
+                                    rays_b.shape[-1])
+            # the request rays' host->device copy is the one INTENDED
+            # transfer of the serving path; explicit device_put keeps the
+            # whole request stream clean under jax.transfer_guard /
+            # analysis.sanitizer()
+            chunks = jax.device_put(chunks)
+            fn = self._get_fn(bucket, family)
+            params = self.params if scene is None else scene.params
+            if self.use_grid:
+                grid = self.grid if scene is None else scene.grid
+                bbox = self.bbox if scene is None else scene.bbox
+                return fn(params, chunks, grid, bbox)
+            return fn(params, chunks)
 
     def _render_bucket(self, rays: np.ndarray, bucket: int,
                        family: str, scene=None) -> dict:
         n = rays.shape[0]
         rays_b = np.pad(rays, ((0, bucket - n), (0, 0)))
         out = dict(self._dispatch(rays_b, bucket, family, scene))
-        # traversal diagnostics are PER-CHUNK scalars ([n_chunks] under the
-        # lax.map), not per-ray maps — fold them into the serving counters
-        # before the per-ray reshape below would garble them
-        if "march_candidates" in out:
-            cand = np.asarray(out.pop("march_candidates"))  # graftlint: ok(host-sync)
-            self.march_chunks += cand.size
-            self.march_candidates += float(cand.sum())
-            self.march_samples_out += float(
-                np.sum(np.asarray(out.pop("march_samples_out")))  # graftlint: ok(host-sync)
-            )
-            self.march_coarse_occ_sum += float(
-                np.sum(np.asarray(out.pop("march_coarse_occ")))  # graftlint: ok(host-sync)
-            )
-            self.march_overflow_sum += float(
-                np.sum(np.asarray(out.pop("overflow_frac")))  # graftlint: ok(host-sync)
-            )
-        out = {
-            # intentional device pull: outputs ARE the response payload
-            k: np.asarray(v).reshape((-1,) + v.shape[2:])[:n]  # graftlint: ok(host-sync)
-            for k, v in out.items()
-        }
+        # the device span wraps the np.asarray pulls below: the first pull
+        # blocks until the async dispatch finishes, so its duration IS the
+        # device-compute wait — the queue/dispatch/device split the span
+        # taxonomy exists for
+        with get_tracer().span("serve.device", stage="device",
+                               bucket=int(bucket)):
+            # traversal diagnostics are PER-CHUNK scalars ([n_chunks]
+            # under the lax.map), not per-ray maps — fold them into the
+            # serving counters before the per-ray reshape below would
+            # garble them
+            if "march_candidates" in out:
+                cand = np.asarray(out.pop("march_candidates"))  # graftlint: ok(host-sync)
+                self.march_chunks += cand.size
+                self.march_candidates += float(cand.sum())
+                self.march_samples_out += float(
+                    np.sum(np.asarray(out.pop("march_samples_out")))  # graftlint: ok(host-sync)
+                )
+                self.march_coarse_occ_sum += float(
+                    np.sum(np.asarray(out.pop("march_coarse_occ")))  # graftlint: ok(host-sync)
+                )
+                self.march_overflow_sum += float(
+                    np.sum(np.asarray(out.pop("overflow_frac")))  # graftlint: ok(host-sync)
+                )
+            out = {
+                # intentional device pull: outputs ARE the response payload
+                k: np.asarray(v).reshape((-1,) + v.shape[2:])[:n]  # graftlint: ok(host-sync)
+                for k, v in out.items()
+            }
         trunc = out.pop("truncated", None)
         if trunc is not None:
             self.n_truncated += int(np.sum(trunc))
@@ -569,6 +584,7 @@ class RenderEngine:
         if emit:
             fields = {} if self._is_default_scene(scene) \
                 else {"scene": str(scene)}
+            # graftlint: ok(emit-hot: per-request completion record, post-sync)
             get_emitter().emit(
                 "serve_request",
                 latency_s=latency,
@@ -605,6 +621,7 @@ class RenderEngine:
         if cached is not None:
             image, served_tier = cached
             fields = {} if scene is None else {"scene": str(scene)}
+            # graftlint: ok(emit-hot: cache-hit record, no device work at all)
             get_emitter().emit(
                 "serve_request",
                 latency_s=time.perf_counter() - t0,

@@ -369,6 +369,7 @@ class Trainer:
                     epoch, min(it + k - 1, max_iter - 1), max_iter, lr, mem
                 ))
                 recorder.record("train")
+                # graftlint: ok(emit-hot: inside the should_log gate — one row per logging cadence, post block_until_ready)
                 emitter.emit(
                     "step",
                     step=host_step,

@@ -32,13 +32,22 @@ Fault spec grammar: ``point:kind[:after[:times]]`` — inject ``kind`` at
 (``-1`` = every hit). Points/kinds: ``resil.FAULT_POINTS`` /
 ``resil.FAULT_KINDS`` (catalog: docs/robustness.md).
 
-Exit code 0 = the run RECOVERED: it completed, no retry ladder was
-exhausted, and (serve) the steady-state stream triggered zero recompiles.
+Serve mode runs with span tracing on and a resil flight recorder
+installed (resil/flight.py): a scenario that opens the circuit breaker
+or crashes the batcher worker must leave a ``flight_<reason>.json``
+post-mortem in the workdir's record dir whose event ring names the
+injected fault — ``check_flight`` asserts it, and a missing or
+cause-less dump fails the run.
+
+Exit code 0 = the run RECOVERED (it completed, no retry ladder was
+exhausted, and — serve — the steady-state stream triggered zero
+recompiles) AND every required flight dump exists and names its fault.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import shutil
@@ -221,6 +230,19 @@ def run_serve(args, plan) -> dict:
     )
     telem = os.path.join(args.workdir, "record", "telemetry.jsonl")
     init_run(cfg, component="serve", path=telem)
+    # span tracing + flight recorder: the chaos run must leave a
+    # post-mortem on breaker-open / watchdog crash — check_flight() in
+    # main() asserts the dump exists and names the injected fault
+    from nerf_replication_tpu.obs import configure_tracing
+    from nerf_replication_tpu.resil import (
+        FlightRecorder,
+        install_flight_recorder,
+        uninstall_flight_recorder,
+    )
+
+    flight_dir = os.path.join(args.workdir, "record")
+    configure_tracing(enabled=True)
+    install_flight_recorder(FlightRecorder(flight_dir))
     network = make_network(cfg)
     params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
     bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
@@ -274,6 +296,8 @@ def run_serve(args, plan) -> dict:
     wall = time.perf_counter() - t0
     health = batcher.health()
     batcher.close(drain=False)
+    uninstall_flight_recorder()
+    configure_tracing(enabled=False)
     out = {
         "mode": "serve",
         "completed": True,
@@ -300,7 +324,66 @@ def run_serve(args, plan) -> dict:
             "load_errors": stats["load_errors"],
             "overloads": stats["overloads"],
         }
+    out["flight_dumps"] = _scan_flight_dumps(flight_dir)
     return out
+
+
+def _scan_flight_dumps(flight_dir: str) -> dict:
+    """Validate every flight_<reason>.json the run left and extract which
+    injected faults its event ring names (the post-mortem must point at
+    the cause, not just exist)."""
+    from nerf_replication_tpu.resil import validate_flight_dump
+
+    dumps: dict = {}
+    for path in sorted(glob.glob(os.path.join(flight_dir, "flight_*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+        except ValueError:
+            dumps[name] = {"valid": False, "errors": ["unparseable JSON"]}
+            continue
+        errs = validate_flight_dump(payload)
+        dumps[name] = {
+            "valid": not errs,
+            "errors": errs[:3],
+            "reason": payload.get("reason"),
+            "n_spans": len(payload.get("spans") or ()),
+            "faults_named": sorted({
+                f"{e.get('point')}:{e.get('fault')}"
+                for e in (payload.get("events") or ())
+                if isinstance(e, dict) and e.get("fault")
+            }),
+        }
+    return dumps
+
+
+def check_flight(outcome: dict, summary: dict, plan) -> tuple[bool, list]:
+    """The flight-recorder acceptance: a breaker-open or watchdog-crash
+    scenario must leave a valid dump whose event ring names one of the
+    injected faults. Scenarios that didn't trip either path pass
+    vacuously (nothing crashed, nothing to dump)."""
+    problems: list = []
+    dumps = outcome.get("flight_dumps") or {}
+    injected = {f"{s.point}:{s.kind}" for s in plan.specs}
+
+    def require(name: str) -> None:
+        d = dumps.get(name)
+        if d is None:
+            problems.append(f"{name} missing")
+        elif not d.get("valid"):
+            problems.append(f"{name} invalid: {d.get('errors')}")
+        elif injected and not (set(d.get("faults_named") or ()) & injected):
+            problems.append(
+                f"{name} names {d.get('faults_named')} — none of the "
+                f"injected {sorted(injected)}"
+            )
+
+    if summary["breaker_transitions"].get("open"):
+        require("flight_breaker_open.json")
+    if outcome.get("worker_restarts", 0) > 0:
+        require("flight_watchdog_crash.json")
+    return (not problems, problems)
 
 
 def summarize_telemetry(path: str) -> dict:
@@ -394,12 +477,16 @@ def main(argv=None) -> int:
         # only counts as recovered if other scenes actually kept serving
         and (args.scenes == 0 or outcome.get("scenes_still_serving", 0) > 0)
     )
+    flight_ok, flight_problems = check_flight(outcome, summary, plan)
     print(json.dumps({"outcome": outcome, "telemetry_summary": summary,
-                      "recovered": recovered}, indent=2))
+                      "recovered": recovered, "flight_ok": flight_ok,
+                      "flight_problems": flight_problems}, indent=2))
     print(f"chaos: {'RECOVERED' if recovered else 'UNRECOVERED'} — "
           f"{plan.injected()} injected, "
           f"{summary['retries_exhausted']} exhausted retries")
-    return 0 if recovered else 1
+    if not flight_ok:
+        print("flight recorder FAILED: " + "; ".join(flight_problems))
+    return 0 if (recovered and flight_ok) else 1
 
 
 if __name__ == "__main__":

@@ -19,7 +19,10 @@ producing a fourth. The committed ``graftlint_baseline.json`` (the static
 analysis gate's accepted-findings set, docs/static_analysis.md) rides in
 the default set too, validated against analysis/baseline.py's schema — a
 hand-edited baseline that drops a required field fails here, not at the
-next lint run. Exit code is nonzero on any invalid row; host-only (no JAX
+next lint run. Flight-recorder dumps (``flight_<reason>.json``, written
+by resil/flight.py on breaker-open / watchdog crash / SceneError /
+SIGTERM) validate against ``validate_flight_dump`` when passed
+explicitly. Exit code is nonzero on any invalid row; host-only (no JAX
 import).
 """
 
@@ -52,10 +55,24 @@ def check_baseline_file(path: str) -> list[str]:
     return [f"{path}: {e}" for e in validate_baseline_data(data)]
 
 
+def check_flight_file(path: str) -> list[str]:
+    """Errors for a flight-recorder dump (whole-file JSON, not JSONL)."""
+    from nerf_replication_tpu.resil.flight import validate_flight_dump
+
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except ValueError as e:
+        return [f"{path}: unparseable JSON: {e}"]
+    return [f"{path}: {e}" for e in validate_flight_dump(data)]
+
+
 def check_file(path: str, max_report: int = 5) -> list[str]:
     """Errors for one file (truncated to ``max_report`` rows' worth)."""
     if os.path.basename(path).startswith("graftlint_baseline"):
         return check_baseline_file(path)
+    if os.path.basename(path).startswith("flight_"):
+        return check_flight_file(path)
     telemetry = os.path.basename(path).startswith("telemetry")
     validate = validate_row if telemetry else validate_bench_row
     errors: list[str] = []

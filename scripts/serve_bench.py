@@ -20,6 +20,15 @@ down. The headline acceptance number is ``compiles_steady``: the obs
 CompileTracker count accumulated AFTER warmup across the whole mixed-shape
 stream — it must be zero (the shape buckets absorb every request shape).
 
+``--tracing both`` (the default) runs each mode twice — span tracing off,
+then on — and prices the instrumentation itself: the tracing-on row adds
+a per-stage latency breakdown from the span stream
+(``stage_queue_p50_ms`` … ``stage_scatter_p95_ms``, the obs/trace.py
+stage taxonomy), ``stage_wall_ratio`` (how much of each closed-loop
+request's wall time the stage spans account for — the tracing acceptance
+wants ≥ 0.9), and ``trace_overhead_pct`` (throughput lost vs the
+tracing-off arm of the same mode — acceptance wants ≤ 5%).
+
 * **fleet churn** (``--scenes N``) — multi-tenant mode: N synthetic
   scenes (same architecture, perturbed weights) behind a
   :class:`~nerf_replication_tpu.fleet.ResidencyManager`, driven as runs
@@ -181,6 +190,9 @@ def _run_fleet(engine, batcher, residency, scene_ids, rng, args) -> dict:
     stream switches; the upcoming scene's load was issued at the START
     of the previous run, so the switch request finds it resident (or
     joins the in-flight transfer) instead of cold-loading inline."""
+    from nerf_replication_tpu.obs import get_tracer
+
+    trs = get_tracer()
     same, switch = [], []
     total = 0
     prev_sid = None
@@ -194,7 +206,9 @@ def _run_fleet(engine, batcher, residency, scene_ids, rng, args) -> dict:
         for i in range(min(args.run_len, args.requests - total)):
             rays = next(stream)
             t0 = time.perf_counter()
-            batcher.submit(rays, NEAR, FAR, scene=sid).result(timeout=60.0)
+            with trs.span("bench.request", parent=None, scene=sid):
+                batcher.submit(rays, NEAR, FAR,
+                               scene=sid).result(timeout=60.0)
             lat = time.perf_counter() - t0
             total += 1
             # the first request after a scene change pays the switch
@@ -243,12 +257,20 @@ def _percentile(values, q):
 
 
 def _run_closed(batcher, rng, args) -> dict:
+    from nerf_replication_tpu.obs import get_tracer
+
+    trs = get_tracer()
     lats = []
     t_start = time.perf_counter()
     for rays in _request_stream(rng, args.requests, args.min_rays,
                                 args.max_rays):
         t0 = time.perf_counter()
-        batcher.submit(rays, NEAR, FAR).result(timeout=60.0)
+        # root span per request: the batcher captures this context at
+        # submit, so the queue/scatter records and the worker's batch
+        # span (acquire/dispatch/device inside it) land on this trace —
+        # _stage_summary divides their sum by this span's duration
+        with trs.span("bench.request", parent=None):
+            batcher.submit(rays, NEAR, FAR).result(timeout=60.0)
         lats.append(time.perf_counter() - t0)
     wall = time.perf_counter() - t_start
     return {"latencies_s": lats, "wall_s": wall}
@@ -328,6 +350,40 @@ def _summary_row(mode: str, run: dict, engine, batcher, args,
     }
 
 
+def _stage_summary(spans: list[dict]) -> dict:
+    """Per-stage latency percentiles and the stage-sum / wall ratio from
+    the span rows a run's tracer sink collected.
+
+    ``stage_wall_ratio`` averages, over requests with a ``bench.request``
+    root span (closed loop), the trace's summed stage durations divided
+    by the root's duration — the acceptance check that the queue →
+    acquire → dispatch → device → scatter taxonomy accounts for the
+    request's wall time instead of leaving dark gaps. ``scene.load`` is
+    excluded from the sum (it nests inside ``scene.acquire``, which is
+    already counted). Field names use the ``stage_`` prefix because a
+    bare ``stage`` key would reclassify the row into the per-stage bench
+    family (obs/schema.py bench_family is first-match)."""
+    by_stage: dict[str, list[float]] = {}
+    stage_sum: dict[str, float] = {}
+    roots: dict[str, float] = {}
+    for s in spans:
+        st = s.get("stage")
+        tid = s.get("trace_id")
+        if st:
+            by_stage.setdefault(st, []).append(float(s["dur_s"]))
+            if s.get("name") != "scene.load":
+                stage_sum[tid] = stage_sum.get(tid, 0.0) + float(s["dur_s"])
+        elif s.get("name") == "bench.request":
+            roots[tid] = float(s["dur_s"])
+    out: dict = {}
+    for st, durs in sorted(by_stage.items()):
+        out[f"stage_{st}_p50_ms"] = (_percentile(durs, 50) or 0.0) * 1e3
+        out[f"stage_{st}_p95_ms"] = (_percentile(durs, 95) or 0.0) * 1e3
+    ratios = [stage_sum.get(t, 0.0) / d for t, d in roots.items() if d > 0]
+    out["stage_wall_ratio"] = (sum(ratios) / len(ratios)) if ratios else None
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="serving-engine load generator")
     p.add_argument("--backend", default="cpu",
@@ -361,6 +417,11 @@ def main(argv=None) -> int:
     p.add_argument("--out-fleet",
                    default=os.path.join(_REPO, "BENCH_FLEET.jsonl"))
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tracing", default="both",
+                   choices=("both", "on", "off"),
+                   help="span-tracing arms per mode: 'both' runs each "
+                        "mode tracing-off then tracing-on and prices the "
+                        "overhead (trace_overhead_pct)")
     p.add_argument("--strict", action="store_true",
                    help="exit nonzero if any post-warmup recompile happened")
     args = p.parse_args(argv)
@@ -375,7 +436,12 @@ def main(argv=None) -> int:
 
     import numpy as np
 
-    from nerf_replication_tpu.obs import append_jsonl, get_emitter
+    from nerf_replication_tpu.obs import (
+        append_jsonl,
+        configure_tracing,
+        get_emitter,
+        get_tracer,
+    )
 
     cfg, engine, batcher, warmup_s = _build_stack(args)
     print(f"engine warm: buckets {list(engine.buckets)}, "
@@ -388,12 +454,22 @@ def main(argv=None) -> int:
             print(f"fleet: {args.scenes} scenes, budget "
                   f"{residency.budget_bytes / 2**20:.1f} MiB "
                   f"({'churn' if args.churn else 'fully resident'})")
+            # fleet mode runs one arm: spans on unless --tracing off (the
+            # acquire/load breakdown is the point of tracing churn)
+            traced = args.tracing != "off"
+            configure_tracing(enabled=traced)
+            spans: list = []
+            if traced:
+                get_tracer().add_sink(spans.append)
             rng = np.random.default_rng(args.seed)
             steady_base = engine.tracker.total_compiles()
             run = _run_fleet(engine, batcher, residency, scene_ids, rng,
                              args)
             compiles_steady = engine.tracker.total_compiles() - steady_base
             row = _fleet_row(run, engine, residency, args, compiles_steady)
+            row["tracing"] = int(traced)
+            if traced:
+                row.update(_stage_summary(spans))
             append_jsonl(args.out_fleet, row)
             print(
                 f"fleet[{row['fleet_mode']}]: n={row['n_requests']} "
@@ -408,6 +484,7 @@ def main(argv=None) -> int:
                       "(a scene switch forced a build)")
                 failed = True
         finally:
+            configure_tracing(enabled=False)
             batcher.close()
             get_emitter().close()
         print(f"row appended to {args.out_fleet}; "
@@ -415,30 +492,62 @@ def main(argv=None) -> int:
         return 1 if (failed and args.strict) else 0
 
     modes = ("closed", "open") if args.mode == "both" else (args.mode,)
+    arms = {"both": (False, True), "off": (False,), "on": (True,)}[
+        args.tracing]
     try:
         for mode in modes:
-            rng = np.random.default_rng(args.seed)
-            before = _snapshot(engine, batcher)
-            steady_base = engine.tracker.total_compiles()
-            run = (_run_closed if mode == "closed" else _run_open)(
-                batcher, rng, args
-            )
-            compiles_steady = engine.tracker.total_compiles() - steady_base
-            row = _summary_row(mode, run, engine, batcher, args,
-                               compiles_steady, warmup_s, before)
-            append_jsonl(args.out, row)
-            print(
-                f"{mode}: n={row['n_requests']} p50={row['p50_ms']:.1f}ms "
-                f"p95={row['p95_ms']:.1f}ms p99={row['p99_ms']:.1f}ms "
-                f"rps={row['rps']:.1f} occupancy={row['occupancy']:.2f} "
-                f"shed={row['shed']} timeouts={row['timeouts']} "
-                f"recompiles_after_warmup={compiles_steady}"
-            )
-            if compiles_steady:
-                print(f"WARNING: {compiles_steady} post-warmup recompiles "
-                      "(shape escaped the buckets)")
-                failed = True
+            rps_off = None
+            for traced in arms:
+                # configure_tracing swaps the global tracer, so the span
+                # sink re-registers per arm and dies with it
+                configure_tracing(enabled=traced)
+                spans: list = []
+                if traced:
+                    get_tracer().add_sink(spans.append)
+                rng = np.random.default_rng(args.seed)
+                before = _snapshot(engine, batcher)
+                steady_base = engine.tracker.total_compiles()
+                run = (_run_closed if mode == "closed" else _run_open)(
+                    batcher, rng, args
+                )
+                compiles_steady = (engine.tracker.total_compiles()
+                                   - steady_base)
+                row = _summary_row(mode, run, engine, batcher, args,
+                                   compiles_steady, warmup_s, before)
+                row["tracing"] = int(traced)
+                if traced:
+                    row.update(_stage_summary(spans))
+                    if rps_off:
+                        row["trace_overhead_pct"] = (
+                            (rps_off - row["rps"]) / rps_off * 100.0
+                        )
+                else:
+                    rps_off = row["rps"]
+                append_jsonl(args.out, row)
+                extra = ""
+                if traced:
+                    ratio = row.get("stage_wall_ratio")
+                    over = row.get("trace_overhead_pct")
+                    extra = (
+                        (f" stage_wall_ratio={ratio:.2f}"
+                         if ratio is not None else "")
+                        + (f" trace_overhead={over:+.1f}%"
+                           if over is not None else "")
+                    )
+                print(
+                    f"{mode}[tracing {'on' if traced else 'off'}]: "
+                    f"n={row['n_requests']} p50={row['p50_ms']:.1f}ms "
+                    f"p95={row['p95_ms']:.1f}ms p99={row['p99_ms']:.1f}ms "
+                    f"rps={row['rps']:.1f} occupancy={row['occupancy']:.2f} "
+                    f"shed={row['shed']} timeouts={row['timeouts']} "
+                    f"recompiles_after_warmup={compiles_steady}" + extra
+                )
+                if compiles_steady:
+                    print(f"WARNING: {compiles_steady} post-warmup "
+                          "recompiles (shape escaped the buckets)")
+                    failed = True
     finally:
+        configure_tracing(enabled=False)
         batcher.close()
         get_emitter().close()
     print(f"rows appended to {args.out}; telemetry in {args.record_dir}")

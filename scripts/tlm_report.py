@@ -7,14 +7,16 @@
 Summary: p50/p95/max per-step time, steps/s, compile count (+ total
 compile seconds), peak device memory / host RSS, final PSNR, and — when
 the run carries resil rows — injected/detected faults, retry-ladder
-outcomes, and circuit-breaker opens. ``--diff`` compares run A
-(baseline) against run B (candidate) and flags regressions past
-``--gate`` percent (step-time p50, peak memory) or any compile-count
-increase / PSNR drop > 0.1 dB / growth in unrecovered faults (exhausted
-retry ladders) or breaker opens; with ``--gate`` the exit code is
-nonzero when a regression is flagged, so a bench battery can use it as
-its gate against a saved baseline run (e.g. the run behind
-``BASELINE.json``).
+outcomes, and circuit-breaker opens. Runs that traced (obs/trace.py span
+rows) additionally get a per-stage latency breakdown (queue → acquire →
+dispatch → device → scatter p50/p95) and the queue-wait share of the
+stage p95 total. ``--diff`` compares run A (baseline) against run B
+(candidate) and flags regressions past ``--gate`` percent (step-time
+p50, peak memory, queue-wait p95 share) or any compile-count increase /
+PSNR drop > 0.1 dB / growth in unrecovered faults (exhausted retry
+ladders) or breaker opens; with ``--gate`` the exit code is nonzero when
+a regression is flagged, so a bench battery can use it as its gate
+against a saved baseline run (e.g. the run behind ``BASELINE.json``).
 
 A file holds every run ever appended to it (one ``run_meta`` row each);
 the summary covers the LAST run unless ``--all-runs`` is given. Purely
@@ -286,6 +288,37 @@ def summarize(rows: list[dict]) -> dict:
             breakers[-1].get("state") if breakers else "closed"
         )
 
+    # request-scoped span rows (obs/trace.py): per-stage latency
+    # breakdown of the serve path (queue → acquire → dispatch → device →
+    # scatter) and the queue-wait share of the stage tail — keys present
+    # only when the run traced (serve.py / serve_bench with tracing on)
+    span_rows = [r for r in rows if r.get("kind") == "span"]
+    stage_rows = [r for r in span_rows
+                  if r.get("stage") and r.get("dur_s") is not None]
+    if stage_rows:
+        stages: dict = {}
+        for r in stage_rows:
+            stages.setdefault(r["stage"], []).append(float(r["dur_s"]))
+        summary["span_count"] = len(span_rows)
+        summary["span_stages"] = {
+            st: {
+                "n": len(durs),
+                "p50_ms": _percentile(durs, 50) * 1e3,
+                "p95_ms": _percentile(durs, 95) * 1e3,
+            }
+            for st, durs in sorted(stages.items())
+        }
+        # queue share of the summed stage p95s: how much of the tail is
+        # waiting for the batcher's cut, not doing work — the scheduling
+        # health number --diff gates on
+        p95_total = sum(
+            v["p95_ms"] for v in summary["span_stages"].values()
+        )
+        q = summary["span_stages"].get("queue")
+        summary["serve_queue_p95_share"] = (
+            q["p95_ms"] / p95_total if q and p95_total > 0 else None
+        )
+
     # static-analysis rows (scripts/graftlint.py): the latest run's
     # new-vs-baselined split and rule mix — keys present only when the
     # stream carries lint_run rows (logs/graftlint/telemetry.jsonl)
@@ -396,6 +429,17 @@ def print_summary(summary: dict, label: str = "") -> None:
               f"{summary['faults_unrecovered']} UNRECOVERED")
         print(f"    breaker:     {summary['breaker_opens']} open(s), "
               f"last state {summary['breaker_last_state']}")
+    if summary.get("span_stages"):
+        mix = "  ".join(
+            f"{st} {v['p50_ms']:.2f}/{v['p95_ms']:.2f}"
+            for st, v in summary["span_stages"].items()
+        )
+        print(f"  spans:         {summary['span_count']} row(s)  "
+              f"(stage p50/p95 ms: {mix})")
+        share = summary.get("serve_queue_p95_share")
+        if share is not None:
+            print(f"    queue share: {share * 100:.1f}% of the stage "
+                  f"p95 total")
     if summary.get("lint_runs"):
         rule_mix = " ".join(
             f"{k}:{v}"
@@ -461,6 +505,19 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     if b is not None and b > a:
         flags.append(f"fleet cold scene loads grew {a} -> {b} "
                      f"(prefetch misses on the request path)")
+    # queue-wait share of the stage p95 total growing means the candidate
+    # spends more of its tail waiting in the batcher queue instead of
+    # doing work — a scheduling regression even when end-to-end p95
+    # hasn't moved yet. The 0.02 absolute floor keeps near-zero baselines
+    # from flagging on noise.
+    a = base.get("serve_queue_p95_share")
+    b = cand.get("serve_queue_p95_share")
+    if (a is not None and b is not None and (b - a) > 0.02
+            and pct(a, b) > gate_pct):
+        flags.append(
+            f"queue-wait p95 share grew {a * 100:.1f}% -> {b * 100:.1f}% "
+            f"of the stage tail"
+        )
     # sweep efficiency DROPPING means the coarse DDA is admitting more
     # dead candidate rows into the sort per useful sample — a traversal
     # regression even when step time hasn't moved yet

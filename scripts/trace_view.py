@@ -1,0 +1,130 @@
+"""Export span rows to Chrome-trace JSON (chrome://tracing, Perfetto).
+
+    python scripts/trace_view.py data/record/lego/telemetry.jsonl
+    python scripts/trace_view.py flight_breaker_open.json --out trace.json
+    python scripts/trace_view.py telemetry.jsonl --trace 00000001
+
+Reads spans from either source — a run's ``telemetry.jsonl`` (rows with
+``kind: span``) or a flight-recorder dump (its ``spans`` list) — and
+writes the Chrome trace-event format: one complete ("X") event per span
+placed on a per-thread track, plus thread-name metadata events, so the
+queue → acquire → dispatch → device → scatter stages of each request
+render as nested bars across the HTTP, batcher-worker, and prefetch
+threads. ``--trace`` filters to one request's trace id.
+
+Span ``start_s`` is on the tracer's clock (perf_counter); the export
+rebases to the earliest span so timestamps start at 0 µs. Host-only
+(no JAX import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def load_spans(path: str) -> list[dict]:
+    """Span rows from a telemetry JSONL or a flight_<reason>.json dump."""
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "{" and not path.endswith(".jsonl"):
+            payload = json.load(f)
+            if isinstance(payload, dict) and isinstance(
+                    payload.get("spans"), list):
+                return [s for s in payload["spans"] if isinstance(s, dict)]
+            return []
+        spans = []
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict) and row.get("kind") == "span":
+                spans.append(row)
+        return spans
+
+
+def to_chrome(spans: list[dict]) -> dict:
+    """Chrome trace-event JSON for a span list (complete events + thread
+    name metadata). Nesting is positional: Chrome stacks events on the
+    same tid by time containment, which parent/child spans satisfy by
+    construction (a child's [start, end) sits inside its parent's)."""
+    if not spans:
+        return {"traceEvents": []}
+    t0 = min(float(s["start_s"]) for s in spans)
+    threads: dict[str, int] = {}
+    events = []
+    for s in spans:
+        thread = str(s.get("thread", "main"))
+        tid = threads.setdefault(thread, len(threads) + 1)
+        args = {
+            "trace_id": s.get("trace_id"),
+            "span_id": s.get("span_id"),
+            "parent_id": s.get("parent_id"),
+        }
+        for k in ("stage", "tier", "scene", "status", "n_rays", "joined",
+                  "source", "family", "bucket"):
+            if s.get(k) is not None:
+                args[k] = s[k]
+        events.append({
+            "ph": "X",
+            "name": str(s.get("name", "span")),
+            "cat": str(s.get("stage") or "span"),
+            "ts": (float(s["start_s"]) - t0) * 1e6,
+            "dur": float(s.get("dur_s", 0.0)) * 1e6,
+            "pid": 1,
+            "tid": tid,
+            "args": args,
+        })
+    for thread, tid in threads.items():
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
+            "args": {"name": thread},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="span rows -> Chrome trace JSON")
+    p.add_argument("path", help="telemetry.jsonl or flight_<reason>.json")
+    p.add_argument("--out", default=None,
+                   help="output path (default: <path stem>_trace.json)")
+    p.add_argument("--trace", default=None,
+                   help="only spans of this trace_id")
+    args = p.parse_args(argv)
+
+    spans = load_spans(args.path)
+    if args.trace:
+        spans = [s for s in spans if s.get("trace_id") == args.trace]
+    if not spans:
+        print(f"{args.path}: no span rows"
+              + (f" for trace {args.trace}" if args.trace else ""))
+        return 1
+    out = args.out
+    if out is None:
+        stem = os.path.splitext(os.path.basename(args.path))[0]
+        out = os.path.join(os.path.dirname(args.path) or ".",
+                           f"{stem}_trace.json")
+    doc = to_chrome(spans)
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    n_threads = len({s.get("thread", "main") for s in spans})
+    n_traces = len({s.get("trace_id") for s in spans})
+    print(f"{out}: {len(spans)} spans, {n_traces} traces, "
+          f"{n_threads} threads")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,8 +23,20 @@ API (all JSON):
   occupancy, shed/timeout counts, queue depth) plus, multi-scene, the
   ``fleet`` residency block (resident set, evictions, prefetch hits).
 * ``GET /healthz`` — supervision view: queue depth, last-dispatch age,
-  circuit-breaker state, worker liveness/restarts. 200 while healthy,
+  circuit-breaker state, worker liveness/restarts, plus the ``slo`` block
+  (latency attainment vs. ``obs.slo_target_ms``, shed/timeout/error/
+  breaker rates from the live metrics registry). 200 while healthy,
   503 when the breaker is open or the worker cannot be kept alive.
+* ``GET /metrics`` — Prometheus text exposition of the live counters/
+  gauges/histograms (obs/metrics.py): request counts by status and tier,
+  queue depth, per-stage latency histograms fed by the span tracer.
+
+With ``obs.trace`` enabled (the default), every request runs under a
+root span whose children — queue wait, scene acquire, dispatch, device
+block, scatter — land in ``telemetry.jsonl`` as ``span`` rows
+(``scripts/trace_view.py`` exports them to chrome://tracing), and a
+crash/breaker-open/SIGTERM dumps the recent-span ring to
+``flight_<reason>.json`` (docs/observability.md).
 
 Errors are structured JSON, never stack traces (docs/robustness.md):
 bad pose / out-of-bounds request → 400, unknown scene → 404, batcher
@@ -39,6 +51,7 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -98,15 +111,19 @@ def render_pose(engine, batcher, body: dict) -> dict:
 
 
 def make_server(engine, batcher, host: str = "127.0.0.1",
-                port: int = 8008) -> ThreadingHTTPServer:
+                port: int = 8008,
+                slo_target_ms: float = 100.0) -> ThreadingHTTPServer:
     """A ready-to-serve ThreadingHTTPServer (port 0 = ephemeral, tests)."""
     from nerf_replication_tpu.fleet import (
         ResidencyOverloadError,
         SceneError,
         UnknownSceneError,
     )
+    from nerf_replication_tpu.obs import get_metrics, get_tracer
     from nerf_replication_tpu.resil import BreakerOpenError, report
     from nerf_replication_tpu.serve.batcher import ServeTimeoutError
+
+    slo_target_s = float(slo_target_ms) / 1e3
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet: telemetry is the record
@@ -126,12 +143,23 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
         def do_GET(self):
             if self.path == "/healthz":
                 health = batcher.health() if batcher is not None else {"ok": True}
+                health["slo"] = get_metrics().slo_view(slo_target_s)
                 return self._reply(200 if health["ok"] else 503, health)
             if self.path == "/stats":
                 stats = engine.stats()
                 if batcher is not None:
                     stats["batcher"] = batcher.stats()
                 return self._reply(200, stats)
+            if self.path == "/metrics":
+                data = get_metrics().render_prometheus().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             return self._reply(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
@@ -140,7 +168,18 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                return self._reply(200, render_pose(engine, batcher, body))
+                scene = body.get("scene")
+                # the REQUEST's root span: parent=None starts a fresh
+                # trace on this handler thread; the batcher submit below
+                # captures it into the queue entry, making every
+                # downstream stage (worker/prefetch threads included) a
+                # descendant
+                with get_tracer().span(
+                    "serve.request", parent=None,
+                    scene=None if scene is None else str(scene),
+                ):
+                    out = render_pose(engine, batcher, body)
+                return self._reply(200, out)
             except BreakerOpenError as err:
                 return self._reply(
                     503, {"error": str(err),
@@ -187,32 +226,68 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from nerf_replication_tpu.config import make_cfg
-    from nerf_replication_tpu.obs import init_run
+    from nerf_replication_tpu.obs import configure_tracing, get_metrics, init_run
     from nerf_replication_tpu.serve import MicroBatcher, engine_from_cfg
     from nerf_replication_tpu.utils.setup import configure_runtime
 
     cfg = make_cfg(args.cfg_file, args.opts or (), default_task="run")
     configure_runtime(cfg)
     emitter = init_run(cfg, component="serve")
-    engine = engine_from_cfg(cfg, cfg_file=args.cfg_file)
-    from nerf_replication_tpu.resil import CircuitBreaker
 
+    # observability wiring (cfg.obs): request tracing + the crash flight
+    # recorder come up BEFORE the engine so warm-up and the first request
+    # are both on the record
+    from nerf_replication_tpu.resil import (
+        CircuitBreaker,
+        FlightRecorder,
+        PreemptionGuard,
+        install_flight_recorder,
+    )
+
+    o = cfg.get("obs", {})
+    trace_on = bool(o.get("trace", True))
+    trace_ring = int(o.get("trace_ring", 256))
+    flight_dir = str(o.get("flight_dir", "")) or str(
+        cfg.get("record_dir", "."))
+    slo_target_ms = float(o.get("slo_target_ms", 100.0))
+    configure_tracing(enabled=trace_on)
+    install_flight_recorder(FlightRecorder(flight_dir, capacity=trace_ring))
+    # SIGTERM: the guard's handler dumps the flight ring, then the poll
+    # loop below drains and exits cleanly (a preempted replica leaves a
+    # post-mortem AND closes its telemetry)
+    guard = PreemptionGuard.install()
+
+    engine = engine_from_cfg(cfg, cfg_file=args.cfg_file)
     batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg))
-    server = make_server(engine, batcher, host=args.host, port=args.port)
+    server = make_server(engine, batcher, host=args.host, port=args.port,
+                         slo_target_ms=slo_target_ms)
     print(
         f"serving on http://{args.host}:{server.server_address[1]} "
         f"(buckets {list(engine.buckets)}, "
         f"{'grid' if engine.use_grid else 'volume'} path, "
-        f"{engine.warmup_compiles} executables warm)"
+        f"{engine.warmup_compiles} executables warm, "
+        f"tracing {'on' if trace_on else 'off'})"
     )
     try:
-        server.serve_forever()
+        if guard is None:
+            server.serve_forever()
+        else:
+            t = threading.Thread(target=server.serve_forever, daemon=True)
+            t.start()
+            while t.is_alive() and not guard.triggered:
+                t.join(timeout=0.5)
+            server.shutdown()
     except KeyboardInterrupt:
         pass
     finally:
         server.server_close()
         batcher.close()
+        snap = get_metrics().snapshot()
+        snap["slo"] = get_metrics().slo_view(slo_target_ms / 1e3)
+        emitter.emit("metrics_snapshot", **snap)
         emitter.close()
+        if guard is not None:
+            guard.uninstall()
     return 0
 
 

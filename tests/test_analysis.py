@@ -465,6 +465,76 @@ def test_swallow_suppressible_with_reason():
 
 
 # --------------------------------------------------------------------------
+# R9 emit-hot
+# --------------------------------------------------------------------------
+
+
+def test_emit_hot_in_traced_body_flagged():
+    src = (
+        "import jax\n"
+        "from nerf_replication_tpu.obs import get_emitter\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    get_emitter().emit('step', step=1)\n"
+        "    return x * 2\n"
+    )
+    found = lint_source(src, path=_LIB_PATH)
+    flagged = [f for f in found if f.rule == "emit-hot"]
+    assert len(flagged) == 1
+    assert "jit-traced" in flagged[0].message
+
+
+def test_emit_hot_in_hot_body_flagged_emitter_and_metrics():
+    src = (
+        "def render(emitter, mx):  # graftlint: hot\n"
+        "    emitter.emit('serve_request', latency_s=0.1)\n"
+        "    mx.counter('serve_requests_total', status='ok')\n"
+        "    mx.observe('serve_request_latency_seconds', 0.1)\n"
+        "    get_metrics().gauge('serve_queue_depth', 3)\n"
+    )
+    found = lint_source(src, path=_LIB_PATH)
+    flagged = [f for f in found if f.rule == "emit-hot"]
+    assert len(flagged) == 4
+    assert all("dispatch-hot" in f.message for f in flagged)
+
+
+def test_emit_hot_propagates_along_hot_call_graph():
+    """A helper CALLED from a hot body inherits hotness — its emit is on
+    the same dispatch path even without its own marker."""
+    src = (
+        "def outer(x):  # graftlint: hot\n"
+        "    return helper(x)\n"
+        "def helper(x):\n"
+        "    get_emitter().emit('row', x=x)\n"
+        "    return x\n"
+    )
+    assert "emit-hot" in _rules_of(lint_source(src, path=_LIB_PATH))
+
+
+def test_emit_hot_negative_cold_code_and_spans():
+    """emit in plain cold code is fine, and span context managers are
+    never flagged — obs/trace.py IS the sanctioned hot-path instrument."""
+    src = (
+        "def cold(emitter):\n"
+        "    emitter.emit('row', x=1)\n"
+        "def hot(x):  # graftlint: hot\n"
+        "    with get_tracer().span('serve.dispatch', stage='dispatch'):\n"
+        "        return x * 2\n"
+    )
+    assert "emit-hot" not in _rules_of(lint_source(src, path=_LIB_PATH))
+
+
+def test_emit_hot_suppressible_with_reason():
+    src = (
+        "def hot(emitter, x):  # graftlint: hot\n"
+        "    # graftlint: ok(emit-hot: per-batch cadence, post-sync)\n"
+        "    emitter.emit('serve_batch', n=x)\n"
+        "    return x\n"
+    )
+    assert "emit-hot" not in _rules_of(lint_source(src, path=_LIB_PATH))
+
+
+# --------------------------------------------------------------------------
 # suppression + baseline workflow
 # --------------------------------------------------------------------------
 

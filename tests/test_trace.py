@@ -1,0 +1,489 @@
+"""Observability tentpole tests (obs/trace.py, obs/metrics.py,
+resil/flight.py): cross-thread span parentage through the micro-batcher
+and the fleet prefetch seam, the bounded flight-recorder ring and its
+deterministic dumps, Prometheus /metrics rendering + the HTTP endpoint,
+the Chrome-trace exporter round-trip, zero steady-state recompiles with
+tracing ON, and flight-dump validation through the schema checker."""
+
+import importlib.util
+import json
+import os
+import re
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from nerf_replication_tpu.obs import (  # noqa: E402
+    configure_tracing,
+    current_ctx,
+    get_metrics,
+    get_tracer,
+    reset_metrics,
+    validate_row,
+)
+from nerf_replication_tpu.obs import emit as emit_mod  # noqa: E402
+from nerf_replication_tpu.resil import (  # noqa: E402
+    FlightRecorder,
+    install_flight_recorder,
+    uninstall_flight_recorder,
+    validate_flight_dump,
+)
+
+
+def _load_script(name):
+    path = os.path.join(_REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _rays(n, seed=0):
+    rng = np.random.default_rng(seed)
+    d = np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.1, (n, 3))
+    return np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (n, 1)), d], -1
+    ).astype(np.float32)
+
+
+@pytest.fixture
+def telem(tmp_path, monkeypatch):
+    """Route the process emitter at a scratch JSONL; yields its path."""
+    path = str(tmp_path / "telemetry.jsonl")
+    em = emit_mod.Emitter(path, chief=True)
+    monkeypatch.setattr(emit_mod, "_active", em)
+    yield path
+    em.close()
+
+
+@pytest.fixture
+def traced(telem):
+    """Tracing ON with a span-collecting sink; resets tracer + metrics
+    after, so other tests see the disabled default. Yields the list the
+    sink appends finished span rows to."""
+    reset_metrics()
+    configure_tracing(enabled=True)
+    spans = []
+    get_tracer().add_sink(spans.append)
+    yield spans
+    configure_tracing(enabled=False)
+    reset_metrics()
+
+
+def _by_name(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s["name"], []).append(s)
+    return out
+
+
+# -- span basics -------------------------------------------------------------
+
+
+def test_span_nesting_ids_and_error_status(traced):
+    trs = get_tracer()
+    with trs.span("outer", parent=None) as outer:
+        with trs.span("inner") as inner:
+            assert inner.ctx.trace_id == outer.ctx.trace_id
+            assert current_ctx().span_id == inner.ctx.span_id
+        assert current_ctx().span_id == outer.ctx.span_id
+    with pytest.raises(ValueError):
+        with trs.span("boom", parent=None):
+            raise ValueError("x")
+    rows = _by_name(traced)
+    assert rows["inner"][0]["parent_id"] == outer.ctx.span_id
+    assert rows["outer"][0]["parent_id"] is None
+    assert rows["boom"][0]["status"] == "error:ValueError"
+    # ids are counter-based 8-hex — deterministic per configure
+    assert re.fullmatch(r"[0-9a-f]{8}", rows["outer"][0]["span_id"])
+
+
+def test_disabled_tracer_is_inert(telem):
+    configure_tracing(enabled=False)
+    trs = get_tracer()
+    with trs.span("nope", parent=None) as sp:
+        assert sp.ctx is None
+        sp.set(tier="full")  # absorbed, never raises
+    trs.record("nope2", start_s=0.0, dur_s=1.0)
+    assert current_ctx() is None
+
+
+# -- cross-thread propagation through the micro-batcher ----------------------
+
+
+def test_batcher_spans_parent_the_submitting_request(traced):
+    """The HTTP-shaped seam: a root span on the submitting thread must
+    become the parent of the queue record (cut time), the worker's batch
+    span, and the scatter record — one joinable trace across threads."""
+    from test_resil import FakeEngine
+
+    from nerf_replication_tpu.serve import MicroBatcher
+
+    engine = FakeEngine()
+    batcher = MicroBatcher(engine)
+    trs = get_tracer()
+    try:
+        with trs.span("serve.request", parent=None) as root:
+            root_ctx = root.ctx
+            out = batcher.submit(_rays(8), 2.0, 6.0).result(timeout=5.0)
+        assert out["rgb_map_f"].shape == (8, 3)
+    finally:
+        batcher.close()
+    rows = _by_name(traced)
+    queue = rows["serve.queue"][0]
+    batch = rows["serve.batch"][0]
+    scatter = rows["serve.scatter"][0]
+    for row in (queue, batch, scatter):
+        assert row["trace_id"] == root_ctx.trace_id
+        assert row["parent_id"] == root_ctx.span_id
+    assert queue["stage"] == "queue" and scatter["stage"] == "scatter"
+    # the batch ran on the worker thread, the root on this one
+    assert batch["thread"] != rows["serve.request"][0]["thread"]
+    assert batch["n_requests"] == 1
+    # every span row the pipeline emitted validates against the schema
+    with open(emit_mod._active.path) as f:
+        emitted = [json.loads(line) for line in f if line.strip()]
+    span_rows = [r for r in emitted if r.get("kind") == "span"]
+    assert len(span_rows) == len(traced)
+    for r in span_rows:
+        assert validate_row(r) == [], r
+
+
+def test_prefetch_load_attributed_to_issuing_request(traced):
+    """The fleet seam: a prefetch issued under request A runs on its own
+    thread but its scene.load span must land in A's trace; a request B
+    joining that in-flight load gets ``joined: prefetch`` on its acquire
+    span — who paid vs who rode, disentangled."""
+    from nerf_replication_tpu.fleet import (
+        ResidencyManager,
+        SceneData,
+        SceneRecord,
+        SceneRegistry,
+    )
+
+    data = SceneData(scene_id="a",
+                     params={"w": np.zeros(64, np.float32)})
+    started, release = threading.Event(), threading.Event()
+
+    def loader(rec):
+        started.set()
+        assert release.wait(5.0)
+        return data
+
+    mgr = ResidencyManager(
+        SceneRegistry([SceneRecord(scene_id="a")]), loader,
+        budget_bytes=1 << 20, verify_checksums=False,
+    )
+    trs = get_tracer()
+    with trs.span("origin.prefetch", parent=None) as op:
+        op_ctx = op.ctx
+        assert mgr.prefetch("a")
+    assert started.wait(5.0)
+    timer = threading.Timer(0.05, release.set)
+    timer.start()
+    try:
+        with trs.span("origin.request", parent=None) as rq:
+            rq_ctx = rq.ctx
+            assert mgr.acquire("a").scene_id == "a"
+        mgr.release("a")
+    finally:
+        timer.join()
+    rows = _by_name(traced)
+    load = rows["scene.load"][0]
+    assert load["source"] == "prefetch"
+    assert load["trace_id"] == op_ctx.trace_id
+    assert load["parent_id"] == op_ctx.span_id
+    assert load["thread"].startswith("fleet-prefetch")
+    acquire = rows["scene.acquire"][0]
+    assert acquire["trace_id"] == rq_ctx.trace_id
+    assert acquire["joined"] == "prefetch"
+
+
+def test_zero_steady_state_recompiles_with_tracing_on(traced):
+    """The invariant the whole module is built around: tracing is
+    host-side only, so a warm mixed-shape stream with tracing ON must
+    not move the CompileTracker total."""
+    from test_resil import FakeEngine
+
+    from nerf_replication_tpu.serve import MicroBatcher
+
+    engine = FakeEngine()
+    batcher = MicroBatcher(engine)
+    try:
+        batcher.submit(_rays(8), 2.0, 6.0).result(timeout=5.0)  # warmup
+        before = engine.tracker.total_compiles()
+        for n in (4, 8, 16, 32, 64):
+            out = batcher.submit(_rays(n), 2.0, 6.0).result(timeout=5.0)
+            assert out["rgb_map_f"].shape == (n, 3)
+        assert engine.tracker.total_compiles() == before
+    finally:
+        batcher.close()
+    assert any(s["name"] == "serve.batch" for s in traced)  # it DID trace
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_dump_deterministic(tmp_path):
+    clk = FakeClock(100.0)
+    rec = FlightRecorder(str(tmp_path), capacity=4, clock=clk)
+    for i in range(10):
+        rec.record({"trace_id": "t0", "span_id": f"{i:08x}", "name": "s",
+                    "start_s": float(i), "dur_s": 0.5})
+    rec.note(point="serve.flush", fault="kill")
+    stats = rec.stats()
+    assert stats["spans"] == 4 and stats["capacity"] == 4
+    path = rec.dump("breaker_open", detail="threshold=2")
+    assert os.path.basename(path) == "flight_breaker_open.json"
+    with open(path) as f:
+        payload = json.load(f)
+    assert validate_flight_dump(payload) == []
+    # ring keeps exactly the LAST capacity spans, in finish order
+    assert [s["span_id"] for s in payload["spans"]] == [
+        f"{i:08x}" for i in range(6, 10)
+    ]
+    assert payload["events"][0]["fault"] == "kill"
+    assert payload["t"] == 100.0
+    # frozen clock + same ring state -> byte-identical re-dump
+    first = open(path, "rb").read()
+    rec.dump("breaker_open", detail="threshold=2")
+    assert open(path, "rb").read() == first
+
+
+def test_dump_reason_sanitized_and_noop_when_uninstalled(tmp_path):
+    from nerf_replication_tpu.resil import dump_flight
+
+    assert dump_flight("anything") is None  # no recorder installed
+    rec = FlightRecorder(str(tmp_path))
+    path = rec.dump("scene error: a/b!")
+    assert os.path.basename(path) == "flight_scene_error_a_b_.json"
+
+
+def test_breaker_open_triggers_flight_dump(tmp_path, telem):
+    from nerf_replication_tpu.resil import CircuitBreaker
+
+    reset_metrics()
+    install_flight_recorder(FlightRecorder(str(tmp_path)))
+    try:
+        br = CircuitBreaker(threshold=2, cooldown_s=60.0)
+        br.record_failure()
+        br.record_failure()
+        dump = os.path.join(str(tmp_path), "flight_breaker_open.json")
+        assert os.path.exists(dump)
+        with open(dump) as f:
+            payload = json.load(f)
+        assert validate_flight_dump(payload) == []
+        assert "threshold" not in payload["reason"]  # reason is the kind
+        assert payload["reason"] == "breaker_open"
+        # the transition also landed in the live metrics
+        snap = get_metrics().snapshot()
+        assert any("serve_breaker_transitions_total" in k
+                   and 'state="open"' in k for k in snap["counters"])
+    finally:
+        uninstall_flight_recorder()
+        reset_metrics()
+
+
+def test_installed_recorder_rings_tracer_spans(tmp_path, traced):
+    rec = install_flight_recorder(FlightRecorder(str(tmp_path)))
+    try:
+        with get_tracer().span("req", parent=None, stage="dispatch"):
+            pass
+        assert rec.stats()["spans"] == 1
+        payload = json.load(open(rec.dump("sigterm")))
+        assert payload["spans"][0]["name"] == "req"
+    finally:
+        uninstall_flight_recorder()
+
+
+# -- live metrics ------------------------------------------------------------
+
+
+def test_metrics_prometheus_rendering_parses(tmp_path):
+    reset_metrics()
+    try:
+        mx = get_metrics()
+        mx.counter("serve_requests_total", status="ok", tier="full")
+        mx.counter("serve_requests_total", 2, status="timeout", tier="none")
+        mx.gauge("serve_queue_depth", 3)
+        for v in (0.004, 0.02, 0.02, 0.7):
+            mx.observe("serve_request_latency_seconds", v, tier="full")
+        text = mx.render_prometheus()
+        line_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(e[+-]?\d+)?$"
+        )
+        for line in text.strip().splitlines():
+            assert line.startswith("# TYPE") or line_re.match(line), line
+        assert 'serve_requests_total{status="ok",tier="full"} 1' in text
+        # histogram: cumulative buckets end at +Inf == _count
+        bucket_vals = [
+            float(m.group(1)) for m in re.finditer(
+                r'serve_request_latency_seconds_bucket\{[^}]*\} ([0-9.]+)',
+                text)
+        ]
+        assert bucket_vals == sorted(bucket_vals)  # cumulative, monotonic
+        assert 'le="+Inf"' in text
+        assert "serve_request_latency_seconds_count" in text
+        view = mx.slo_view(0.1)
+        assert view["requests"] == 3  # counter total, not histogram count
+        assert view["attainment"] == pytest.approx(3 / 4)
+        assert view["timeout_rate"] == pytest.approx(2 / 3, abs=1e-3)
+    finally:
+        reset_metrics()
+
+
+def test_http_metrics_and_healthz_slo(traced):
+    import http.client
+
+    import serve as serve_cli
+    from test_resil import FakeEngine
+
+    from nerf_replication_tpu.serve import MicroBatcher
+
+    engine = FakeEngine()
+    batcher = MicroBatcher(engine)
+    server = serve_cli.make_server(engine, batcher, port=0,
+                                   slo_target_ms=100.0)
+    port = server.server_address[1]
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    try:
+        batcher.submit(_rays(8), 2.0, 6.0).result(timeout=5.0)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert "# TYPE serve_requests_total counter" in body
+        assert 'serve_requests_total{status="ok",tier="full"} 1' in body
+        assert "serve_stage_seconds_bucket" in body  # span-fed histogram
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["ok"] and health["slo"]["requests"] == 1
+        assert health["slo"]["target_ms"] == 100.0
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        batcher.close()
+
+
+# -- Chrome-trace export -----------------------------------------------------
+
+
+def test_trace_view_chrome_roundtrip(tmp_path, telem):
+    """Golden round-trip: spans under a fake clock -> JSONL -> Chrome
+    trace JSON that loads, nests by time containment, and carries the
+    thread-name metadata chrome://tracing groups tracks by."""
+    clk = FakeClock(10.0)
+    configure_tracing(enabled=True, clock=clk)
+    spans = []
+    get_tracer().add_sink(spans.append)
+    trs = get_tracer()
+    try:
+        with trs.span("serve.request", parent=None):
+            clk.advance(0.001)
+            with trs.span("serve.dispatch", stage="dispatch"):
+                clk.advance(0.002)
+            clk.advance(0.001)
+    finally:
+        configure_tracing(enabled=False)
+        reset_metrics()
+    jsonl = tmp_path / "spans.jsonl"
+    with open(jsonl, "w") as f:
+        for s in spans:
+            f.write(json.dumps({"kind": "span", **s}) + "\n")
+        f.write("not json\n")  # exporter must tolerate torn lines
+    tv = _load_script("trace_view")
+    out = tmp_path / "trace.json"
+    assert tv.main([str(jsonl), "--out", str(out)]) == 0
+    doc = json.load(open(out))
+    xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert set(xs) == {"serve.request", "serve.dispatch"}
+    parent, child = xs["serve.request"], xs["serve.dispatch"]
+    assert parent["ts"] == 0.0  # rebased to the earliest span
+    assert child["dur"] == pytest.approx(2000.0)  # 2 ms in µs
+    assert parent["ts"] <= child["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1e-6
+    assert child["args"]["parent_id"] == parent["args"]["span_id"]
+    assert child["cat"] == "dispatch"
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert metas and all(e["name"] == "thread_name" for e in metas)
+    # flight dumps are a second legal source for the same exporter
+    rec = FlightRecorder(str(tmp_path), clock=FakeClock(1.0))
+    for s in spans:
+        rec.record(s)
+    dump = rec.dump("sigterm")
+    assert tv.load_spans(dump) == spans
+    # filtering to an unknown trace id is a clean nonzero exit
+    assert tv.main([str(jsonl), "--trace", "ffffffff",
+                    "--out", str(out)]) == 1
+
+
+# -- tlm_report: span section + queue-share diff gate ------------------------
+
+
+def test_tlm_report_span_section_and_queue_share_gate(tmp_path):
+    def write_run(path, queue_ms):
+        rows = [{"kind": "run_meta", "run_id": "r", "t": 0.0}]
+        for i in range(20):
+            for stage, ms in (("queue", queue_ms), ("device", 30.0)):
+                rows.append({
+                    "kind": "span", "trace_id": f"{i:08x}",
+                    "span_id": f"{i:08x}", "name": f"serve.{stage}",
+                    "start_s": float(i), "dur_s": ms / 1e3, "stage": stage,
+                })
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    tlm = _load_script("tlm_report")
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    write_run(a, queue_ms=3.0)   # ~9% of the stage p95 total
+    write_run(b, queue_ms=20.0)  # 40%: waiting, not working
+    sa = tlm.summarize(tlm.load_rows(a))
+    sb = tlm.summarize(tlm.load_rows(b))
+    assert sa["span_stages"]["queue"]["p50_ms"] == pytest.approx(3.0)
+    assert sa["serve_queue_p95_share"] == pytest.approx(3 / 33, abs=1e-3)
+    flags = tlm.diff(sa, sb, gate_pct=10.0)
+    assert any("queue-wait p95 share" in f for f in flags)
+    assert tlm.diff(sa, sa, gate_pct=10.0) == []  # self-diff is clean
+
+
+# -- schema checker handles flight dumps -------------------------------------
+
+
+def test_check_telemetry_schema_validates_flight_dumps(tmp_path):
+    rec = FlightRecorder(str(tmp_path), clock=FakeClock(5.0))
+    rec.record({"trace_id": "t0", "span_id": "00000001", "name": "req",
+                "start_s": 0.0, "dur_s": 0.1})
+    rec.note(point="fleet.load", fault="io_error")
+    path = rec.dump("scene_error", detail="scene=a")
+    chk = _load_script("check_telemetry_schema")
+    assert chk.check_file(path) == []
+    payload = json.load(open(path))
+    del payload["reason"]
+    payload["spans"].append({"name": 3})
+    bad = tmp_path / "flight_bad.json"
+    with open(bad, "w") as f:
+        json.dump(payload, f)
+    errors = chk.check_file(str(bad))
+    assert any("reason" in e for e in errors)
+    assert any("spans[1]" in e for e in errors)

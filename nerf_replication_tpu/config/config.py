@@ -142,6 +142,25 @@ def default_cfg() -> ConfigNode:
     # precision knobs (TPU-native: bfloat16 compute, f32 params/accumulation)
     cfg.precision = ConfigNode({"compute_dtype": "float32", "param_dtype": "float32"})
 
+    # learned sampling (renderer/sampling.py, models/proposal.py,
+    # docs/sampling.md): mode "proposal" replaces the coarse pass with a
+    # small density-only MLP — S_p = n_proposal stratified proposal-net
+    # samples resampled (inverse-CDF over the proposal weight histogram)
+    # into S_f = n_fine fine-network points, the proposal supervised by
+    # the interlevel weight-bound loss (loss_mult) next to the photometric
+    # loss. anneal_iters blends the resampling PDF from uniform to the
+    # proposal histogram over early training; net sizes the proposal MLP.
+    cfg.sampling = ConfigNode(
+        {
+            "mode": "coarse_fine",   # "proposal" enables the resampler
+            "n_proposal": 64,        # S_p proposal-MLP samples per ray
+            "n_fine": 32,            # S_f fine-MLP samples per ray
+            "anneal_iters": 1000,    # uniform->sharp PDF anneal horizon
+            "loss_mult": 1.0,        # interlevel loss weight
+            "net": {"D": 2, "W": 64, "freq": 5},  # proposal MLP size
+        }
+    )
+
     # render-serving engine (nerf_replication_tpu/serve, docs/serving.md):
     # shape buckets are ray-chunk sizes arbitrary request shapes pad into
     # (each rounded up to a multiple of the render chunk size), so a mixed

@@ -194,7 +194,14 @@ class NeRFMLP(nn.Module):
 
 class Network(nn.Module):
     """Coarse + fine NeRF pair behind one apply, with pluggable encoders
-    (parity: reference `Network`, network.py:126-192)."""
+    (parity: reference `Network`, network.py:126-192).
+
+    ``proposal_cfg`` (a static ``(D, W, n_freqs)`` tuple, None = absent)
+    adds the learned-sampling density branch (models/proposal.py) as a
+    third model under the SAME apply — ``model="proposal"`` returns raw
+    [..., S, 1] σ. One params tree for all branches keeps checkpoints,
+    donation, AOT signatures, and the serve engine's scene-compat checks
+    structurally unchanged."""
 
     D: int = 8
     W: int = 256
@@ -207,6 +214,7 @@ class Network(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     scan_trunk: bool = False
+    proposal_cfg: tuple | None = None
 
     def setup(self):
         kwargs = dict(
@@ -222,11 +230,27 @@ class Network(nn.Module):
         )
         self.coarse = NeRFMLP(**kwargs, name="coarse")
         self.fine = NeRFMLP(**kwargs, name="fine")
+        if self.proposal_cfg is not None:
+            from ..proposal import ProposalMLP
+
+            d_p, w_p, f_p = self.proposal_cfg
+            self.proposal = ProposalMLP(
+                D=d_p, W=w_p, n_freqs=f_p,
+                compute_dtype=self.compute_dtype,
+                param_dtype=self.param_dtype,
+                name="proposal",
+            )
 
     def __call__(self, pts: jax.Array, viewdirs: jax.Array | None, model: str = "coarse"):
-        """``pts [..., S, 3]``, ``viewdirs [..., 3]`` → raw ``[..., S, 4]``.
+        """``pts [..., S, 3]``, ``viewdirs [..., 3]`` → raw ``[..., S, 4]``
+        (or ``[..., S, 1]`` raw σ for ``model="proposal"``).
 
-        ``model`` must be a static string ("coarse" | "fine")."""
+        ``model`` must be a static string ("coarse" | "fine" |
+        "proposal")."""
+        if model == "proposal":
+            # density-only branch: its own inline frequency encoding (far
+            # fewer bands than the main xyz_encoder), no view conditioning
+            return self.proposal(pts)
         embedded = self.xyz_encoder(pts)
         if self.use_viewdirs:
             dirs = jnp.broadcast_to(
@@ -250,6 +274,18 @@ def make_network(cfg) -> Network:
     else:
         dir_enc, input_ch_views = None, 0
     prec = cfg.get("precision", {})
+    # learned sampling (cfg.sampling, docs/sampling.md): proposal mode
+    # grows the density-only branch; its params ride the same tree, so a
+    # proposal-trained checkpoint IS a normal checkpoint with one more
+    # top-level branch
+    samp = cfg.get("sampling", {})
+    proposal_cfg = None
+    if str(samp.get("mode", "coarse_fine")) == "proposal":
+        net = samp.get("net", {})
+        proposal_cfg = (
+            int(net.get("D", 2)), int(net.get("W", 64)),
+            int(net.get("freq", 5)),
+        )
     return Network(
         D=int(cfg.network.nerf.D),
         W=int(cfg.network.nerf.W),
@@ -262,6 +298,7 @@ def make_network(cfg) -> Network:
         compute_dtype=jnp.dtype(prec.get("compute_dtype", "float32")),
         param_dtype=jnp.dtype(prec.get("param_dtype", "float32")),
         scan_trunk=bool(cfg.network.nerf.get("scan_trunk", False)),
+        proposal_cfg=proposal_cfg,
     )
 
 
@@ -316,4 +353,10 @@ def init_params(network: Network, key: jax.Array):
     params_c = network.init(k_coarse, pts, dirs, model="coarse")
     params_f = network.init(k_fine, pts, dirs, model="fine")
     merged = {**params_c["params"], **params_f["params"]}
+    if getattr(network, "proposal_cfg", None) is not None:
+        # the proposal branch draws from its own fold of the fine key so
+        # coarse/fine init streams are bitwise-unchanged from 2-branch runs
+        k_prop = jax.random.fold_in(k_fine, 1)
+        params_p = network.init(k_prop, pts, dirs, model="proposal")
+        merged = {**merged, **params_p["params"]}
     return {"params": merged}

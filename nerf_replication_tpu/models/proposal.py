@@ -1,0 +1,65 @@
+"""Density-only proposal MLP — the learned sampler's network half.
+
+A deliberately small frequency-encoded MLP (default D=2, W=64, 5 bands vs
+the main trunk's D=8, W=256, 10 bands) whose ONLY job is to produce a
+per-sample raw density the resampler (renderer/sampling.py) turns into a
+weight histogram. Per NerfAcc (arXiv 2305.04966) / NeuSample (arXiv
+2111.15552): sample placement needs far less capacity than appearance, so
+S_p evaluations of this net + S_f of the fine net undercut the reference's
+S_c coarse + (S_c + S_f) fine sweeps at matched PSNR.
+
+It rides inside ``models.nerf.network.Network`` as a third branch
+(``model="proposal"``, params under the same tree as coarse/fine), so
+checkpointing, donation, AOT registration, scene-compat checks, and the
+serve engine's bf16 clone all work unchanged.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ProposalMLP(nn.Module):
+    """[..., S, d] points → [..., S, 1] raw density (pre-relu σ).
+
+    Encoding is inline frequency (log-spaced bands, include-input — the
+    freq.py formula) over whatever point dimensionality arrives (3-D
+    static or 4-D time-conditioned), so the branch needs no encoder
+    plumbing. No view dependence: density is direction-free by
+    construction, like the main trunk's ``alpha_linear`` head.
+    """
+
+    D: int = 2
+    W: int = 64
+    n_freqs: int = 5
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pts: jax.Array) -> jax.Array:
+        if self.n_freqs > 0:
+            bands = 2.0 ** jnp.arange(self.n_freqs, dtype=jnp.float32)
+            xb = pts[..., None, :] * bands[:, None]
+            enc = jnp.stack([jnp.sin(xb), jnp.cos(xb)], axis=-2)
+            h = jnp.concatenate(
+                [pts, enc.reshape(*pts.shape[:-1], -1)], axis=-1
+            )
+        else:
+            h = pts
+        h = h.astype(self.compute_dtype)
+        for i in range(self.D):
+            h = nn.relu(
+                nn.Dense(
+                    self.W,
+                    dtype=self.compute_dtype,
+                    param_dtype=self.param_dtype,
+                    name=f"prop_linear_{i}",
+                )(h)
+            )
+        # density head in f32 for numerically stable compositing, matching
+        # the main trunk's alpha_linear convention (network.py:172-177)
+        return nn.Dense(
+            1, param_dtype=self.param_dtype, name="sigma_linear"
+        )(h.astype(jnp.float32))

@@ -136,6 +136,16 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
          "fine_occ": _NUM, "overflow_frac": _NUM, "truncated": _NUM,
          "n_rays": _NUM, "step": _NUM},
     ),
+    # -- learned sampling (renderer/sampling.py proposal resampler) ----------
+    # one per validation pass / bench arm: the fine-MLP evaluations per ray
+    # the active sampling mode costs (the budget the proposal network
+    # exists to cut) next to the quality it bought. tlm_report summarizes
+    # these and --diff gates on a grown fine-eval budget.
+    "sample": (
+        {"mode": (str,), "fine_evals_per_ray": _NUM},
+        {"n_proposal": _NUM, "n_fine": _NUM, "psnr": _NUM, "step": _NUM,
+         "surface": (str,), "loss_prop": _NUM, "rays_per_s": _NUM},
+    ),
     # -- resilience rows (nerf_replication_tpu/resil) ------------------------
     # one per fault at a named fault point: injected (FaultPlan chaos) or
     # detected in the wild (checksum mismatch, torn dir, worker crash).
@@ -279,6 +289,13 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # fleet_mode rather than reusing serve_mode.
     "fleet_mode": ("n_scenes", "evictions", "prefetch_hit_rate",
                    "p95_same_ms", "p95_switch_ms"),
+    # scripts/bench_sampling.py rows (BENCH_SAMPLING.jsonl): one row per
+    # sampling arm (coarse_fine baseline vs proposal resampler) trained to
+    # the same budget on the same scene — PSNR at matched training next to
+    # the fine-MLP eval budget and render throughput. NOTE: must not carry
+    # any earlier discriminator key (bench_family is first-match), hence
+    # sampling_mode rather than reusing arm/metric.
+    "sampling_mode": ("fine_evals_per_ray", "rays_per_s", "psnr"),
 }
 
 

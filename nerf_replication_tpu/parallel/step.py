@@ -74,6 +74,7 @@ def build_dp_step(
             grads, stats = sampled_grad_step(
                 loss, st.params, bank_rays, bank_rgbs, n_local, near, far,
                 k_sample, k_render, index_pool=pool, grad_accum=grad_accum,
+                step=st.step,
             )
             with jax.named_scope("grad_allreduce"):
                 grads = tree_pmean(grads, DATA_AXIS)
@@ -152,18 +153,15 @@ def build_gspmd_step(
     n_micro = max(n_local // grad_accum, 1)
     sample_sharded_micro = make_sampler(n_micro)
 
-    def _grads_for(p_ref, sampler, bank_rays, bank_rgbs, ks, kr):
+    def _grads_for(p_ref, sampler, bank_rays, bank_rgbs, ks, kr, step):
         rays, rgbs = sampler(ks, bank_rays, bank_rgbs)
         rays = jax.lax.with_sharding_constraint(rays, batch_sh)
         rgbs = jax.lax.with_sharding_constraint(rgbs, batch_sh)
+        batch = {"rays": rays, "rgbs": rgbs, "near": near, "far": far,
+                 "step": step}
 
         def loss_fn(p):
-            _, l, stats = loss(
-                {"params": p},
-                {"rays": rays, "rgbs": rgbs, "near": near, "far": far},
-                key=kr,
-                train=True,
-            )
+            _, l, stats = loss({"params": p}, batch, key=kr, train=True)
             return l, stats
 
         (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(p_ref)
@@ -182,7 +180,7 @@ def build_gspmd_step(
                 ks, kr = keys
                 grads, stats = _grads_for(
                     st.params, sample_sharded_micro, bank_rays, bank_rgbs,
-                    ks, kr,
+                    ks, kr, st.step,
                 )
                 return jax.tree_util.tree_map(
                     lambda a, b: a + b, carry, grads
@@ -203,7 +201,7 @@ def build_gspmd_step(
         else:
             grads, stats = _grads_for(
                 st.params, sample_sharded, bank_rays, bank_rgbs,
-                k_sample, k_render,
+                k_sample, k_render, st.step,
             )
         new_state = st.apply_gradients(grads=grads)
         return new_state, stats

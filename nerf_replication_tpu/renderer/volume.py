@@ -22,10 +22,12 @@ the inverse CDF.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+
+from .sampling import SamplingOptions, proposal_render_rays
 
 
 @dataclass(frozen=True)
@@ -41,6 +43,9 @@ class RenderOptions:
     use_viewdirs: bool = True
     chunk_size: int = 8192
     remat: bool = False  # rematerialize MLP activations in backward (HBM↓)
+    # learned sampling (cfg.sampling, renderer/sampling.py): mode
+    # "proposal" replaces the coarse pass with the proposal-net resampler
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
 
     @classmethod
     def from_cfg(cls, cfg, train: bool = True) -> "RenderOptions":
@@ -60,7 +65,21 @@ class RenderOptions:
             use_viewdirs=bool(ta.get("use_viewdirs", True)),
             chunk_size=int(ta.get("chunk_size", 8192)),
             remat=bool(ta.get("remat", False)) and train,
+            sampling=SamplingOptions.from_cfg(cfg, train=train),
         )
+
+    @property
+    def fine_evals_per_ray(self) -> int:
+        """Fine-MLP evaluations per ray this configuration costs — the
+        number the proposal resampler exists to cut (BENCH_SAMPLING's
+        headline column). Coarse+fine evaluates the fine network on the
+        MERGED S_c + S_f sorted set (render_rays); proposal mode on the
+        S_f resampled points alone."""
+        if self.sampling.mode == "proposal":
+            return self.sampling.n_fine
+        if self.n_importance > 0:
+            return self.n_samples + self.n_importance
+        return 0  # coarse-only: the fine MLP never runs
 
 
 def stratified_z_vals(
@@ -189,6 +208,7 @@ def render_rays(
     far,
     key: jax.Array | None,
     options: RenderOptions,
+    step: jax.Array | None = None,
 ) -> dict:
     """Render a [N, 6] (or [N, 7] time-conditioned) ray batch through
     coarse (+fine) networks.
@@ -197,12 +217,22 @@ def render_rays(
     closed over); returns the reference's output dict keys
     (`rgb_map_c/f`, `depth_map_c/f`, `acc_map_c/f`).
 
+    ``options.sampling.mode == "proposal"`` routes the proposal-network
+    resampler (renderer/sampling.py) instead of the coarse pass — a
+    trace-time static, so each mode is its own fused executable. ``step``
+    (a traced scalar from the train state; None at eval) drives the
+    proposal PDF anneal and is ignored by the coarse+fine path.
+
     A 7th ray column (the per-frame latent/time index — light-stage and
     dynamic-scene datasets) is broadcast onto every sample point as a 4th
     point coordinate, so ``xyz_encoder`` receives the ``(x, y, z, t)`` the
     dynamic encoder family (models/encoding/dynamic.py) consumes. Static
     3-D encoders must be paired with 6-column rays — the extra coordinate
     is a shape-static trace-time property, never a runtime branch."""
+    if options.sampling.mode == "proposal":
+        return proposal_render_rays(
+            apply_fn, rays, near, far, key, options, step=step
+        )
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     t_col = rays[..., 6:7] if rays.shape[-1] > 6 else None
     n_rays = rays.shape[0]
@@ -346,7 +376,11 @@ class Renderer:
         )
 
     def render(self, params, batch: dict, key=None, train: bool = True) -> dict:
-        """Render a batch dict {rays [N,6], near, far} (reference render())."""
+        """Render a batch dict {rays [N,6], near, far} (reference render()).
+
+        An optional ``batch["step"]`` (the traced train-state step the
+        step builders thread through) drives the proposal-sampling anneal;
+        absent means fully-sharp resampling."""
         options = self.train_options if train else self.eval_options
         return render_rays(
             self._apply_fn(params),
@@ -355,7 +389,21 @@ class Renderer:
             batch["far"],
             key,
             options,
+            step=batch.get("step"),
         )
+
+    def sampling_stats(self) -> dict:
+        """Static sampling ledger for telemetry surfaces (the trainer's
+        ``sample`` rows, serve ``GET /stats``): the mode and the
+        fine-MLP evaluations per ray each path costs."""
+        s = self.eval_options.sampling
+        return {
+            "mode": s.mode,
+            "fine_evals_per_ray_train": self.train_options.fine_evals_per_ray,
+            "fine_evals_per_ray_eval": self.eval_options.fine_evals_per_ray,
+            "n_proposal": s.n_proposal if s.mode == "proposal" else 0,
+            "n_fine": s.n_fine if s.mode == "proposal" else 0,
+        }
 
     def _build_chunked_fn(self, n_chunks: int):
         """Jitted chunked-eval executable for a fixed chunk count. Named

@@ -11,10 +11,12 @@ retrace. With NerfAcc-style occupancy sampling making per-ray FLOPs cheap,
 dispatch/batching dominates serving latency; the bucket set is the whole
 executable inventory, compiled before the first request arrives.
 
-Four executable families exist per bucket — ``full`` / ``bf16`` /
-``reduced_k`` / ``coarse`` (serve/policy.py's degradation ladder;
-``half_res`` reuses ``coarse`` with host-side ray striding) — so shedding
-load under backlog switches executables, never compiles one. ``bf16`` is
+A handful of executable families exist per bucket — ``full`` / ``bf16`` /
+``proposal`` / ``reduced_k`` / ``coarse`` (serve/policy.py's degradation
+ladder; ``half_res`` reuses ``coarse`` with host-side ray striding, and
+``proposal`` is warmed only for checkpoints that carry the learned-sampler
+branch, falling back to ``reduced_k`` otherwise) — so shedding load under
+backlog switches executables, never compiles one. ``bf16`` is
 the full march budget with the network cloned to bfloat16 COMPUTE (f32
 params and f32 compositing — the march's sigmoid/relu/transmittance math
 runs outside the network): its own prewarmed bucket set, no new code
@@ -76,6 +78,16 @@ class ServeOptions:
         )
 
 
+def _has_proposal_branch(params) -> bool:
+    """Whether a param tree (concrete or abstract) carries the learned
+    sampler's ``proposal`` branch (models/proposal.py) — structure only,
+    so it works on the eval_shape templates warm-up runs on."""
+    try:
+        return "proposal" in params.get("params", {})
+    except AttributeError:
+        return False
+
+
 def _normalize_buckets(buckets, chunk: int) -> tuple[int, ...]:
     """Ascending unique bucket sizes, each a multiple of the render chunk
     (the executables ``lax.map`` over [chunk, C] rows, so a bucket that
@@ -99,7 +111,7 @@ class RenderEngine:
 
     def __init__(self, cfg, network, params, near, far, grid=None, bbox=None,
                  tracker: CompileTracker | None = None,
-                 warmup_families: tuple[str, ...] = FAMILIES,
+                 warmup_families: tuple[str, ...] | None = None,
                  aot=None):
         import jax.numpy as jnp
 
@@ -108,6 +120,11 @@ class RenderEngine:
 
         self.network = network
         self.params = params
+        # a checkpoint trained with sampling.mode: proposal carries the
+        # learned-sampler branch; only then is the "proposal" executable
+        # family real — without it the tier remaps to reduced_k at render
+        # time (TIER ladder in serve/policy.py)
+        self.has_proposal = _has_proposal_branch(params)
         self.near = float(near)
         self.far = float(far)
         self.options = ServeOptions.from_cfg(cfg)
@@ -170,6 +187,14 @@ class RenderEngine:
 
     # -- executable construction --------------------------------------------
 
+    def _families_for_params(self) -> tuple[str, ...]:
+        """The executable families this checkpoint can actually serve:
+        every ladder family, minus ``proposal`` when the params carry no
+        proposal branch (the tier then degrades through reduced_k)."""
+        return tuple(
+            f for f in FAMILIES if f != "proposal" or self.has_proposal
+        )
+
     def _family_march_options(self, family: str):
         base = self.march_options
         if family in ("full", "bf16"):
@@ -182,6 +207,23 @@ class RenderEngine:
 
     def _family_eval_options(self, family: str):
         base = self.eval_options
+        s = base.sampling
+        if s.mode == "proposal":
+            # learned-sampler checkpoint: the coarse branch is untrained
+            # (the proposal path never touches it), so every degraded tier
+            # stays on the proposal render and sheds by shrinking the
+            # histogram / fine budgets instead of swapping networks
+            if family in ("full", "bf16"):
+                return base
+            if family == "proposal":
+                s2 = replace(s, n_fine=max(1, s.n_fine // 2))
+            elif family == "reduced_k":
+                s2 = replace(s, n_proposal=max(2, s.n_proposal // 2),
+                             n_fine=max(1, s.n_fine // 2))
+            else:  # coarse tier: the deepest shed still renders fine
+                s2 = replace(s, n_proposal=max(2, s.n_proposal // 2),
+                             n_fine=max(1, s.n_fine // 4))
+            return replace(base, sampling=s2)
         if family in ("full", "bf16"):
             return base
         if family == "reduced_k":
@@ -210,6 +252,28 @@ class RenderEngine:
         network = self._family_network(family)
         near, far = self.near, self.far
         model = "coarse" if family == "coarse" else "fine"
+
+        if self.use_grid and family == "proposal":
+            # the learned sampler is its own acceleration structure: the
+            # proposal executable routes through the chunked proposal
+            # render even on a grid engine. Signature keeps (params,
+            # rays_p, grid, bbox) — grid/bbox unused — so _dispatch and
+            # the AOT warm-up treat every grid-engine family uniformly
+            options = self._family_eval_options(family)
+
+            @jax.jit
+            def fn(params, rays_p, grid, bbox):
+                apply_fn = lambda pts, vd, m: network.apply(  # noqa: E731
+                    params, pts, vd, model=m
+                )
+                return jax.lax.map(
+                    lambda rc: render_rays(
+                        apply_fn, rc, near, far, None, options
+                    ),
+                    rays_p,
+                )
+
+            return fn
 
         if self.use_grid:
             options = self._family_march_options(family)
@@ -275,7 +339,7 @@ class RenderEngine:
         return fn
 
     # graftlint: hot
-    def warm_up(self, families: tuple[str, ...] = FAMILIES) -> int:
+    def warm_up(self, families: tuple[str, ...] | None = None) -> int:
         """Build every (bucket, family) executable before traffic.
 
         With an AOT registry the whole inventory registers with abstract
@@ -293,6 +357,8 @@ class RenderEngine:
         import jax
         import jax.numpy as jnp
 
+        if families is None:
+            families = self._families_for_params()
         t0 = time.perf_counter()
         before = self.tracker.total_compiles()
         if self.aot is not None:
@@ -524,6 +590,12 @@ class RenderEngine:
         outputs are host numpy [N, ...] arrays, info reports the
         padded-ray accounting the occupancy telemetry needs.
         """
+        if family == "proposal" and not self.has_proposal:
+            # coarse+fine checkpoint: the proposal family's shed step is
+            # served from the reduced_k executable — an already-warm
+            # family, never a new compile. Remapped HERE so every caller
+            # (render_request, the micro-batcher's drain) degrades alike.
+            family = "reduced_k"
         # host-side input normalization (requests arrive as numpy/lists)
         rays = np.asarray(rays, np.float32)  # graftlint: ok(host-sync)
         if rays.ndim != 2:
@@ -668,6 +740,18 @@ class RenderEngine:
             }
         return {
             "march": march,
+            # the learned-sampling story per family: fine-MLP evals/ray is
+            # the cost knob the proposal resampler exists to cut, and the
+            # per-tier budgets make degraded traffic's quality/cost trade
+            # inspectable from GET /stats
+            "sampling": {
+                "mode": self.eval_options.sampling.mode,
+                "has_proposal": self.has_proposal,
+                "fine_evals_per_ray": {
+                    f: self._family_eval_options(f).fine_evals_per_ray
+                    for f in self._families_for_params()
+                },
+            },
             "buckets": list(self.buckets),
             "chunk": self.chunk,
             "use_grid": self.use_grid,

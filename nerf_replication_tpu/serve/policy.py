@@ -21,6 +21,16 @@ bf16        bf16               full march budget, fine network, bf16
                                rounding-level PSNR delta, and on TPU the
                                halved MXU word size makes it cheaper than
                                full, not just equal
+proposal    proposal           learned-sampler fine pass at HALF the fine
+                               budget (renderer/sampling.py) — checkpoints
+                               trained with ``sampling.mode: proposal``
+                               carry the proposal net, and its histogram
+                               concentrates a reduced budget where the
+                               density is, so this sheds compute with less
+                               PSNR loss than a uniform-march cut. For
+                               coarse+fine checkpoints (no proposal branch)
+                               the engine serves this tier from the
+                               reduced_k family — never a new executable
 reduced_k   reduced_k          half the max_samples MLP budget per ray
 coarse      coarse             coarse network + reduced budget
 half_res    coarse             coarse, every 2nd ray rendered, output
@@ -34,20 +44,23 @@ from dataclasses import dataclass
 
 # degradation order; index 0 is the undegraded tier
 TIER_NAMES: tuple[str, ...] = (
-    "full", "bf16", "reduced_k", "coarse", "half_res"
+    "full", "bf16", "proposal", "reduced_k", "coarse", "half_res"
 )
 
 # tier -> (executable family, ray stride applied OUTSIDE the executable)
 TIER_IMPL: dict[str, tuple[str, int]] = {
     "full": ("full", 1),
     "bf16": ("bf16", 1),
+    "proposal": ("proposal", 1),
     "reduced_k": ("reduced_k", 1),
     "coarse": ("coarse", 1),
     "half_res": ("coarse", 2),
 }
 
-# the executable families the engine pre-warms per bucket
-FAMILIES: tuple[str, ...] = ("full", "bf16", "reduced_k", "coarse")
+# the executable families the engine pre-warms per bucket; "proposal" is
+# warmed only when the loaded checkpoint carries the proposal branch
+# (engine._families_for_params), else its tier falls back to reduced_k
+FAMILIES: tuple[str, ...] = ("full", "bf16", "proposal", "reduced_k", "coarse")
 
 
 @dataclass(frozen=True)

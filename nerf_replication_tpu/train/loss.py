@@ -16,6 +16,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..renderer import make_renderer
+from ..renderer.sampling import interlevel_loss
 
 
 def mse(pred, target):
@@ -35,16 +36,31 @@ class NeRFLoss:
     def __call__(self, params, batch, key=None, train: bool = True):
         output = self.renderer.render(params, batch, key=key, train=train)
         target = batch["rgbs"]
-        loss_c = mse(output["rgb_map_c"], target)
-        stats = {"loss_c": loss_c}
-        loss = loss_c
+        stats = {}
+        loss = 0.0
+        # proposal sampling mode (renderer/sampling.py) has no coarse
+        # render: the photometric loss is fine-only, and the proposal net
+        # trains on the interlevel weight-bound loss over the two
+        # histograms the renderer returned
+        if "rgb_map_c" in output:
+            loss_c = mse(output["rgb_map_c"], target)
+            stats["loss_c"] = loss_c
+            loss = loss + loss_c
         if "rgb_map_f" in output:
             loss_f = mse(output["rgb_map_f"], target)
             stats["loss_f"] = loss_f
             loss = loss + loss_f
             stats["psnr"] = mse_to_psnr(loss_f)
         else:
-            stats["psnr"] = mse_to_psnr(loss_c)
+            stats["psnr"] = mse_to_psnr(stats["loss_c"])
+        if "prop_w" in output:
+            loss_p = interlevel_loss(
+                output["fine_t"], output["fine_w"],
+                output["prop_t"], output["prop_w"],
+            )
+            mult = self.renderer.train_options.sampling.loss_mult
+            stats["loss_prop"] = loss_p
+            loss = loss + mult * loss_p
         stats["loss"] = loss
         return output, loss, stats
 

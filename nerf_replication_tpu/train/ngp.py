@@ -117,6 +117,13 @@ class NGPTrainer:
             )
         )
         self._eval_cap_escalations = 0
+        # occupancy-derived cap (maybe_derive_eval_cap): once the grid has
+        # carved, the stream's real need is ~occupancy x max_samples per
+        # ray — deriving the cap from the live grid before the first eval
+        # compile replaces the blanket 1024 preset with a scene-sized one.
+        # An explicit ngp_packed_cap_avg_eval pins the cap (no derivation).
+        self._eval_cap_user_preset = "ngp_packed_cap_avg_eval" in ta
+        self._eval_cap_derived = False
         self.grid_res = int(ta.get("ngp_grid_res", 64))
         # density threshold follows the EVAL bake's convention
         # (task_arg.occupancy_grid_threshold, σ=1.0 in the lego family)
@@ -262,15 +269,61 @@ class NGPTrainer:
             self.aot.register(name, self._jit_step(k, warm=warm), args)
         self.aot.compile_all(wait=False)
 
+    def maybe_derive_eval_cap(self, grid) -> bool:
+        """Size the packed eval stream cap from the LIVE grid's occupancy
+        (once, before the first eval executable compiles): the packed
+        march only emits samples in occupied cells, so a carved grid needs
+        ~occupancy x max_samples mean samples per ray; 1.5x headroom
+        absorbs rays that cross denser-than-average regions. The blanket
+        1024 preset stays as the fallback for uncarved grids, and the
+        render_image escalation loop remains the safety net when even the
+        derived cap overflows. No-op when the user pinned
+        ``ngp_packed_cap_avg_eval`` explicitly, when the march is not
+        packed, or after the first derivation (a moving cap would rebuild
+        the eval executable every time occupancy drifts). Returns whether
+        the cap changed."""
+        if (not self.packed_march or self._eval_cap_user_preset
+                or self._eval_cap_derived):
+            return False
+        occ = float(jnp.mean(grid))  # one intentional sync, pre-first-eval
+        if occ <= 0.0 or occ >= self.warmup_exit_occ:
+            # dead or still-dense grid (fresh inits warm-start ABOVE the
+            # threshold, occ = 1.0): keep the blanket preset and leave
+            # derivation open for the first genuinely carved eval
+            return False
+        raw = occ * self.eval_march.max_samples * 1.5
+        cap = max(64, -(-int(np.ceil(raw)) // 64) * 64)  # round up to x64
+        self._eval_cap_derived = True
+        if cap == self.packed_cap_avg_eval:
+            return False
+        cap_old = self.packed_cap_avg_eval
+        self.packed_cap_avg_eval = cap
+        get_emitter().emit(
+            "compile",
+            name="ngp_render_eval_cap_derived",
+            n_compiles=0,  # a (re)sizing, not a build — builds ride below
+            wall_s=0.0,
+            cap_old=cap_old,
+            cap_new=cap,
+        )
+        print(
+            f"ngp eval cap: occupancy {occ:.1%} x "
+            f"{self.eval_march.max_samples} max_samples x 1.5 headroom "
+            f"-> packed_cap_avg_eval {cap} (was {cap_old})"
+        )
+        return True
+
     def aot_register_render(self, state, n_rays_image: int) -> None:
         """Pre-build the packed/accelerated eval executable for one test
-        image's ray count at the preset cap — the first val no longer
-        blocks on its compile, and a warm process deserializes it."""
+        image's ray count — sized by the live grid's occupancy when it has
+        carved (maybe_derive_eval_cap) — so the first val no longer blocks
+        on its compile, and a warm process deserializes it."""
         if self.aot is None:
             return
         from ..compile import abstract_like
         from ..renderer.volume import _pad_to_chunks
 
+        self.maybe_derive_eval_cap(state.grid_ema > self.threshold)
         rays = jnp.zeros((int(n_rays_image), 6), jnp.float32)
         rays_p, _, n_chunks, chunk = _pad_to_chunks(
             rays, self.eval_march.chunk_size
@@ -682,6 +735,9 @@ class NGPTrainer:
         from ..renderer.volume import _pad_to_chunks, _unpad_outputs
 
         grid = state.grid_ema > self.threshold
+        # first eval on a carved grid: size the stream cap from occupancy
+        # BEFORE the executable cache key below bakes the preset in
+        self.maybe_derive_eval_cap(grid)
         rays_p, n, n_chunks, chunk = _pad_to_chunks(
             jnp.asarray(batch["rays"]), self.eval_march.chunk_size
         )

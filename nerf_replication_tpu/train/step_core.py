@@ -40,6 +40,7 @@ def sampled_grad_step(
     k_render,
     index_pool=None,
     grad_accum: int = 1,
+    step=None,
 ):
     """Draw ``n_rays`` from the bank and compute (grads, stats) of the loss.
 
@@ -53,7 +54,7 @@ def sampled_grad_step(
     """
     if grad_accum <= 1:
         return _one_grad(loss, params, bank_rays, bank_rgbs, n_rays, near,
-                         far, k_sample, k_render, index_pool)
+                         far, k_sample, k_render, index_pool, step)
     if n_rays % grad_accum != 0:
         raise ValueError(
             f"n_rays={n_rays} must be divisible by "
@@ -67,7 +68,7 @@ def sampled_grad_step(
         ks, kr = keys
         grads, stats = _one_grad(
             loss, params, bank_rays, bank_rgbs, n_micro, near, far, ks, kr,
-            index_pool,
+            index_pool, step,
         )
         carry = jax.tree_util.tree_map(lambda a, b: a + b, carry, grads)
         return carry, stats
@@ -107,7 +108,7 @@ def fix_accum_psnr(stats: dict) -> dict:
 
 
 def _one_grad(loss, params, bank_rays, bank_rgbs, n_rays, near, far,
-              k_sample, k_render, index_pool):
+              k_sample, k_render, index_pool, step=None):
     # named scopes land in the compiled op names, so the xplane trace a
     # profiler window captures (obs/profiling.py) attributes device time
     # to the bank draw vs the render+grad sweep
@@ -116,13 +117,14 @@ def _one_grad(loss, params, bank_rays, bank_rgbs, n_rays, near, far,
             k_sample, bank_rays, bank_rgbs, n_rays, index_pool=index_pool
         )
 
+    # traced scalar, not a python int: the proposal sampler's anneal
+    # schedule (renderer/sampling.py) reads it per step without retracing
+    batch = {"rays": rays, "rgbs": rgbs, "near": near, "far": far}
+    if step is not None:
+        batch["step"] = step
+
     def loss_fn(p):
-        _, l, stats = loss(
-            {"params": p},
-            {"rays": rays, "rgbs": rgbs, "near": near, "far": far},
-            key=k_render,
-            train=True,
-        )
+        _, l, stats = loss({"params": p}, batch, key=k_render, train=True)
         return l, stats
 
     with jax.named_scope("render_grad"):

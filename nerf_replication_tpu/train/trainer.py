@@ -175,7 +175,7 @@ class Trainer:
             grads, stats = sampled_grad_step(
                 loss, state.params, bank_rays, bank_rgbs, n_rays, near, far,
                 k_sample, k_render, index_pool=pool[0] if pool else None,
-                grad_accum=grad_accum,
+                grad_accum=grad_accum, step=state.step,
             )
             new_state = state.apply_gradients(grads=grads)
             return new_state, stats
@@ -198,6 +198,7 @@ class Trainer:
                 grads, stats = sampled_grad_step(
                     loss, st.params, bank_rays, bank_rgbs, n_rays, near,
                     far, k_sample, k_render, grad_accum=grad_accum,
+                    step=st.step,
                 )
                 return st.apply_gradients(grads=grads), stats
 
@@ -439,6 +440,23 @@ class Trainer:
                 log(f"val epoch {epoch}: " + "  ".join(
                     f"{k}: {v:.4f}" for k, v in result.items()
                 ))
+        # one sample row per validation pass: the fine-eval budget is the
+        # quantity the learned sampler exists to cut, so it is tracked at
+        # the same cadence as quality (tlm_report --diff gates on it)
+        renderer = getattr(self.loss, "renderer", None)
+        if renderer is not None and hasattr(renderer, "sampling_stats"):
+            ss = renderer.sampling_stats()
+            row = {
+                "mode": ss["mode"],
+                "fine_evals_per_ray": ss["fine_evals_per_ray_eval"],
+                "n_proposal": ss["n_proposal"],
+                "n_fine": ss["n_fine"],
+                "surface": "val",
+                "step": int(state.step),
+            }
+            if "psnr" in result:
+                row["psnr"] = float(result["psnr"])
+            get_emitter().emit("sample", **row)
         return result
 
 

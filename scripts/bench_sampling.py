@@ -1,0 +1,236 @@
+"""Learned-sampling benchmark: coarse+fine baseline vs proposal resampler.
+
+Trains both arms from scratch on the procedural scene with matched step
+budgets, then reports per arm:
+
+  - ``fine_evals_per_ray``: fine-MLP evaluations per ray at eval time
+    (the quantity the proposal resampler exists to cut),
+  - ``psnr``: reconstruction quality on the held-out test rays,
+  - ``rays_per_s``: deterministic eval-path throughput.
+
+Timing runs K carry-dependent iterations inside ONE jitted fori_loop
+(the elision-immune pattern from bench_traversal.py): each iteration
+perturbs the ray origins by ``sum * 1e-12`` so XLA cannot hoist or
+elide repeated renders, and compile time is excluded by a warmup call.
+
+Rows append to BENCH_SAMPLING.jsonl (family ``sampling_mode``,
+obs/schema.py) — the committed trail `tlm_report --diff` gates on:
+a candidate whose fine-evals/ray grows past the baseline's fails CI.
+
+    JAX_PLATFORMS=cpu python scripts/bench_sampling.py --steps 400
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from nerf_replication_tpu.utils.platform import (  # noqa: E402
+    enable_compilation_cache,
+    setup_backend,
+)
+
+# Matched tiny budgets (the test suite's procedural-scene schema): the
+# baseline spends N_samples + N_importance = 48 fine-MLP evals per ray;
+# the proposal arm resamples n_fine = 24 from a 24-sample proposal
+# histogram — a 2x cut at parity PSNR (n_fine 16 gives 3x but lands
+# ~0.4 dB under the baseline at this training budget).
+_COMMON = [
+    "scene", "procedural",
+    "train_dataset.H", "16", "train_dataset.W", "16",
+    "test_dataset.H", "16", "test_dataset.W", "16",
+    "task_arg.N_rays", "256",
+    "task_arg.N_samples", "24",
+    "task_arg.N_importance", "24",
+    "task_arg.chunk_size", "256",
+    "task_arg.precrop_iters", "0",
+    "network.nerf.W", "64",
+    "network.nerf.D", "3",
+    "network.nerf.skips", "[1]",
+    "network.xyz_encoder.freq", "6",
+    "network.dir_encoder.freq", "2",
+    "ep_iter", "1000000",
+]
+
+ARMS = {
+    "coarse_fine": [],
+    "proposal": [
+        "sampling.mode", "proposal",
+        "sampling.n_proposal", "24",
+        "sampling.n_fine", "24",
+    ],
+}
+
+
+def make_arm_cfg(mode: str, scene_root: str, steps: int):
+    from nerf_replication_tpu.config import make_cfg
+
+    extra = list(ARMS[mode])
+    if mode == "proposal":
+        # anneal over the first half of training, sharp for the rest
+        extra += ["sampling.anneal_iters", str(max(1, steps // 2))]
+    return make_cfg(
+        os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        [
+            *_COMMON,
+            "train_dataset.data_root", scene_root,
+            "test_dataset.data_root", scene_root,
+            *extra,
+        ],
+    )
+
+
+def run_arm(mode: str, scene_root: str, steps: int, iters: int,
+            time_rays: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nerf_replication_tpu.datasets.blender import Dataset
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.renderer.volume import render_rays
+    from nerf_replication_tpu.train import (
+        Trainer,
+        make_loss,
+        make_train_state,
+    )
+
+    cfg = make_arm_cfg(mode, scene_root, steps)
+    net = make_network(cfg)
+    loss = make_loss(cfg, net)
+    trainer = Trainer(cfg, net, loss)
+    state, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+
+    ds = Dataset(data_root=scene_root, scene="procedural", split="train",
+                 H=16, W=16)
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    base_key = jax.random.PRNGKey(1)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, stats = trainer.step(state, bank[0], bank[1], base_key)
+    train_psnr = float(stats["psnr"])
+    train_s = time.perf_counter() - t0
+    params = {"params": state.params}
+
+    # held-out quality: deterministic chunked eval over every test ray
+    renderer = loss.renderer
+    test_ds = Dataset(data_root=scene_root, scene="procedural", split="test",
+                      H=16, W=16)
+    test_rays, test_rgbs = (jnp.asarray(a) for a in test_ds.ray_bank())
+    out = renderer.render_chunked(
+        params,
+        {"rays": test_rays, "near": trainer.near, "far": trainer.far},
+    )
+    pred = out["rgb_map_f"].reshape(-1, 3)[: test_rgbs.shape[0]]
+    mse = float(jnp.mean((pred - test_rgbs) ** 2))
+    psnr = -10.0 * float(np.log10(max(mse, 1e-12)))
+
+    # eval-path throughput: K chained renders inside one jit
+    options = renderer.eval_options
+    near, far = trainer.near, trainer.far
+    reps = -(-time_rays // int(bank[0].shape[0]))
+    r0 = jnp.tile(bank[0], (reps, 1))[:time_rays]
+
+    @jax.jit
+    def timed(params, rays0):
+        def apply_fn(pts, vd, model):
+            return net.apply(params, pts, vd, model=model)
+
+        def body(i, carry):
+            s, rays = carry
+            o = render_rays(apply_fn, rays, near, far, None, options)
+            s = s + jnp.mean(o["rgb_map_f"])
+            return s, rays0.at[0, 0].add(s * 1e-12)
+
+        return jax.lax.fori_loop(0, iters, body, (0.0, r0))[0]
+
+    timed(params, r0).block_until_ready()  # compile excluded
+    t0 = time.perf_counter()
+    timed(params, r0).block_until_ready()
+    dt = time.perf_counter() - t0
+    rays_per_s = time_rays * iters / dt
+
+    ss = renderer.sampling_stats()
+    return {
+        "sampling_mode": mode,
+        "fine_evals_per_ray": int(options.fine_evals_per_ray),
+        "rays_per_s": rays_per_s,
+        "psnr": psnr,
+        "train_psnr": train_psnr,
+        "train_steps": steps,
+        "train_s": train_s,
+        "n_proposal": ss["n_proposal"],
+        "n_fine": ss["n_fine"],
+        "timed_rays": time_rays,
+        "timed_iters": iters,
+        "platform": jax.default_backend(),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default=os.path.join(_REPO,
+                                                 "BENCH_SAMPLING.jsonl"))
+    p.add_argument("--steps", type=int, default=400,
+                   help="train steps per arm (matched)")
+    p.add_argument("--iters", type=int, default=4,
+                   help="chained renders inside the timing loop")
+    p.add_argument("--time_rays", type=int, default=1024,
+                   help="rays per timed render")
+    p.add_argument("--scene_dir", default="",
+                   help="reuse an existing procedural scene dir")
+    p.add_argument("--force_platform", default="",
+                   help="cpu|tpu|gpu (default: auto)")
+    args = p.parse_args(argv)
+
+    setup_backend(args.force_platform)
+    enable_compilation_cache()
+
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+
+    scene_root = args.scene_dir
+    tmp = None
+    if not scene_root:
+        tmp = tempfile.TemporaryDirectory(prefix="bench_sampling_")
+        scene_root = tmp.name
+        generate_scene(scene_root, scene="procedural", H=16, W=16,
+                       n_train=6, n_test=2)
+
+    rows = []
+    for mode in ("coarse_fine", "proposal"):
+        print(f"[bench_sampling] arm={mode} steps={args.steps} ...")
+        row = run_arm(mode, scene_root, args.steps, args.iters,
+                      args.time_rays)
+        rows.append(row)
+        print(f"  fine_evals_per_ray={row['fine_evals_per_ray']} "
+              f"psnr={row['psnr']:.2f} rays/s={row['rays_per_s']:.0f}")
+
+    base, prop = rows
+    reduction = base["fine_evals_per_ray"] / max(1, prop["fine_evals_per_ray"])
+    delta_db = prop["psnr"] - base["psnr"]
+    prop["fine_eval_reduction_x"] = reduction
+    prop["psnr_delta_db"] = delta_db
+    print(f"[bench_sampling] proposal vs baseline: {reduction:.1f}x fewer "
+          f"fine evals/ray, PSNR delta {delta_db:+.2f} dB, "
+          f"throughput {prop['rays_per_s'] / base['rays_per_s']:.2f}x")
+
+    with open(args.out, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    print(f"[bench_sampling] appended {len(rows)} rows to {args.out}")
+
+    if tmp is not None:
+        tmp.cleanup()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,7 +14,8 @@ stage p95 total. ``--diff`` compares run A (baseline) against run B
 (candidate) and flags regressions past ``--gate`` percent (step-time
 p50, peak memory, queue-wait p95 share) or any compile-count increase /
 PSNR drop > 0.1 dB / growth in unrecovered faults (exhausted retry
-ladders) or breaker opens; with ``--gate`` the exit code is nonzero when
+ladders), breaker opens, or fine-MLP evals/ray (the learned-sampling
+budget); with ``--gate`` the exit code is nonzero when
 a regression is flagged, so a bench battery can use it as its gate
 against a saved baseline run (e.g. the run behind ``BASELINE.json``).
 
@@ -253,6 +254,24 @@ def summarize(rows: list[dict]) -> dict:
             {r.get("mode", "packed") for r in marches}
         )
 
+    # learned-sampling rows (renderer/sampling.py proposal resampler):
+    # fine-MLP evaluations per ray — the budget the proposal network cuts
+    # — next to the PSNR it bought. Keys present only when the run emitted
+    # sample rows (validation passes / bench_sampling arms).
+    samples = [r for r in rows if r.get("kind") == "sample"]
+    if samples:
+        last = samples[-1]
+        psnrs = [float(r["psnr"]) for r in samples
+                 if r.get("psnr") is not None]
+        summary["sample_rows"] = len(samples)
+        summary["sampling_mode"] = last.get("mode")
+        summary["sampling_fine_evals_per_ray"] = last.get(
+            "fine_evals_per_ray"
+        )
+        summary["sampling_n_proposal"] = last.get("n_proposal")
+        summary["sampling_n_fine"] = last.get("n_fine")
+        summary["sampling_last_psnr"] = psnrs[-1] if psnrs else None
+
     # resilience rows (nerf_replication_tpu/resil): injected vs detected
     # faults, the retry ladder's outcomes, breaker transitions. An
     # ``exhausted`` retry row is an UNRECOVERED fault — the count --diff
@@ -417,6 +436,17 @@ def print_summary(summary: dict, label: str = "") -> None:
               + (f"{occ * 100:.1f}%" if occ is not None else "n/a")
               + "  overflow max: "
               + (f"{over * 100:.1f}%" if over is not None else "n/a"))
+    if summary.get("sample_rows"):
+        mode = summary.get("sampling_mode") or "n/a"
+        fer = summary.get("sampling_fine_evals_per_ray")
+        psnr = summary.get("sampling_last_psnr")
+        budgets = ""
+        if mode == "proposal":
+            budgets = (f"  S_p: {summary.get('sampling_n_proposal')}"
+                       f"  S_f: {summary.get('sampling_n_fine')}")
+        print(f"  sampling:      mode {mode}  fine evals/ray: "
+              + (f"{fer:g}" if fer is not None else "n/a") + budgets
+              + (f"  psnr: {psnr:.3f}" if psnr is not None else ""))
     if summary.get("fault_points") is not None:
         mix = " ".join(
             f"{k}:{v}" for k, v in sorted(summary["fault_points"].items())
@@ -528,6 +558,14 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
             f"march sweep efficiency dropped {a * 100:.1f}% -> "
             f"{b * 100:.1f}%"
         )
+    # fine-MLP evals/ray GROWING means the candidate spends more network
+    # sweeps per ray than its baseline — the learned sampler's whole win
+    # handed back (a config drift or a mode fallback, not a perf bug the
+    # step-time gate would necessarily catch at small batches)
+    a = base.get("sampling_fine_evals_per_ray")
+    b = cand.get("sampling_fine_evals_per_ray")
+    if a and b is not None and b > a:
+        flags.append(f"fine-MLP evals/ray grew {a:g} -> {b:g}")
     return flags
 
 

@@ -1,0 +1,313 @@
+"""Learned-sampling subsystem (renderer/sampling.py, models/proposal.py):
+the inverse-CDF resampler's ordering/stratification/determinism contracts,
+the interlevel bound loss, the proposal-mode network + render pipeline
+end-to-end on the procedural scene, and the serve ladder's ``proposal``
+executable family (zero steady-state recompiles, coarse_fine fallback).
+All CPU."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.blender import Dataset
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.renderer.sampling import (
+    edges_from_samples,
+    interlevel_loss,
+    resample_pdf,
+    weights_from_sigma,
+)
+from nerf_replication_tpu.serve import RenderEngine
+
+NEAR, FAR = 2.0, 6.0
+
+
+def proposal_cfg(scene_root, extra=()):
+    """tiny_cfg with the learned sampler replacing the coarse pass."""
+    return tiny_cfg(
+        scene_root,
+        [
+            "sampling.mode", "proposal",
+            "sampling.n_proposal", "24",
+            "sampling.n_fine", "16",
+            "sampling.anneal_iters", "50",
+            *extra,
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def scene_root(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_sampling"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=6, n_test=2)
+    return root
+
+
+# -- resampler contracts -----------------------------------------------------
+
+
+def test_resample_det_samples_are_monotonic_and_in_range():
+    key = jax.random.PRNGKey(3)
+    bins = jnp.sort(jax.random.uniform(key, (8, 25), minval=NEAR, maxval=FAR))
+    weights = jax.random.uniform(jax.random.fold_in(key, 1), (8, 24)) + 1e-3
+    z = np.asarray(resample_pdf(None, bins, weights, 32, det=True))
+    assert z.shape == (8, 32)
+    assert (np.diff(z, axis=-1) >= 0).all()
+    assert (z >= np.asarray(bins)[:, :1]).all()
+    assert (z <= np.asarray(bins)[:, -1:]).all()
+
+
+def test_uniform_weights_reduce_to_stratified_midpoints():
+    """A flat histogram must resample to the stratified midpoint rule —
+    the property that makes the annealed PDF's uniform endpoint exactly
+    the classic stratified sampler."""
+    bins = jnp.linspace(NEAR, FAR, 25)[None, :].repeat(4, 0)
+    weights = jnp.ones((4, 24))
+    n = 16
+    z = np.asarray(resample_pdf(None, bins, weights, n, det=True))
+    expect = NEAR + (FAR - NEAR) * (np.arange(n) + 0.5) / n
+    np.testing.assert_allclose(z, np.tile(expect, (4, 1)), rtol=0, atol=1e-4)
+    # anneal=0 blends ANY histogram fully to uniform -> same midpoints
+    skew = jnp.concatenate([jnp.ones((4, 12)) * 50.0, jnp.ones((4, 12))], -1)
+    z0 = np.asarray(resample_pdf(None, bins, skew, n, det=True, anneal=0.0))
+    np.testing.assert_allclose(z0, np.tile(expect, (4, 1)), rtol=0, atol=1e-4)
+
+
+def test_resample_concentrates_where_the_mass_is():
+    bins = jnp.linspace(0.0, 1.0, 11)[None, :]
+    weights = jnp.array([[0.0, 0.0, 0.0, 0.0, 1.0, 1.0, 0.0, 0.0, 0.0, 0.0]])
+    z = np.asarray(resample_pdf(None, bins, weights, 64, det=True))
+    # the 1e-5 floor leaks a sliver of mass to empty bins; nearly all
+    # samples must land inside [0.4, 0.6] where the histogram lives
+    assert (np.abs(z - 0.5) < 0.1 + 1e-3).mean() > 0.95
+
+
+def test_resample_jit_is_bitwise_deterministic():
+    key = jax.random.PRNGKey(11)
+    bins = jnp.linspace(NEAR, FAR, 25)[None, :].repeat(8, 0)
+    weights = jax.random.uniform(jax.random.fold_in(key, 7), (8, 24))
+    fn = jax.jit(resample_pdf, static_argnames=("n_samples", "det"))
+    a = np.asarray(fn(key, bins, weights, 16, det=False))
+    b = np.asarray(fn(key, bins, weights, 16, det=False))
+    assert np.array_equal(a, b)  # bitwise, same key
+    c = np.asarray(resample_pdf(key, bins, weights, 16, det=False))
+    np.testing.assert_allclose(a, c, rtol=1e-6, atol=1e-6)
+
+
+def test_weights_and_edges_helpers():
+    z = jnp.linspace(NEAR, FAR, 24)[None, :]
+    sigma = jnp.ones_like(z) * 2.0
+    rays_d = jnp.array([[0.0, 0.0, -1.0]])
+    w = np.asarray(weights_from_sigma(sigma, z, rays_d))
+    assert w.shape == z.shape
+    assert (w >= 0).all() and w.sum() <= 1.0 + 1e-5
+    edges = np.asarray(edges_from_samples(z))
+    assert edges.shape == (1, 25)
+    assert (np.diff(edges, axis=-1) >= 0).all()
+    np.testing.assert_allclose(edges[:, 0], NEAR)
+    np.testing.assert_allclose(edges[:, -1], FAR)
+
+
+# -- interlevel bound loss ---------------------------------------------------
+
+
+def test_interlevel_loss_zero_when_proposal_covers_fine():
+    t = jnp.linspace(0.0, 1.0, 17)[None, :]
+    w = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(0), (1, 16)))
+    # identical histograms: the outer measure upper-bounds each bin's own
+    # weight, so nothing exceeds the envelope
+    loss = float(interlevel_loss(t, w, t, w))
+    assert loss == pytest.approx(0.0, abs=1e-9)
+    # a LOOSER envelope (same support, more mass) is also free
+    loss2 = float(interlevel_loss(t, w, t, w * 2.0))
+    assert loss2 == pytest.approx(0.0, abs=1e-9)
+
+
+def test_interlevel_loss_penalizes_uncovered_fine_mass():
+    t = jnp.linspace(0.0, 1.0, 17)[None, :]
+    w_fine = jnp.zeros((1, 16)).at[0, -1].set(1.0)  # all mass at the end
+    w_prop = jnp.zeros((1, 16)).at[0, 0].set(1.0)  # envelope at the start
+    loss = float(interlevel_loss(t, w_fine, t, w_prop))
+    assert loss > 0.1
+
+
+def test_interlevel_loss_grads_flow_to_proposal_only():
+    t = jnp.linspace(0.0, 1.0, 17)[None, :]
+    w_fine = jax.nn.softmax(jnp.arange(16.0))[None, :]
+    w_prop = jnp.full((1, 16), 1.0 / 16)
+
+    g_prop = jax.grad(lambda wp: interlevel_loss(t, w_fine, t, wp))(w_prop)
+    assert float(jnp.abs(g_prop).sum()) > 0.0
+    # fine inputs are stop-gradient'ed INSIDE the loss: the fine network
+    # must never be pulled toward the proposal's histogram
+    g_fine = jax.grad(lambda wf: interlevel_loss(t, wf, t, w_prop))(w_fine)
+    assert float(jnp.abs(g_fine).sum()) == 0.0
+
+
+# -- proposal-mode network + pipeline ----------------------------------------
+
+
+def test_proposal_mode_network_has_three_branches(scene_root):
+    cfg = proposal_cfg(scene_root)
+    net = make_network(cfg)
+    params = init_params(net, jax.random.PRNGKey(0))
+    assert set(params["params"]) == {"coarse", "fine", "proposal"}
+    # the proposal branch is the SMALL density-only MLP, not a clone
+    n_prop = sum(
+        x.size for x in jax.tree_util.tree_leaves(params["params"]["proposal"])
+    )
+    n_fine = sum(
+        x.size for x in jax.tree_util.tree_leaves(params["params"]["fine"])
+    )
+    assert n_prop < n_fine / 2
+
+
+def test_proposal_branch_init_does_not_disturb_coarse_fine(scene_root):
+    """Adding the learned sampler must keep the coarse/fine init draws
+    bitwise-stable — checkpoints and seeds stay comparable across modes."""
+    base = tiny_cfg(scene_root)
+    prop = proposal_cfg(scene_root)
+    p_base = init_params(make_network(base), jax.random.PRNGKey(0))
+    p_prop = init_params(make_network(prop), jax.random.PRNGKey(0))
+    for branch in ("coarse", "fine"):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_base["params"][branch]),
+            jax.tree_util.tree_leaves(p_prop["params"][branch]),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), branch
+
+
+def test_proposal_eval_render_is_deterministic_and_cheaper(scene_root):
+    from nerf_replication_tpu.renderer.volume import make_renderer
+
+    cfg = proposal_cfg(scene_root)
+    net = make_network(cfg)
+    params = init_params(net, jax.random.PRNGKey(0))
+    renderer = make_renderer(cfg, net)
+    assert renderer.eval_options.sampling.mode == "proposal"
+    assert renderer.eval_options.fine_evals_per_ray == 16
+    assert renderer.train_options.fine_evals_per_ray == 16
+    ss = renderer.sampling_stats()
+    assert ss["mode"] == "proposal" and ss["n_proposal"] == 24
+    rays = jnp.asarray(
+        np.concatenate(
+            [np.tile([0.0, 0.0, 4.0], (32, 1)),
+             np.tile([0.0, 0.0, -1.0], (32, 1))], -1
+        ).astype(np.float32)
+    )
+    batch = {"rays": rays, "near": NEAR, "far": FAR}
+    a = renderer.render_chunked(params, batch)
+    b = renderer.render_chunked(params, batch)
+    assert a["rgb_map_f"].shape == (32, 3)
+    assert np.array_equal(np.asarray(a["rgb_map_f"]), np.asarray(b["rgb_map_f"]))
+    assert np.isfinite(np.asarray(a["rgb_map_f"])).all()
+
+
+def test_proposal_end_to_end_psnr_parity(scene_root):
+    """The acceptance slice: the proposal pipeline trains end-to-end on
+    the procedural scene and clears the SAME bars as the coarse+fine
+    e2e test (test_train.py) with a third of the fine-MLP evals."""
+    from nerf_replication_tpu.train import Trainer, make_loss, make_train_state
+
+    cfg = proposal_cfg(scene_root)
+    net = make_network(cfg)
+    loss = make_loss(cfg, net)
+    trainer = Trainer(cfg, net, loss)
+    state, _ = make_train_state(cfg, net, jax.random.PRNGKey(0))
+    ds = Dataset(
+        data_root=scene_root, scene="procedural", split="train", H=16, W=16
+    )
+    bank = tuple(jnp.asarray(a) for a in ds.ray_bank())
+    base_key = jax.random.PRNGKey(1)
+
+    psnr_first = None
+    for i in range(150):
+        state, stats = trainer.step(state, bank[0], bank[1], base_key)
+        if i == 0:
+            psnr_first = float(stats["psnr"])
+            assert "loss_prop" in stats  # interlevel loss is live
+    psnr_last = float(stats["psnr"])
+    assert np.isfinite(float(stats["loss_prop"]))
+    assert psnr_last > psnr_first + 3.0, (psnr_first, psnr_last)
+    assert psnr_last > 12.0
+
+
+# -- serve ladder ------------------------------------------------------------
+
+
+def _serve_extra():
+    return [
+        "serve.buckets", "[64]",
+        "serve.max_batch_rays", "64",
+        "serve.max_delay_ms", "40.0",
+        "serve.request_timeout_s", "5.0",
+        "serve.cache_entries", "4",
+        "serve.pose_decimals", "3",
+        "serve.shed_queue_depths", "[1, 2, 4, 6]",
+    ]
+
+
+def test_serve_proposal_engine_prewarms_and_never_recompiles(scene_root):
+    """A proposal-trained checkpoint serves a 6th executable family: the
+    warm-up covers it, a mixed-tier stream stays at zero new compiles,
+    and /stats reports the per-family fine-eval ladder."""
+    cfg = proposal_cfg(scene_root, _serve_extra())
+    net = make_network(cfg)
+    params = init_params(net, jax.random.PRNGKey(0))
+    engine = RenderEngine(cfg, net, params, near=NEAR, far=FAR)
+    assert engine.has_proposal
+    assert "proposal" in engine._families_for_params()
+    assert engine.warmup_compiles > 0
+    before = engine.tracker.total_compiles()
+    rays = np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (40, 1)),
+         np.tile([0.0, 0.0, -1.0], (40, 1))], -1
+    ).astype(np.float32)
+    for tier in ("full", "bf16", "proposal", "reduced_k", "coarse",
+                 "half_res"):
+        out = engine.render_request(rays, NEAR, FAR, tier=tier, emit=False)
+        assert out["rgb_map_f"].shape == (40, 3)
+        assert np.isfinite(out["rgb_map_f"]).all()
+    assert engine.tracker.total_compiles() == before
+    s = engine.stats()["sampling"]
+    assert s["mode"] == "proposal" and s["has_proposal"]
+    fe = s["fine_evals_per_ray"]
+    # the shed ladder strictly cuts fine-MLP work tier over tier
+    assert fe["full"] == 16 and fe["proposal"] == 8
+    assert fe["reduced_k"] == 8 and fe["coarse"] == 4
+
+
+def test_serve_coarse_fine_engine_falls_back_from_proposal_tier(scene_root):
+    """A classic checkpoint has no learned-sampler branch: the proposal
+    family is never warmed, and the proposal TIER serves from the
+    already-warm reduced_k executable without compiling anything."""
+    cfg = tiny_cfg(scene_root, _serve_extra())
+    net = make_network(cfg)
+    params = init_params(net, jax.random.PRNGKey(0))
+    engine = RenderEngine(cfg, net, params, near=NEAR, far=FAR)
+    assert not engine.has_proposal
+    assert "proposal" not in engine._families_for_params()
+    before = engine.tracker.total_compiles()
+    rays = np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (16, 1)),
+         np.tile([0.0, 0.0, -1.0], (16, 1))], -1
+    ).astype(np.float32)
+    out = engine.render_request(rays, NEAR, FAR, tier="proposal", emit=False)
+    reduced = engine.render_request(rays, NEAR, FAR, tier="reduced_k",
+                                    emit=False)
+    np.testing.assert_array_equal(out["rgb_map_f"], reduced["rgb_map_f"])
+    assert engine.tracker.total_compiles() == before
+    s = engine.stats()["sampling"]
+    assert s["mode"] == "coarse_fine" and not s["has_proposal"]
+    assert "proposal" not in s["fine_evals_per_ray"]

@@ -127,7 +127,11 @@ def test_mixed_shapes_never_retrace_after_warmup(setup):
     for n in (1, 63, 64, 65, 127, 128, 129, 255, 256, 300, 513, 777):
         rays = _rays(min(n, 256))
         rays = np.tile(rays, (-(-n // rays.shape[0]), 1))[:n]
-        for tier in ("full", "bf16", "reduced_k", "coarse", "half_res"):
+        # "proposal" rides along: this coarse_fine checkpoint has no
+        # learned-sampler branch, so the tier falls back to the reduced_k
+        # family — which must not compile anything new either
+        for tier in ("full", "bf16", "proposal", "reduced_k", "coarse",
+                     "half_res"):
             out = engine.render_request(rays, NEAR, FAR, tier=tier,
                                         emit=False)
             assert out["rgb_map_f"].shape == (n, 3)
@@ -255,23 +259,24 @@ def test_engine_and_batcher_reject_mismatched_bounds(setup):
 
 
 def test_policy_tiers_deterministic():
-    policy = DegradationPolicy(thresholds=(1, 2, 4, 6))
+    policy = DegradationPolicy(thresholds=(1, 2, 4, 6, 8))
     assert policy.tier_for(0) == "full"
     assert policy.tier_for(1) == "bf16"
-    assert policy.tier_for(2) == "reduced_k"
-    assert policy.tier_for(4) == "coarse"
-    assert policy.tier_for(6) == "half_res"
+    assert policy.tier_for(2) == "proposal"
+    assert policy.tier_for(4) == "reduced_k"
+    assert policy.tier_for(6) == "coarse"
+    assert policy.tier_for(8) == "half_res"
     assert policy.tier_for(1000) == "half_res"  # saturates, never IndexError
     # a SHORT ladder still works: depths map to the first len+1 tiers
     short = DegradationPolicy(thresholds=(2, 4))
     assert short.tier_for(1) == "full"
     assert short.tier_for(2) == "bf16"
-    assert short.tier_for(4) == "reduced_k"
-    assert short.tier_for(99) == "reduced_k"
+    assert short.tier_for(4) == "proposal"
+    assert short.tier_for(99) == "proposal"
     with pytest.raises(ValueError, match="ascending"):
         DegradationPolicy(thresholds=(4, 2))
     with pytest.raises(ValueError, match="at most"):
-        DegradationPolicy(thresholds=(1, 2, 3, 4, 5))
+        DegradationPolicy(thresholds=(1, 2, 3, 4, 5, 6))
 
 
 def test_degradation_under_synthetic_queue_depth(setup):
@@ -279,8 +284,8 @@ def test_degradation_under_synthetic_queue_depth(setup):
     behind the cut batch and the batch serves at the policy's tier for
     depth N — recorded in each response."""
     cfg, network, params, grid, bbox, engine = setup
-    for backlog, expected in ((0, "full"), (1, "bf16"), (2, "reduced_k"),
-                              (4, "coarse"), (6, "half_res")):
+    for backlog, expected in ((0, "full"), (1, "bf16"), (2, "proposal"),
+                              (4, "reduced_k"), (6, "coarse")):
         clock = FakeClock()
         batcher = MicroBatcher(engine, clock=clock, start=False)
         futures = [batcher.submit(_rays(256), NEAR, FAR)]  # fills max_batch
@@ -442,7 +447,8 @@ def test_serve_rows_validate_against_schema(setup, tmp_path):
     batch = next(r for r in rows if r["kind"] == "serve_batch")
     assert 0.0 < batch["occupancy"] <= 1.0
     shed = next(r for r in rows if r["kind"] == "serve_shed")
-    assert shed["tier"] in ("bf16", "reduced_k", "coarse", "half_res")
+    assert shed["tier"] in ("bf16", "proposal", "reduced_k", "coarse",
+                            "half_res")
 
 
 def test_tlm_report_summarizes_serve_rows(tmp_path):

@@ -193,10 +193,29 @@ def default_cfg() -> ConfigNode:
         {
             "manifest": "",             # scene manifest JSON (docs/fleet.md)
             "scan_dir": "",             # or: discover scenes by directory scan
+            "store_dir": "",            # or: sharded SceneStore root (index.json)
             "hbm_budget_mb": 256.0,     # resident-scene byte budget
             "prefetch": True,           # background h2d on first sight
             "verify_checksums": True,   # tree-sha256 gate on scene checkpoints
             "default_scene": "default",  # alias for the engine's own scene
+            # tiered residency ladder (fleet/ladder.py): > 0 keeps a
+            # host-RAM staging tier so HBM eviction demotes instead of
+            # dropping (re-promotion = device_put, no disk/checksum walk);
+            # TTLs expire idle bytes at each tier (0 = never)
+            "staging_mb": 0.0,          # host-RAM staging budget (0 = off)
+            "staging_ttl_s": 0.0,       # staged-copy expiry
+            "resident_ttl_s": 0.0,      # idle HBM-resident demotion
+            # per-tenant QoS (fleet/qos.py): token-bucket admission (429
+            # TenantQuotaError past the rate), weighted fair batch cuts,
+            # and per-tenant breakers; tenants maps name -> {rate, burst,
+            # weight} overrides of the defaults
+            "qos": {
+                "enabled": False,
+                "default_rate": 200.0,   # sustained requests/s per tenant
+                "default_burst": 50.0,   # bucket capacity (burst headroom)
+                "default_weight": 1.0,   # fair-batching share
+                "tenants": {},
+            },
         }
     )
 

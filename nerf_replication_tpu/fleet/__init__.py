@@ -1,15 +1,24 @@
-"""fleet/: multi-scene serving — scene registry + HBM-budgeted residency.
+"""fleet/: the multi-scene control plane — catalog, residency, QoS.
 
 One trained scene per :class:`~nerf_replication_tpu.serve.RenderEngine`
 was the last single-tenant assumption in the serving stack. This package
-removes it: a :class:`SceneRegistry` names every scene's artifacts
-(manifest or directory scan), and a :class:`ResidencyManager` keeps an
-LRU of device-resident scenes under a byte budget with pinned leases and
-async prefetch — all rendered through the engine's ONE prewarmed
-bucket×tier executable family, zero per-scene compiles (docs/fleet.md).
+removes it, in two layers (docs/fleet.md):
+
+* **Serving data plane** — a :class:`SceneRegistry` (manifest or
+  directory scan) or sharded :class:`SceneStore` (manifest shards, lazy
+  page-in) names every scene's artifacts; a :class:`ResidencyManager`
+  keeps an LRU of device-resident scenes under a byte budget with pinned
+  leases and async prefetch — all rendered through the engine's ONE
+  prewarmed bucket×tier executable family, zero per-scene compiles.
+* **Control plane** — :class:`TieredResidencyManager` adds the host-RAM
+  staging tier (eviction demotes, re-promotion is a device_put);
+  :class:`QosController` meters tenants (token-bucket admission,
+  fair-share weights, per-tenant breakers); :class:`ScenePublisher`
+  hot-swaps a scene to a new checkpoint version under live traffic.
 
 ``fleet_from_cfg`` is the wiring surface: it reads the ``fleet:`` config
-block, builds the registry + residency, and attaches them to an engine.
+block, builds the catalog + residency ladder, and attaches them to an
+engine.
 """
 
 from __future__ import annotations
@@ -19,47 +28,67 @@ from .errors import (
     SceneCompatError,
     SceneError,
     SceneLoadError,
+    ScenePublishError,
     UnknownSceneError,
 )
+from .ladder import TieredResidencyManager
+from .publish import ScenePublisher
+from .qos import QosController, TenantPolicy, TenantQuotaError
 from .registry import SceneRecord, SceneRegistry, checkpoint_loader
 from .residency import ResidencyManager, SceneData
+from .store import SceneStore, write_sharded
 
 __all__ = [
+    "QosController",
     "ResidencyManager",
     "ResidencyOverloadError",
     "SceneCompatError",
     "SceneData",
     "SceneError",
     "SceneLoadError",
+    "ScenePublishError",
+    "ScenePublisher",
     "SceneRecord",
     "SceneRegistry",
+    "SceneStore",
+    "TenantPolicy",
+    "TenantQuotaError",
+    "TieredResidencyManager",
     "UnknownSceneError",
     "checkpoint_loader",
     "fleet_from_cfg",
+    "write_sharded",
 ]
 
 
 def fleet_from_cfg(cfg, engine):
     """Build + attach the fleet for ``engine`` from the ``fleet:`` block.
 
-    Returns the :class:`ResidencyManager`, or None when no manifest or
-    scan directory is configured (single-scene serving, the API-compatible
-    default). The byte budget comes from ``fleet.hbm_budget_mb`` and is
-    enforced against real leaf ``nbytes`` at load time."""
+    Returns the residency manager, or None when no discovery knob
+    (``manifest`` / ``scan_dir`` / ``store_dir``) is set — single-scene
+    serving, the API-compatible default. ``staging_mb > 0`` selects the
+    tiered ladder (HBM eviction demotes to host RAM) over the classic
+    drop-on-evict manager. The byte budgets come from
+    ``fleet.hbm_budget_mb`` / ``fleet.staging_mb`` and are enforced
+    against real leaf ``nbytes`` at load time."""
     from ..resil import retry_params
 
     f = cfg.get("fleet", {})
     manifest = str(f.get("manifest", ""))
     scan_dir = str(f.get("scan_dir", ""))
-    if not manifest and not scan_dir:
+    store_dir = str(f.get("store_dir", ""))
+    if not manifest and not scan_dir and not store_dir:
         return None
-    registry = (SceneRegistry.from_manifest(manifest) if manifest
-                else SceneRegistry.scan(scan_dir))
+    if store_dir:
+        registry = SceneStore(store_dir)
+    elif manifest:
+        registry = SceneRegistry.from_manifest(manifest)
+    else:
+        registry = SceneRegistry.scan(scan_dir)
     loader = checkpoint_loader(
         engine.params, default_near=engine.near, default_far=engine.far
     )
-    residency = ResidencyManager(
-        registry, loader,
+    common = dict(
         budget_bytes=int(float(f.get("hbm_budget_mb", 256.0)) * (1 << 20)),
         prefetch=bool(f.get("prefetch", True)),
         verify_checksums=bool(f.get("verify_checksums", True)),
@@ -67,6 +96,17 @@ def fleet_from_cfg(cfg, engine):
         pose_decimals=engine.options.pose_decimals,
         retry_kw=retry_params(cfg),
     )
+    staging_mb = float(f.get("staging_mb", 0.0))
+    if staging_mb > 0:
+        residency = TieredResidencyManager(
+            registry, loader,
+            staging_budget_bytes=int(staging_mb * (1 << 20)),
+            staging_ttl_s=float(f.get("staging_ttl_s", 0.0)),
+            resident_ttl_s=float(f.get("resident_ttl_s", 0.0)),
+            **common,
+        )
+    else:
+        residency = ResidencyManager(registry, loader, **common)
     engine.attach_fleet(
         residency, default_scene=str(f.get("default_scene", "default"))
     )

@@ -38,3 +38,9 @@ class SceneCompatError(SceneLoadError):
 class ResidencyOverloadError(SceneError):
     """The byte budget cannot admit the scene because every resident
     scene is pinned by an in-flight batch (HTTP 503 + Retry-After)."""
+
+
+class ScenePublishError(SceneError):
+    """A hot-update could not swap (drain timeout, concurrent publish).
+    The OLD version is still serving — a failed publish never degrades
+    the scene (HTTP 503 for the publish call only)."""

@@ -70,14 +70,16 @@ class SceneData:
 class _Resident:
     """Book-keeping wrapper around one device-resident scene."""
 
-    __slots__ = ("data", "refcount", "touch", "source", "ever_acquired")
+    __slots__ = ("data", "refcount", "touch", "source", "ever_acquired",
+                 "last_used_t")
 
     def __init__(self, data: SceneData, source: str):
         self.data = data
         self.refcount = 0
         self.touch = 0
-        self.source = source          # "cold" | "prefetch"
+        self.source = source          # "cold" | "prefetch" | "staging" | ...
         self.ever_acquired = False
+        self.last_used_t = time.monotonic()   # wall recency (TTL sweeps)
 
 
 class _Load:
@@ -124,6 +126,10 @@ class ResidencyManager:
         self._resident: OrderedDict[str, _Resident] = OrderedDict()
         self._loading: dict[str, _Load] = {}
         self._reserved = 0            # bytes admitted but not yet committed
+        # scenes mid-hot-update (fleet/publish.py): new acquires park on
+        # the condition until the version swap lands, so the publisher's
+        # refcount drain barrier cannot be starved by fresh pins
+        self._publishing: set[str] = set()
         self._pose_caches: dict[str, PoseCache] = {}
         # counters (read via stats(); mutated under the lock)
         self.loads = 0
@@ -153,11 +159,16 @@ class ResidencyManager:
                                scene=scene_id) as sp:
             while True:
                 with self._cond:
+                    # a publish in flight for this scene: park until the
+                    # swap lands (the post-swap pin renders version N+1)
+                    while scene_id in self._publishing:
+                        self._cond.wait()
                     resident = self._resident.get(scene_id)
                     if resident is not None:
                         resident.refcount += 1
                         _TOUCH += 1
                         resident.touch = _TOUCH
+                        resident.last_used_t = time.monotonic()
                         self._resident.move_to_end(scene_id)
                         if not resident.ever_acquired:
                             # first pin after materialization: a prefetch
@@ -226,7 +237,8 @@ class ResidencyManager:
         if not self.prefetch_enabled or scene_id not in self.registry:
             return False
         with self._cond:
-            if scene_id in self._resident or scene_id in self._loading:
+            if (scene_id in self._resident or scene_id in self._loading
+                    or scene_id in self._publishing):
                 return False
             load = _Load("prefetch")
             self._loading[scene_id] = load
@@ -272,9 +284,16 @@ class ResidencyManager:
         global _TOUCH
         record = self.registry.get(scene_id)
         t0 = time.perf_counter()
-        host = self._load_host(record)
-        if self.validate is not None:
-            self.validate(host)       # SceneCompatError on mismatch
+        # staging fast path (fleet/ladder.py): a demoted scene's host
+        # arrays are still in RAM — re-promotion is a device_put, not a
+        # disk load + checksum walk (and was validated at original load)
+        host = self._staged_host(scene_id)
+        if host is not None:
+            source = "staging"
+        else:
+            host = self._load_host(record)
+            if self.validate is not None:
+                self.validate(host)   # SceneCompatError on mismatch
         nbytes = _tree_nbytes(host)
         if nbytes > self.budget_bytes:
             raise ResidencyOverloadError(
@@ -306,12 +325,18 @@ class ResidencyManager:
             self._resident[scene_id] = resident
             self._resident.move_to_end(scene_id)
             self.loads += 1
+            self._note_load(source)
             self.bytes_loaded += nbytes
+            # write-through to the host-RAM staging tier (no-op in the
+            # one-level manager): a later HBM eviction demotes instead of
+            # dropping because the host copy is already staged
+            self._stage_host(scene_id, host, nbytes)
             n_res, res_bytes = len(self._resident), self._resident_bytes()
+            tier_fields = self._tier_fields()
         get_emitter().emit(
             "scene_load", scene=scene_id, bytes=nbytes, source=source,
             load_s=round(time.perf_counter() - t0, 4),
-            resident=n_res, resident_bytes=res_bytes,
+            resident=n_res, resident_bytes=res_bytes, **tier_fields,
         )
 
     def _load_host(self, record) -> SceneData:
@@ -379,15 +404,48 @@ class ResidencyManager:
                         "pinned by in-flight batches",
                     )
                 victim = self._resident.pop(victim_id)
+                reason = self._retire(victim_id, victim)
                 self.evictions += 1
                 self.bytes_evicted += victim.data.nbytes
                 n_res, res_bytes = len(self._resident), self._resident_bytes()
                 get_emitter().emit(
                     "scene_evict", scene=victim_id,
-                    bytes=victim.data.nbytes, reason="budget",
+                    bytes=victim.data.nbytes, reason=reason,
                     resident=n_res, resident_bytes=res_bytes,
+                    **self._tier_fields(),
                 )
             self._reserved += nbytes
+
+    # -- residency-tier hooks (overridden by fleet/ladder.py) -----------------
+
+    def _staged_host(self, scene_id: str) -> SceneData | None:
+        """Host-side copy of ``scene_id`` if a staging tier holds one
+        (None in the one-level manager: every miss is a disk load)."""
+        return None
+
+    def _note_load(self, source: str) -> None:
+        """Per-source load accounting hook at commit (under the lock).
+        Counted HERE and not at the staging lookup so a load that fails
+        admission (overload, device_put error) never drifts the ledger:
+        ``loads == disk_loads + repromotions`` must hold exactly."""
+
+    def _stage_host(self, scene_id: str, host: SceneData, nbytes: int) -> None:
+        """Write-through hook at commit (called under the lock)."""
+
+    def _invalidate_staged(self, scene_id: str) -> None:
+        """Drop a staged host copy (called under the lock) — a published
+        version swap makes the old staged arrays stale."""
+
+    def _retire(self, scene_id: str, resident: _Resident) -> str:
+        """The victim just left the resident dict (under the lock);
+        subclasses may keep its host arrays staged instead of dropping.
+        Returns the ``scene_evict`` reason."""
+        return "budget"
+
+    def _tier_fields(self) -> dict:
+        """Extra occupancy fields for scene_load/scene_evict rows.
+        Called under the (non-reentrant) lock — do not re-acquire."""
+        return {}
 
     # -- per-scene pose caches ------------------------------------------------
 

@@ -92,10 +92,13 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
     # -- serving rows (nerf_replication_tpu/serve) ---------------------------
     # one per completed (or timed-out) render request: end-to-end latency,
     # the degradation tier it was served at, and whether the pose cache hit
+    # tenant: which QoS tenant the request billed against (fleet/qos.py;
+    # absent on tenant-less requests)
     "serve_request": (
         {"latency_s": _NUM, "n_rays": _NUM, "tier": (str,)},
         {"queue_s": _NUM, "status": (str,), "cache_hit": (bool, int),
-         "n_buckets": _NUM, "bucket_rays": _NUM, "scene": (str,)},
+         "n_buckets": _NUM, "bucket_rays": _NUM, "scene": (str,),
+         "tenant": (str,)},
     ),
     # one per coalesced engine dispatch: how many requests/rays rode the
     # batch and how full the padded buckets were (occupancy = real/padded).
@@ -104,27 +107,53 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
     "serve_batch": (
         {"n_requests": _NUM, "n_rays": _NUM, "occupancy": _NUM},
         {"tier": (str,), "render_s": _NUM, "queue_depth": _NUM,
-         "bucket_rays": _NUM, "scene": (str,)},
+         "bucket_rays": _NUM, "scene": (str,), "tenant": (str,)},
     ),
     # -- fleet rows (nerf_replication_tpu/fleet, docs/fleet.md) --------------
     # one per scene materialization onto the device: how it arrived
-    # (source: cold = a request blocked on the load, prefetch = the
-    # background thread had it ready), the REAL byte footprint charged
-    # against fleet.hbm_budget_mb, and the residency set after commit
+    # (source: cold = a request blocked on the disk load, prefetch = the
+    # background thread had it ready, staging = re-promoted from the
+    # host-RAM tier — a device_put, no disk walk, publish = a hot-update
+    # swap), the REAL byte footprint charged against fleet.hbm_budget_mb,
+    # and the residency set after commit. staging/staging_bytes: host-RAM
+    # tier occupancy after commit (tiered ladder only, fleet/ladder.py)
     "scene_load": (
         {"scene": (str,), "bytes": _NUM, "source": (str,)},
-        {"load_s": _NUM, "resident": _NUM, "resident_bytes": _NUM},
+        {"load_s": _NUM, "resident": _NUM, "resident_bytes": _NUM,
+         "staging": _NUM, "staging_bytes": _NUM},
     ),
-    # one per budget eviction: the LRU unpinned scene dropped to admit a
-    # new one (reason is "budget" today; kept open for TTL/manual evicts)
+    # one per eviction at either residency tier. reason: budget (one-level
+    # manager, drop to admit), demoted (HBM -> host-RAM staging, the
+    # arrays survive), lru (dropped with no staged copy / staging LRU),
+    # ttl (staged copy expired), manual (operator evict). tier: which
+    # tier lost the scene (hbm | staging; absent = hbm, pre-ladder rows)
     "scene_evict": (
         {"scene": (str,), "bytes": _NUM},
-        {"reason": (str,), "resident": _NUM, "resident_bytes": _NUM},
+        {"reason": (str,), "resident": _NUM, "resident_bytes": _NUM,
+         "tier": (str,), "staging": _NUM, "staging_bytes": _NUM},
     ),
-    # one per load-shed decision: the backlog that triggered a degraded tier
+    # one per load-shed decision: the backlog that triggered a degraded
+    # tier (tenant: the per-tenant breaker forced the degrade, fleet/qos.py)
     "serve_shed": (
         {"tier": (str,), "queue_depth": _NUM},
-        {"n_requests": _NUM, "n_rays": _NUM},
+        {"n_requests": _NUM, "n_rays": _NUM, "tenant": (str,)},
+    ),
+    # -- QoS rows (nerf_replication_tpu/fleet/qos.py) ------------------------
+    # one per admission decision at the tenant token bucket: admit (tokens
+    # remained) or deny (quota exhausted -> TenantQuotaError, HTTP 429).
+    # quota_remaining is the bucket level AFTER the decision.
+    "tenant_admit": (
+        {"tenant": (str,), "decision": (str,)},
+        {"quota_remaining": _NUM, "rate": _NUM, "burst": _NUM,
+         "retry_after_s": _NUM},
+    ),
+    # one per scene hot-update attempt (fleet/publish.py): version N ->
+    # N+1 swap with pinned-lease drain. status: ok | torn (checksum fail,
+    # version N kept serving) | error. drain_ms: how long in-flight
+    # leases on N held the swap.
+    "scene_publish": (
+        {"scene": (str,), "from_version": _NUM, "to_version": _NUM},
+        {"drain_ms": _NUM, "bytes": _NUM, "status": (str,)},
     ),
     # -- traversal (renderer/packed_march.py hierarchical coarse-DDA) --------
     # one per eval image (or bench arm): rows entering the global sort vs
@@ -183,9 +212,9 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
          "start_s": _NUM, "dur_s": _NUM},
         {"parent_id": (str, type(None)), "thread": (str,), "stage": (str,),
          "tier": (str,), "scene": (str, type(None)), "status": (str,),
-         "n_rays": _NUM, "n_requests": _NUM, "joined": (str,),
-         "source": (str,), "family": (str,), "bucket": _NUM,
-         "queue_depth": _NUM, "detail": (str,)},
+         "tenant": (str, type(None)), "n_rays": _NUM, "n_requests": _NUM,
+         "joined": (str,), "source": (str,), "family": (str,),
+         "bucket": _NUM, "queue_depth": _NUM, "detail": (str,)},
     ),
     # one per live-aggregation dump (obs/metrics.py snapshot()): the
     # counters/gauges/histograms behind GET /metrics, serialized for
@@ -296,6 +325,14 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # any earlier discriminator key (bench_family is first-match), hence
     # sampling_mode rather than reusing arm/metric.
     "sampling_mode": ("fine_evals_per_ray", "rays_per_s", "psnr"),
+    # scripts/serve_bench.py --tenants rows (BENCH_QOS.jsonl): one row per
+    # multi-tenant open-loop run — the quiet tenant's p95 while a hot
+    # tenant runs saturated under weighted fair batching, against its
+    # solo-run p95, plus the residency-ladder re-promotion vs cold-load
+    # split. NOTE: must not carry any earlier discriminator key
+    # (bench_family is first-match), hence qos_mode and the qos-specific
+    # field names.
+    "qos_mode": ("tenants", "hot_share", "quiet_p95_ms", "quiet_solo_p95_ms"),
 }
 
 

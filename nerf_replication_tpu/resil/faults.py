@@ -57,6 +57,7 @@ FAULT_POINTS: tuple[str, ...] = (
     "serve.flush",              # serve/batcher.py: worker batch flush
     "train.loss",               # train loop's fetched loss scalar (nan_loss)
     "fleet.load",               # fleet/residency.py: before a scene load
+    "fleet.publish",            # fleet/publish.py: before a hot-update gate
 )
 
 FAULT_KINDS: tuple[str, ...] = (

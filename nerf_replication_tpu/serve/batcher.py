@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..fleet.errors import SceneError
+from ..fleet.qos import TenantQuotaError
 from ..obs import get_emitter
 from ..obs.metrics import get_metrics
 from ..obs.trace import current_ctx, get_tracer
@@ -87,6 +88,7 @@ class _Pending:
     future: ServeFuture
     t_enqueued: float
     scene: str | None = None
+    tenant: str | None = None
     # trace context captured on the submitting (HTTP) thread — the queue
     # entry is how a request's identity crosses into the worker thread.
     # t_trace is the enqueue time on the TRACER's clock (the batcher's
@@ -105,7 +107,7 @@ class MicroBatcher:
 
     def __init__(self, engine, policy: DegradationPolicy | None = None,
                  clock=time.monotonic, start: bool = True,
-                 breaker: CircuitBreaker | None = None):
+                 breaker: CircuitBreaker | None = None, qos=None):
         self.engine = engine
         self.options = engine.options
         self.policy = policy or DegradationPolicy(
@@ -113,6 +115,14 @@ class MicroBatcher:
         )
         self.clock = clock
         self.breaker = breaker or CircuitBreaker(clock=clock)
+        # per-tenant QoS (fleet/qos.py QosController, duck-typed): when
+        # attached, submissions meter through tenant token buckets and
+        # batch cuts drain tenant queues by weight (None = FIFO classic)
+        self.qos = qos
+        # weighted-fair virtual time per tenant ("" = tenant-less): a
+        # popped request advances its tenant by rays/weight, so assembly
+        # order is start-time fair regardless of arrival order
+        self._vtime: dict[str, float] = {}
         self._queue: deque[_Pending] = deque()
         self._cond = threading.Condition()
         self._stop = False
@@ -123,6 +133,7 @@ class MicroBatcher:
         self.n_completed = 0
         self.n_dispatch_errors = 0
         self.n_scene_errors = 0
+        self.n_quota_denied = 0
         self.worker_restarts = 0
         self._inflight: list[_Pending] = []
         self._worker_dead = False
@@ -187,7 +198,8 @@ class MicroBatcher:
 
     # -- submission -----------------------------------------------------------
 
-    def submit(self, rays, near, far, scene: str | None = None) -> ServeFuture:
+    def submit(self, rays, near, far, scene: str | None = None,
+               tenant: str | None = None) -> ServeFuture:
         """Enqueue a [N, C] ray request; returns a future.
 
         Bounds are validated HERE (BakedBoundsError raises to the caller
@@ -198,9 +210,26 @@ class MicroBatcher:
         batch is currently rendering. With the circuit breaker open,
         submission fast-fails with :class:`BreakerOpenError` (503 +
         Retry-After at the HTTP edge) instead of queueing work onto a
-        known-bad dispatch path."""
+        known-bad dispatch path.
+
+        With a QoS controller attached, ``tenant`` meters through that
+        tenant's token bucket first (TenantQuotaError -> 429) and its
+        scoped breaker (a tenant whose batches keep failing fast-fails
+        alone — the engine-level breaker stays closed for everyone
+        else)."""
+        tenant = None if tenant is None else str(tenant)
+        if self.qos is not None:
+            tb = self.qos.breaker(tenant)
+            if not tb.allow():
+                raise BreakerOpenError(tb.retry_after_s())
         if not self.breaker.allow():
             raise BreakerOpenError(self.breaker.retry_after_s())
+        if self.qos is not None:
+            try:
+                self.qos.admit(tenant)
+            except TenantQuotaError:     # 429 at the HTTP edge
+                self.n_quota_denied += 1
+                raise
         self.ensure_worker()
         check_baked_bounds(self.engine.near, self.engine.far, near, far,
                            surface="serve micro-batcher")
@@ -218,7 +247,8 @@ class MicroBatcher:
             )
         trs = get_tracer()
         pending = _Pending(rays, ServeFuture(rays.shape[0]), self.clock(),
-                           scene=scene, ctx=current_ctx(), t_trace=trs.now())
+                           scene=scene, tenant=tenant, ctx=current_ctx(),
+                           t_trace=trs.now())
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is closed")
@@ -277,6 +307,9 @@ class MicroBatcher:
                 if remaining <= 0:
                     break  # max-delay edge
                 self._cond.wait(timeout=remaining)
+            if self.qos is not None:
+                batch = self._fair_pop(max_rays)
+                return batch, len(self._queue)
             # pop whole head-scene requests up to the ray budget (always
             # >= 1, so an oversize single request still renders — the
             # engine splits it); other scenes and over-budget stragglers
@@ -298,6 +331,49 @@ class MicroBatcher:
             self._queue.clear()
             self._queue.extend(kept)
             return batch, len(self._queue)
+
+    def _fair_pop(self, max_rays: int) -> list[_Pending]:
+        """Weighted fair batch assembly (QoS mode; caller holds the lock).
+
+        The batch's scene is the one wanted by the *least-served*
+        backlogged tenant (lowest virtual time), NOT the queue head — a
+        flooding tenant's backlog cannot push a quiet tenant's requests
+        behind it. The ray budget then fills tenant-by-tenant in virtual-
+        time order (within a tenant: arrival order, same scene only), and
+        every popped request advances its tenant's clock by
+        ``rays / weight`` — a hot tenant saturates its weighted share of
+        batch capacity and no more while anyone else is waiting."""
+        by_tenant: dict[str, list[_Pending]] = {}
+        for p in self._queue:
+            by_tenant.setdefault(p.tenant or "", []).append(p)
+        # a tenant (re)joining the backlog starts at the active floor:
+        # idle time banks no credit, so a burst after silence still
+        # shares the batch fairly
+        floor = min((self._vtime[t] for t in by_tenant if t in self._vtime),
+                    default=0.0)
+        for t in by_tenant:
+            self._vtime[t] = max(self._vtime.get(t, floor), floor)
+        order = sorted(by_tenant, key=lambda t: self._vtime[t])
+        scene = by_tenant[order[0]][0].scene
+        batch: list[_Pending] = []
+        total = 0
+        for t in order:
+            weight = self.qos.weight(t or None)
+            for p in by_tenant[t]:
+                if p.scene != scene:
+                    continue
+                if batch and total + p.n_rays > max_rays:
+                    break
+                batch.append(p)
+                total += p.n_rays
+                self._vtime[t] += p.n_rays / weight
+            if total >= max_rays:
+                break
+        picked = set(map(id, batch))
+        kept = [p for p in self._queue if id(p) not in picked]
+        self._queue.clear()
+        self._queue.extend(kept)
+        return batch
 
     def pump(self) -> int:
         """Cut and render one batch synchronously (the test/manual-drive
@@ -359,13 +435,29 @@ class MicroBatcher:
         # request's root span even though this runs on the worker thread
         for p in live:
             trs.record("serve.queue", start_s=p.t_trace, end_s=t_cut,
-                       parent=p.ctx, stage="queue", n_rays=p.n_rays)
+                       parent=p.ctx, stage="queue", n_rays=p.n_rays,
+                       **({} if p.tenant is None else {"tenant": p.tenant}))
+
+        # tenant attribution: a fair-popped batch is usually single-tenant
+        # (vtime ordering groups a tenant's run); when it is, its breaker
+        # and telemetry rows carry the tenant so a bad tenant's failures
+        # stay scoped to it
+        tenants = {p.tenant for p in live}
+        batch_tenant = next(iter(tenants)) if len(tenants) == 1 else None
+        tenant_breaker = (self.qos.breaker(batch_tenant)
+                          if self.qos is not None and batch_tenant is not None
+                          else None)
+        tenant_fields = ({} if batch_tenant is None
+                         else {"tenant": batch_tenant})
 
         # failure degrades through the SAME ladder load does: consecutive
         # dispatch failures (pre-open breaker pressure) push the tier pick
-        # further down — cheaper executables, never a new compile
+        # further down — cheaper executables, never a new compile. A
+        # tenant whose own breaker is stressed degrades at least as far.
         tier = self.policy.tier_for(queue_depth)
         steps = self.breaker.degrade_steps()
+        if tenant_breaker is not None:
+            steps = max(steps, tenant_breaker.degrade_steps())
         if steps:
             i = TIER_NAMES.index(tier)
             tier = TIER_NAMES[min(i + steps, len(TIER_NAMES) - 1)]
@@ -377,6 +469,7 @@ class MicroBatcher:
                 "serve_shed", tier=tier, queue_depth=queue_depth,
                 n_requests=len(live),
                 n_rays=sum(p.n_rays for p in live),
+                **tenant_fields,
             )
             # graftlint: ok(emit-hot: batch-cadence counter bump, lock-cheap)
             mx.counter("serve_sheds_total", tier=tier)
@@ -440,6 +533,7 @@ class MicroBatcher:
                     latency_s=self.clock() - p.t_enqueued,
                     n_rays=p.n_rays, tier=tier, status="scene_error",
                     queue_s=t0 - p.t_enqueued, **scene_fields,
+                    **({} if p.tenant is None else {"tenant": p.tenant}),
                 )
                 # graftlint: ok(emit-hot: scene-failure path, not steady-state)
                 mx.counter("serve_requests_total", status="scene_error",
@@ -453,7 +547,15 @@ class MicroBatcher:
         except Exception as err:  # scatter the failure; don't kill the loop
             self.n_dispatch_errors += 1
             self._last_dispatch_t = self.clock()
-            self.breaker.record_failure()
+            # a single-tenant batch charges THAT tenant's breaker only —
+            # its floods of bad requests open its own circuit (429/503 for
+            # it alone) while the engine-level breaker stays closed for
+            # everyone else. Mixed/tenant-less batches charge the global
+            # breaker as before.
+            if tenant_breaker is not None:
+                tenant_breaker.record_failure()
+            else:
+                self.breaker.record_failure()
             detail = f"{type(err).__name__}: {err}"
             for p in live:
                 p.future.set_exception(err)
@@ -463,6 +565,7 @@ class MicroBatcher:
                     latency_s=self.clock() - p.t_enqueued,
                     n_rays=p.n_rays, tier=tier, status="error",
                     queue_s=t0 - p.t_enqueued, **scene_fields,
+                    **({} if p.tenant is None else {"tenant": p.tenant}),
                 )
                 # graftlint: ok(emit-hot: dispatch-failure path, not steady-state)
                 mx.counter("serve_requests_total", status="error", tier=tier)
@@ -473,6 +576,8 @@ class MicroBatcher:
         render_s = self.clock() - t0
         self._last_dispatch_t = self.clock()
         self.breaker.record_success()
+        if tenant_breaker is not None:
+            tenant_breaker.record_success()
 
         self.n_batches += 1
         # graftlint: ok(emit-hot: one row per coalesced batch, post-sync)
@@ -486,6 +591,7 @@ class MicroBatcher:
             queue_depth=queue_depth,
             bucket_rays=int(info["bucket_rays"]),
             **scene_fields,
+            **tenant_fields,
         )
 
         t_done = self.clock()
@@ -510,13 +616,17 @@ class MicroBatcher:
                 status="ok",
                 queue_s=t0 - p.t_enqueued,
                 **scene_fields,
+                **({} if p.tenant is None else {"tenant": p.tenant}),
             )
             trs.record("serve.scatter", start_s=t_sc, parent=p.ctx,
                        stage="scatter", n_rays=p.n_rays, tier=tier)
+            t_labels = {} if p.tenant is None else {"tenant": p.tenant}
             # graftlint: ok(emit-hot: per-request counter+histogram, lock-cheap post-sync)
-            mx.counter("serve_requests_total", status="ok", tier=tier)
+            mx.counter("serve_requests_total", status="ok", tier=tier,
+                       **t_labels)
             # graftlint: ok(emit-hot: per-request counter+histogram, lock-cheap post-sync)
-            mx.observe("serve_request_latency_seconds", latency_s, tier=tier)
+            mx.observe("serve_request_latency_seconds", latency_s, tier=tier,
+                       **t_labels)
             p.future.set_result(sliced)
         # graftlint: ok(emit-hot: one gauge store per batch)
         mx.gauge("serve_queue_depth", queue_depth)
@@ -525,7 +635,7 @@ class MicroBatcher:
         return len(live)
 
     def stats(self) -> dict:
-        return {
+        out = {
             "queue_depth": self.queue_depth(),
             "n_batches": self.n_batches,
             "n_completed": self.n_completed,
@@ -533,9 +643,19 @@ class MicroBatcher:
             "n_timeouts": self.n_timeouts,
             "n_dispatch_errors": self.n_dispatch_errors,
             "n_scene_errors": self.n_scene_errors,
+            "n_quota_denied": self.n_quota_denied,
             "worker_restarts": self.worker_restarts,
             "breaker": self.breaker.snapshot(),
         }
+        if self.qos is not None:
+            with self._cond:
+                depths: dict[str, int] = {}
+                for p in self._queue:
+                    key = p.tenant or ""
+                    depths[key] = depths.get(key, 0) + 1
+            out["tenant_queue_depth"] = depths
+            out["qos"] = self.qos.stats()
+        return out
 
     def last_dispatch_age_s(self) -> float | None:
         """Seconds since the last dispatch attempt (None before the

@@ -18,6 +18,8 @@ command line, not a war story.
         --fault fleet.load:io_error:2
     python scripts/chaos_run.py serve --scenes 3 \
         --fault fleet.load:truncate:3:1
+    python scripts/chaos_run.py serve --scenes 3 --tenants 3 \
+        --fault fleet.load:truncate:3:1
 
 ``--scenes N`` puts the serve mode behind a multi-scene fleet
 (nerf_replication_tpu/fleet) with an HBM budget of about half the
@@ -26,6 +28,15 @@ on the ``fleet.load`` point: an injected ``io_error`` must be absorbed
 by the retry ladder, while a ``truncate`` (torn checkpoint, caught by
 the tree checksum) must fail ONLY that scene's requests — every other
 scene keeps serving and the run still counts as recovered.
+
+``--tenants N`` adds the QoS control plane (fleet/qos.py): one ``hot``
+tenant floods a deliberately tiny token bucket while ``N-1`` quiet
+tenants trickle under a generous one. Recovery then ALSO requires the
+blast radius to hold: the hot tenant must actually be throttled (429s +
+a ``flight_tenant_throttled.json`` naming it), every quiet tenant must
+keep getting full-tier responses (zero quiet sheds, zero quiet denies),
+and — combined with a torn-scene fault — the scene-error flight dump
+must name the injected fault next to the throttle dump.
 
 Fault spec grammar: ``point:kind[:after[:times]]`` — inject ``kind`` at
 ``point`` after letting ``after`` hits through, on up to ``times`` hits
@@ -250,7 +261,30 @@ def run_serve(args, plan) -> dict:
     grid[4:12, 4:12, 4:12] = True
     engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
                           grid=grid, bbox=bbox)
-    batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg))
+
+    # multi-tenant mode: a 'hot' tenant on a deliberately starved bucket
+    # next to quiet tenants on a generous one — the flood must be
+    # absorbed at ADMISSION (429 + throttle dump), never as queue
+    # pressure that sheds the quiet tenants' batches
+    from nerf_replication_tpu.fleet.qos import (
+        QosController,
+        TenantPolicy,
+        TenantQuotaError,
+    )
+
+    qos = quiet_ids = None
+    if args.tenants > 0:
+        quiet_ids = [f"quiet{i:02d}" for i in range(max(1, args.tenants - 1))]
+        qos = QosController(
+            # hot's bucket is starved enough that ANY realistic loop
+            # cadence floods it (the loop submits hot 3x per cycle)
+            [TenantPolicy("hot", rate=2.0, burst=2.0)]
+            + [TenantPolicy(q, rate=2000.0, burst=256.0)
+               for q in quiet_ids],
+            dump_after_denies=4,
+        )
+    batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg),
+                           qos=qos)
 
     from nerf_replication_tpu.fleet import SceneError
 
@@ -262,6 +296,9 @@ def run_serve(args, plan) -> dict:
     steady_base = engine.tracker.total_compiles()
     ok = rejected = failed = scene_failed = 0
     ok_by_scene: dict = {}
+    ok_by_tenant: dict = {}
+    denied_by_tenant: dict = {}
+    quiet_shed = quiet_denied = 0
     t0 = time.perf_counter()
     with injecting(plan):
         for i in range(args.requests):
@@ -274,16 +311,32 @@ def run_serve(args, plan) -> dict:
             # churn under fault, not one scene absorbing every hit
             scene = scene_ids[(i // 4) % len(scene_ids)] if scene_ids \
                 else None
+            # 3:1 hot:quiet mix — the hot tenant submits at loop speed
+            # (far past its starved quota) while quiet tenants rotate
+            tenant = None
+            if qos is not None:
+                tenant = ("hot" if i % 4 != 3
+                          else quiet_ids[(i // 4) % len(quiet_ids)])
             try:
-                batcher.submit(rays, NEAR, FAR, scene=scene).result(
-                    timeout=30.0
-                )
+                out = batcher.submit(rays, NEAR, FAR, scene=scene,
+                                     tenant=tenant).result(timeout=30.0)
                 ok += 1
                 if scene is not None:
                     ok_by_scene[scene] = ok_by_scene.get(scene, 0) + 1
+                if tenant is not None:
+                    ok_by_tenant[tenant] = ok_by_tenant.get(tenant, 0) + 1
+                    if tenant != "hot" and out.get("tier") != "full":
+                        quiet_shed += 1
             except BreakerOpenError:
                 rejected += 1
                 time.sleep(0.05)
+            except TenantQuotaError as err:
+                # admission-level throttle (the typed 429): scoped to the
+                # offending tenant, never queue pressure for the others
+                denied_by_tenant[err.tenant] = \
+                    denied_by_tenant.get(err.tenant, 0) + 1
+                if err.tenant != "hot":
+                    quiet_denied += 1
             except SceneError:
                 # scene-scoped failure (torn/unloadable): 503 for THAT
                 # scene only — the stream itself keeps flowing
@@ -324,6 +377,18 @@ def run_serve(args, plan) -> dict:
             "load_errors": stats["load_errors"],
             "overloads": stats["overloads"],
         }
+    if qos is not None:
+        quiet_served = sum(1 for q in quiet_ids
+                           if ok_by_tenant.get(q, 0) > 0)
+        out["qos"] = {
+            "ok_by_tenant": ok_by_tenant,
+            "denied_by_tenant": denied_by_tenant,
+            "hot_denied": denied_by_tenant.get("hot", 0),
+            "quiet_denied": quiet_denied,
+            "quiet_shed": quiet_shed,
+            "quiet_tenants": len(quiet_ids),
+            "quiet_tenants_served": quiet_served,
+        }
     out["flight_dumps"] = _scan_flight_dumps(flight_dir)
     return out
 
@@ -348,6 +413,7 @@ def _scan_flight_dumps(flight_dir: str) -> dict:
             "valid": not errs,
             "errors": errs[:3],
             "reason": payload.get("reason"),
+            "detail": payload.get("detail"),
             "n_spans": len(payload.get("spans") or ()),
             "faults_named": sorted({
                 f"{e.get('point')}:{e.get('fault')}"
@@ -383,6 +449,27 @@ def check_flight(outcome: dict, summary: dict, plan) -> tuple[bool, list]:
         require("flight_breaker_open.json")
     if outcome.get("worker_restarts", 0) > 0:
         require("flight_watchdog_crash.json")
+    # a torn/unloadable scene 503s scene-scoped AND leaves a post-mortem
+    # whose event ring names the injected fault (the batcher's
+    # scene_error dump)
+    if outcome.get("n_scene_failed", 0) > 0 and injected:
+        require("flight_scene_error.json")
+    # multi-tenant: a throttled hot tenant must leave a dump NAMING the
+    # tenant — the operator's first question after a 429 storm
+    q = outcome.get("qos") or {}
+    if q.get("hot_denied", 0) > 0:
+        d = dumps.get("flight_tenant_throttled.json")
+        if d is None:
+            problems.append("flight_tenant_throttled.json missing")
+        elif not d.get("valid"):
+            problems.append(
+                f"flight_tenant_throttled.json invalid: {d.get('errors')}"
+            )
+        elif "tenant=hot" not in (d.get("detail") or ""):
+            problems.append(
+                "flight_tenant_throttled.json does not name the hot "
+                f"tenant (detail: {d.get('detail')!r})"
+            )
     return (not problems, problems)
 
 
@@ -435,6 +522,11 @@ def main(argv=None) -> int:
     p.add_argument("--scenes", type=int, default=0,
                    help="serve mode: N > 0 runs the stream over an "
                         "N-scene fleet (fleet.load fault coverage)")
+    p.add_argument("--tenants", type=int, default=0,
+                   help="serve mode: N > 0 adds QoS — one flooding "
+                        "'hot' tenant vs N-1 quiet ones; recovery "
+                        "requires the quiet tenants un-shed and the "
+                        "throttle dump naming the hot tenant")
     p.add_argument("--backend", default="cpu",
                    help="platform pin ('cpu', 'cpu:8'; '' = inherit)")
     p.add_argument("--workdir",
@@ -469,6 +561,7 @@ def main(argv=None) -> int:
     outcome["faults_injected_by_plan"] = plan.injected()
     summary = summarize_telemetry(outcome["telemetry"])
 
+    qos_out = outcome.get("qos") or {}
     recovered = bool(
         outcome["completed"]
         and summary["retries_exhausted"] == 0
@@ -476,6 +569,16 @@ def main(argv=None) -> int:
         # fleet mode: a torn scene may 503 scene-scoped, but the stream
         # only counts as recovered if other scenes actually kept serving
         and (args.scenes == 0 or outcome.get("scenes_still_serving", 0) > 0)
+        # tenant mode: the hot tenant must have been throttled, every
+        # quiet tenant must have kept serving, and none of them may have
+        # been shed or denied — the blast radius stayed on the offender
+        and (args.tenants == 0 or (
+            qos_out.get("hot_denied", 0) > 0
+            and qos_out.get("quiet_tenants_served", 0)
+            == qos_out.get("quiet_tenants", -1)
+            and qos_out.get("quiet_shed", 1) == 0
+            and qos_out.get("quiet_denied", 1) == 0
+        ))
     )
     flight_ok, flight_problems = check_flight(outcome, summary, plan)
     print(json.dumps({"outcome": outcome, "telemetry_summary": summary,

@@ -10,12 +10,16 @@ the run carries resil rows — injected/detected faults, retry-ladder
 outcomes, and circuit-breaker opens. Runs that traced (obs/trace.py span
 rows) additionally get a per-stage latency breakdown (queue → acquire →
 dispatch → device → scatter p50/p95) and the queue-wait share of the
-stage p95 total. ``--diff`` compares run A (baseline) against run B
+stage p95 total; fleet runs add a control-plane block — per-tenant
+admit/deny/shed mix, tier occupancy (HBM vs host-RAM staging), the
+demote-vs-cold reload split, and publish outcomes.
+``--diff`` compares run A (baseline) against run B
 (candidate) and flags regressions past ``--gate`` percent (step-time
-p50, peak memory, queue-wait p95 share) or any compile-count increase /
-PSNR drop > 0.1 dB / growth in unrecovered faults (exhausted retry
-ladders), breaker opens, or fine-MLP evals/ray (the learned-sampling
-budget); with ``--gate`` the exit code is nonzero when
+p50, peak memory, queue-wait p95 share, tenant deny rate, staging
+re-promotion share) or any compile-count increase / PSNR drop > 0.1 dB
+/ growth in unrecovered faults (exhausted retry ladders), breaker
+opens, cold scene loads, failed publishes, or fine-MLP evals/ray (the
+learned-sampling budget); with ``--gate`` the exit code is nonzero when
 a regression is flagged, so a bench battery can use it as its gate
 against a saved baseline run (e.g. the run behind ``BASELINE.json``).
 
@@ -215,6 +219,37 @@ def summarize(rows: list[dict]) -> dict:
             pre / (pre + cold) if (pre + cold) else None
         )
         summary["fleet_evictions"] = len(scene_evicts)
+        # tiered ladder (fleet/ladder.py): scene_load rows whose host
+        # arrays came from the staging tier vs a disk read, demotions vs
+        # full drops on the eviction side, and the last-seen occupancy of
+        # both tiers. The demote/cold split is the ladder's whole point:
+        # a working set that cycles through HBM should re-promote from
+        # host RAM (device_put only), not re-walk disk + checksums.
+        staged = sum(1 for r in scene_loads if r.get("source") == "staging")
+        demoted = sum(1 for r in scene_evicts
+                      if r.get("reason") == "demoted")
+        if staged or demoted or any("tier" in r for r in scene_evicts):
+            summary["fleet_staging_loads"] = staged
+            summary["fleet_demotions"] = demoted
+            summary["fleet_demote_vs_cold"] = (
+                staged / (staged + cold) if (staged + cold) else None
+            )
+            reasons: dict = {}
+            for r in scene_evicts:
+                k = r.get("reason", "budget")
+                reasons[k] = reasons.get(k, 0) + 1
+            summary["fleet_evict_reasons"] = reasons
+            last_tier = next(
+                (r for r in reversed(rows)
+                 if r.get("kind") in ("scene_load", "scene_evict")
+                 and r.get("staging") is not None),
+                None,
+            )
+            if last_tier is not None:
+                summary["fleet_tier_occupancy"] = {
+                    "hbm": last_tier.get("resident"),
+                    "staging": last_tier.get("staging"),
+                }
         summary["fleet_scenes"] = sorted(
             {r.get("scene") for r in scene_loads if r.get("scene")}
         )
@@ -271,6 +306,45 @@ def summarize(rows: list[dict]) -> dict:
         summary["sampling_n_proposal"] = last.get("n_proposal")
         summary["sampling_n_fine"] = last.get("n_fine")
         summary["sampling_last_psnr"] = psnrs[-1] if psnrs else None
+
+    # QoS rows (fleet/qos.py): per-tenant admission mix and the shed/
+    # error attribution — who was throttled, who was degraded, who trips
+    # their own breaker. Keys present only when the run metered tenants.
+    admits = [r for r in rows if r.get("kind") == "tenant_admit"]
+    if admits:
+        tenants: dict = {}
+        for r in admits:
+            t = tenants.setdefault(
+                r.get("tenant", "?"), {"admit": 0, "deny": 0, "shed": 0}
+            )
+            t["admit" if r.get("decision") == "admit" else "deny"] += 1
+        for r in rows:
+            if r.get("kind") == "serve_shed" and r.get("tenant"):
+                if r["tenant"] in tenants:
+                    tenants[r["tenant"]]["shed"] += 1
+        total_admit = sum(t["admit"] for t in tenants.values())
+        total_deny = sum(t["deny"] for t in tenants.values())
+        summary["qos_tenants"] = {k: tenants[k] for k in sorted(tenants)}
+        summary["qos_admits"] = total_admit
+        summary["qos_denies"] = total_deny
+        summary["qos_deny_rate"] = (
+            total_deny / (total_admit + total_deny)
+            if (total_admit + total_deny) else None
+        )
+
+    # hot-update rows (fleet/publish.py): publishes by status, drain tail
+    publishes = [r for r in rows if r.get("kind") == "scene_publish"]
+    if publishes:
+        by_status: dict = {}
+        for r in publishes:
+            k = r.get("status", "?")
+            by_status[k] = by_status.get(k, 0) + 1
+        drains = [float(r["drain_ms"]) for r in publishes
+                  if r.get("status") == "ok" and r.get("drain_ms") is not None]
+        summary["publishes"] = by_status
+        summary["publish_drain_p95_ms"] = (
+            _percentile(drains, 95) if drains else None
+        )
 
     # resilience rows (nerf_replication_tpu/resil): injected vs detected
     # faults, the retry ladder's outcomes, breaker transitions. An
@@ -424,6 +498,39 @@ def print_summary(summary: dict, label: str = "") -> None:
         print(f"    evictions:   {summary['fleet_evictions']}  "
               f"bytes loaded: {_fmt_bytes(summary['fleet_bytes_loaded'])}  "
               f"resident at end: {summary['fleet_resident_last']}")
+        if summary.get("fleet_staging_loads") is not None:
+            ratio = summary.get("fleet_demote_vs_cold")
+            reasons = " ".join(
+                f"{k}:{v}" for k, v in
+                sorted((summary.get("fleet_evict_reasons") or {}).items())
+            )
+            occ = summary.get("fleet_tier_occupancy") or {}
+            print(f"    ladder:      {summary['fleet_staging_loads']} "
+                  f"staging re-promotion(s) / "
+                  f"{summary['fleet_demotions']} demotion(s)"
+                  + (f"  ({ratio * 100:.0f}% warm)" if ratio is not None
+                     else "")
+                  + (f"  evict reasons: {reasons}" if reasons else ""))
+            if occ:
+                print(f"    tiers at end: hbm {occ.get('hbm')}  "
+                      f"staging {occ.get('staging')}")
+    if summary.get("qos_tenants"):
+        rate = summary.get("qos_deny_rate")
+        print(f"  qos:           {summary['qos_admits']} admitted / "
+              f"{summary['qos_denies']} denied"
+              + (f"  ({rate * 100:.1f}% deny rate)" if rate is not None
+                 else ""))
+        for name, t in summary["qos_tenants"].items():
+            print(f"    {name:<12} admit {t['admit']}  deny {t['deny']}  "
+                  f"shed {t['shed']}")
+    if summary.get("publishes"):
+        mix = " ".join(
+            f"{k}:{v}" for k, v in sorted(summary["publishes"].items())
+        )
+        drain = summary.get("publish_drain_p95_ms")
+        print(f"  publishes:     {mix}"
+              + (f"  drain p95 {drain:.1f} ms" if drain is not None
+                 else ""))
     if summary.get("march_rows"):
         eff = summary.get("march_sweep_efficiency")
         occ = summary.get("march_coarse_occ")
@@ -535,6 +642,34 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     if b is not None and b > a:
         flags.append(f"fleet cold scene loads grew {a} -> {b} "
                      f"(prefetch misses on the request path)")
+    # a candidate denying a larger share of tenant admissions is either
+    # under-provisioned quota or a fairness regression — both user-facing
+    # 429s that never reach the latency histograms
+    a = base.get("qos_deny_rate")
+    b = cand.get("qos_deny_rate")
+    if b is not None and b > (a or 0.0) + 0.02 and (
+            a is None or pct(a, b) > gate_pct):
+        flags.append(
+            f"tenant deny rate grew {(a or 0.0) * 100:.1f}% -> "
+            f"{b * 100:.1f}% of admission attempts"
+        )
+    # the ladder exists to turn evictions into demotions: a candidate
+    # re-promoting a SMALLER share of its reloads from staging is paying
+    # disk + checksum walks the baseline did not
+    a = base.get("fleet_demote_vs_cold")
+    b = cand.get("fleet_demote_vs_cold")
+    if a and b is not None and (a - b) > 0.02 and (a - b) / a * 100.0 > gate_pct:
+        flags.append(
+            f"staging re-promotion share dropped {a * 100:.1f}% -> "
+            f"{b * 100:.1f}% (ladder misses -> cold disk loads)"
+        )
+    a = (base.get("publishes") or {}).get("torn", 0) + (
+        base.get("publishes") or {}).get("error", 0)
+    b_pub = cand.get("publishes")
+    if b_pub is not None:
+        b = b_pub.get("torn", 0) + b_pub.get("error", 0)
+        if b > a:
+            flags.append(f"failed scene publishes grew {a} -> {b}")
     # queue-wait share of the stage p95 total growing means the candidate
     # spends more of its tail waiting in the batcher queue instead of
     # doing work — a scheduling regression even when end-to-end p95

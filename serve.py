@@ -16,7 +16,10 @@ API (all JSON):
   ``radius`` degrees, degrees, world units) OR a ``c2w`` 3x4/4x4 matrix;
   optional ``H``/``W``/``focal`` override the dataset camera; optional
   ``scene`` names a registry scene when the ``fleet:`` block is
-  configured (absent = the engine's own checkpoint, API-compatible).
+  configured (absent = the engine's own checkpoint, API-compatible);
+  optional ``tenant`` meters the request through that tenant's QoS token
+  bucket when ``fleet.qos.enabled`` (over-quota -> 429 + ``Retry-After``;
+  a tenant whose batches keep failing trips only its OWN breaker).
   Response: ``{h, w, tier, cache_hit, latency_ms, rgb_b64}`` with
   ``rgb_b64`` the base64 of the raw uint8 [h, w, 3] buffer.
 * ``GET /stats`` — engine + batcher + cache counters (compile inventory,
@@ -39,7 +42,8 @@ crash/breaker-open/SIGTERM dumps the recent-span ring to
 ``flight_<reason>.json`` (docs/observability.md).
 
 Errors are structured JSON, never stack traces (docs/robustness.md):
-bad pose / out-of-bounds request → 400, unknown scene → 404, batcher
+bad pose / out-of-bounds request → 400, unknown scene → 404, over-quota
+tenant → 429 with a ``Retry-After`` header, batcher
 timeout → 504, breaker open → 503 with a ``Retry-After`` header, a
 torn/unloadable/over-budget scene → 503 for THAT scene only (every other
 resident scene keeps serving), anything else → 500
@@ -87,13 +91,16 @@ def render_pose(engine, batcher, body: dict) -> dict:
     focal = float(body.get("focal", camera["focal"]))
     scene = body.get("scene")
     scene = None if scene is None else str(scene)
+    tenant = body.get("tenant")
+    tenant = None if tenant is None else str(tenant)
     c2w = _resolve_pose(body)
 
     timeout = engine.options.request_timeout_s + 30.0  # queue + render slack
     via = None
     if batcher is not None:
         via = lambda rays, near, far: (  # noqa: E731
-            batcher.submit(rays, near, far, scene=scene).result(timeout)
+            batcher.submit(rays, near, far, scene=scene,
+                           tenant=tenant).result(timeout)
         )
     t0 = time.perf_counter()
     image, info = engine.render_view(c2w, H, W, focal, via=via, scene=scene)
@@ -117,6 +124,7 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
     from nerf_replication_tpu.fleet import (
         ResidencyOverloadError,
         SceneError,
+        TenantQuotaError,
         UnknownSceneError,
     )
     from nerf_replication_tpu.obs import get_metrics, get_tracer
@@ -169,6 +177,7 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 scene = body.get("scene")
+                tenant = body.get("tenant")
                 # the REQUEST's root span: parent=None starts a fresh
                 # trace on this handler thread; the batcher submit below
                 # captures it into the queue entry, making every
@@ -177,9 +186,18 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                 with get_tracer().span(
                     "serve.request", parent=None,
                     scene=None if scene is None else str(scene),
+                    tenant=None if tenant is None else str(tenant),
                 ):
                     out = render_pose(engine, batcher, body)
                 return self._reply(200, out)
+            except TenantQuotaError as err:
+                # over-quota tenant: typed 429 with the bucket's own
+                # refill horizon — scoped to the tenant, not the server
+                return self._reply(
+                    429, {"error": str(err), "tenant": err.tenant,
+                          "retry_after_s": err.retry_after_s},
+                    headers={"Retry-After": str(max(1, round(err.retry_after_s)))},
+                )
             except BreakerOpenError as err:
                 return self._reply(
                     503, {"error": str(err),
@@ -257,8 +275,11 @@ def main(argv=None) -> int:
     # post-mortem AND closes its telemetry)
     guard = PreemptionGuard.install()
 
+    from nerf_replication_tpu.fleet.qos import QosController
+
     engine = engine_from_cfg(cfg, cfg_file=args.cfg_file)
-    batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg))
+    batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg),
+                           qos=QosController.from_cfg(cfg))
     server = make_server(engine, batcher, host=args.host, port=args.port,
                          slo_target_ms=slo_target_ms)
     print(

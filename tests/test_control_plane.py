@@ -1,0 +1,810 @@
+"""Fleet control plane (PR: store/ladder/qos/publish): the sharded
+SceneStore pages manifest shards lazily under an LRU cap and promotes
+atomically (index last); the tiered residency ladder demotes HBM
+evictions to host-RAM staging and re-promotes bitwise-identically with
+typed eviction reasons and TTL sweeps; per-tenant QoS meters admission
+through token buckets (typed 429), cuts weighted-fair batches, and
+scopes breaker blast radius to the offending tenant; scene hot-update
+publishes version N+1 atomically behind a pinned-lease drain barrier
+while a torn N+1 leaves N serving. A threaded stress test races
+prefetch vs demotion vs acquire, and a compile-tracked matrix pins zero
+steady-state recompiles across scene switch, demote+re-promote,
+throttle, and hot-swap. All CPU, tiny fake network — no real training."""
+
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from test_fleet import _CFG_OPTS, _rays, _torn_checkpoint_dir
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.fleet import (
+    QosController,
+    ResidencyOverloadError,
+    SceneData,
+    SceneLoadError,
+    ScenePublishError,
+    ScenePublisher,
+    SceneRecord,
+    SceneRegistry,
+    SceneStore,
+    TenantPolicy,
+    TenantQuotaError,
+    TieredResidencyManager,
+    UnknownSceneError,
+    write_sharded,
+)
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.obs import init_run, validate_row
+from nerf_replication_tpu.resil import BreakerOpenError
+from nerf_replication_tpu.serve import MicroBatcher, RenderEngine
+
+NEAR, FAR = 2.0, 6.0
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_cp"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4, n_test=1)
+    cfg = tiny_cfg(root, _CFG_OPTS)
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox, warmup_families=("full",))
+    return cfg, network, params, grid, bbox, engine
+
+
+def _np_ladder(scene_ids=("a", "b", "c", "d"), budget_scenes=2.0,
+               staging_scenes=4.0, **kw):
+    """Engine-free tiered fleet over 4000-byte numpy params: byte
+    accounting, tier membership, and LRU order are exact."""
+    datas = {
+        sid: SceneData(scene_id=sid,
+                       params={"w": np.full((1000,), i, np.float32)})
+        for i, sid in enumerate(scene_ids)
+    }
+    registry = SceneRegistry(SceneRecord(scene_id=sid) for sid in scene_ids)
+    mgr = TieredResidencyManager(
+        registry, lambda rec: datas[rec.scene_id],
+        budget_bytes=int(4000 * budget_scenes),
+        staging_budget_bytes=int(4000 * staging_scenes),
+        verify_checksums=False, **kw,
+    )
+    return mgr, datas
+
+
+def _versioned_ladder(**kw):
+    """One scene whose loader manufactures arrays from the registry
+    record's ``epoch`` — publishing a bumped-epoch record IS the new
+    version, bitwise-distinguishable from the old."""
+    def loader(rec):
+        v = float(rec.epoch or 1)
+        return SceneData(scene_id=rec.scene_id,
+                         params={"w": np.full((1000,), v, np.float32)})
+
+    registry = SceneRegistry([SceneRecord("a", epoch=1)])
+    return TieredResidencyManager(
+        registry, loader, budget_bytes=1 << 20,
+        staging_budget_bytes=1 << 20,
+        **{"verify_checksums": False, **kw},
+    )
+
+
+def _tiered_fleet(params, grid, bbox, scene_ids=("a", "b", "c"),
+                  budget_scenes=2.5, staging_scenes=8.0, **kw):
+    """Tiered fleet over the real engine's params: scale per (scene,
+    epoch) so a hot-published version is bitwise-distinguishable."""
+    ids = list(scene_ids)
+
+    def loader(rec):
+        s = 1.0 + 0.01 * (ids.index(rec.scene_id) + 1)
+        s += 0.1 * float(rec.epoch or 0)
+        return SceneData(
+            scene_id=rec.scene_id,
+            params=jax.tree.map(
+                lambda a: np.asarray(a) * np.float32(s), params),
+            grid=grid, bbox=bbox, near=NEAR, far=FAR,
+        )
+
+    registry = SceneRegistry(SceneRecord(scene_id=s) for s in ids)
+    one = (sum(leaf.nbytes for leaf in jax.tree.leaves(params))
+           + grid.nbytes + bbox.nbytes)
+    return TieredResidencyManager(
+        registry, loader, budget_bytes=int(one * budget_scenes),
+        staging_budget_bytes=int(one * staging_scenes),
+        verify_checksums=False, **kw,
+    )
+
+
+# -- sharded scene store ------------------------------------------------------
+
+
+def _registry(n: int, prefix: str = "scene") -> SceneRegistry:
+    return SceneRegistry(
+        SceneRecord(f"{prefix}{i:03d}", checkpoint=f"/ckpts/{prefix}{i:03d}")
+        for i in range(n)
+    )
+
+
+def test_write_sharded_round_trip_and_lazy_page_in(tmp_path):
+    root = str(tmp_path / "store")
+    write_sharded(_registry(10), root, shard_size=4)
+    names = sorted(os.listdir(root))
+    assert names == ["index.json", "shard-0000.json", "shard-0001.json",
+                     "shard-0002.json"]
+    # every shard file IS a plain manifest: existing tools keep working
+    sub = SceneRegistry.from_manifest(os.path.join(root, "shard-0001.json"))
+    assert sub.ids() == [f"scene{i:03d}" for i in range(4, 8)]
+
+    store = SceneStore(root, max_loaded_shards=2)
+    assert len(store) == 10 and "scene007" in store
+    assert store.stats()["loaded_shards"] == 0  # index only: nothing paged
+    rec = store.get("scene005")
+    assert rec.checkpoint == "/ckpts/scene005"
+    assert store.stats()["page_ins"] == 1
+    store.get("scene006")  # same shard: no new parse
+    assert store.stats()["page_ins"] == 1
+    # touching all three shards overflows the 2-shard cap (LRU drop)
+    store.get("scene001")
+    store.get("scene009")
+    s = store.stats()
+    assert s["page_ins"] == 3 and s["loaded_shards"] == 2
+    assert s["shard_evictions"] == 1
+    # a dropped shard re-pages transparently on the next hit
+    assert store.get("scene005").scene_id == "scene005"
+    assert store.stats()["page_ins"] == 4
+    with pytest.raises(UnknownSceneError):
+        store.get("ghost")
+    assert store.ids() == [f"scene{i:03d}" for i in range(10)]
+
+
+def test_store_register_writes_through_its_shard(tmp_path):
+    root = str(tmp_path / "store")
+    write_sharded(_registry(6), root, shard_size=4)
+    store = SceneStore(root)
+    store.register(SceneRecord("scene001", checkpoint="/v2/scene001",
+                               epoch=2))
+    assert store.get("scene001").checkpoint == "/v2/scene001"
+    # write-through: a FRESH store (new process) sees the update, and the
+    # untouched neighbors in the rewritten shard survived verbatim
+    again = SceneStore(root)
+    assert again.get("scene001").epoch == 2
+    assert again.get("scene000").checkpoint == "/ckpts/scene000"
+    # a brand-new scene is queryable immediately (override until the next
+    # promotion) and survives a re-promotion into the sharded file set
+    store.register(SceneRecord("newscene", checkpoint="/v1/newscene"))
+    assert "newscene" in store and len(store) == 7
+    write_sharded(store.to_registry(), root, shard_size=4)
+    assert SceneStore(root).get("newscene").checkpoint == "/v1/newscene"
+
+
+def test_store_rejects_future_version_and_names_drift(tmp_path):
+    root = str(tmp_path / "store")
+    write_sharded(_registry(3), root, shard_size=4)
+    index = os.path.join(root, "index.json")
+    with open(index) as fh:
+        data = json.load(fh)
+    data["version"] = 99
+    with open(index, "w") as fh:
+        json.dump(data, fh)
+    with pytest.raises(ValueError, match="version"):
+        SceneStore(root)
+    # index/shard drift (hand-edited shard) is a loud typed error
+    data["version"] = 1
+    data["shards"][0]["scenes"].append("phantom")
+    with open(index, "w") as fh:
+        json.dump(data, fh)
+    store = SceneStore(root)
+    with pytest.raises(UnknownSceneError, match="phantom"):
+        store.get("phantom")
+
+
+def test_residency_manager_takes_a_store(tmp_path):
+    """The store quacks like a registry: the residency manager loads
+    through it without knowing the catalog is sharded."""
+    root = str(tmp_path / "store")
+    write_sharded(_registry(5), root, shard_size=2)
+    store = SceneStore(root, max_loaded_shards=1)
+    mgr = TieredResidencyManager(
+        store,
+        lambda rec: SceneData(scene_id=rec.scene_id,
+                              params={"w": np.zeros(8, np.float32)}),
+        budget_bytes=1 << 20, staging_budget_bytes=1 << 20,
+        verify_checksums=False,
+    )
+    with mgr.lease("scene003") as data:
+        assert data.scene_id == "scene003"
+    assert store.stats()["page_ins"] == 1
+    assert mgr.stats()["known_scenes"] == 5
+
+
+# -- tiered residency ladder --------------------------------------------------
+
+
+def test_demote_then_repromote_is_bitwise_and_skips_disk():
+    mgr, datas = _np_ladder(budget_scenes=2.0)
+    with mgr.lease("a"):
+        pass
+    with mgr.lease("b"):
+        pass
+    with mgr.lease("c"):  # budget: a demotes (staged copy survives)
+        pass
+    assert mgr.resident_ids() == ["b", "c"]
+    assert "a" in mgr.staged_ids()
+    s = mgr.stats()
+    assert s["demotions"] == 1 and s["disk_loads"] == 3
+
+    with mgr.lease("a") as data:  # re-promotion: staging, not disk
+        assert np.array_equal(np.asarray(data.params["w"]),
+                              datas["a"].params["w"])
+    s = mgr.stats()
+    assert s["repromotions"] == 1
+    assert s["disk_loads"] == 3  # the re-promotion never touched disk
+    assert s["loads"] == s["disk_loads"] + s["repromotions"]
+
+
+def test_staging_has_its_own_budget_and_lru():
+    mgr, _ = _np_ladder(budget_scenes=1.0, staging_scenes=2.0)
+    for sid in ("a", "b", "c"):  # each admit demotes the previous scene
+        with mgr.lease(sid):
+            pass
+    # staging holds 2 of the 3 staged copies: the oldest fell to its LRU
+    assert mgr.staged_ids() == ["b", "c"]
+    s = mgr.stats()
+    assert s["staging_evictions"] == 1
+    assert s["staging_bytes"] <= mgr.staging_budget_bytes
+    with mgr.lease("a"):  # its staged copy is gone: a true cold reload
+        pass
+    assert mgr.stats()["disk_loads"] == 4
+
+
+def test_ttl_sweep_demotes_idle_residents_and_drops_stale_staging():
+    mgr, _ = _np_ladder(budget_scenes=4.0, staging_scenes=4.0,
+                        resident_ttl_s=20.0)
+    with mgr.lease("a"):
+        pass
+    assert mgr.sweep(now=time.monotonic() + 5.0) == {"hbm": 0, "staging": 0}
+    out = mgr.sweep(now=time.monotonic() + 60.0)
+    assert out == {"hbm": 1, "staging": 0}
+    assert mgr.resident_ids() == []
+    assert mgr.staged_ids() == ["a"]  # TTL demotion keeps re-promotion cheap
+    with mgr.lease("a"):
+        pass
+    assert mgr.stats()["repromotions"] == 1
+
+    mgr2, _ = _np_ladder(budget_scenes=1.0, staging_ttl_s=10.0)
+    with mgr2.lease("a"):
+        pass
+    with mgr2.lease("b"):  # demotes a into staging
+        pass
+    assert mgr2.sweep(now=time.monotonic() + 60.0)["staging"] >= 1
+    assert "a" not in mgr2.staged_ids()
+    assert mgr2.stats()["ttl_evictions"] >= 1
+
+
+def test_manual_evict_demotes_unless_pinned_or_dropped():
+    mgr, _ = _np_ladder(budget_scenes=4.0)
+    data = mgr.acquire("a")
+    assert data is not None
+    assert mgr.evict("a") is False  # pinned: nothing happens
+    assert mgr.resident_ids() == ["a"]
+    mgr.release("a")
+    assert mgr.evict("a") is True   # demotes; staged copy survives
+    assert mgr.resident_ids() == [] and mgr.staged_ids() == ["a"]
+    with mgr.lease("a"):
+        pass
+    assert mgr.stats()["repromotions"] == 1
+    assert mgr.evict("a", drop_staged=True) is True  # purge both tiers
+    assert mgr.staged_ids() == []
+    assert mgr.stats()["manual_evictions"] >= 2
+
+
+# -- per-tenant QoS -----------------------------------------------------------
+
+
+def test_token_bucket_admission_denies_with_retry_after():
+    t = [0.0]
+    qos = QosController([TenantPolicy("t", rate=10.0, burst=2.0)],
+                        clock=lambda: t[0])
+    assert qos.admit("t") == pytest.approx(1.0)
+    assert qos.admit("t") == pytest.approx(0.0)
+    with pytest.raises(TenantQuotaError) as exc:
+        qos.admit("t")
+    assert exc.value.tenant == "t"
+    assert exc.value.retry_after_s == pytest.approx(0.1)
+    t[0] += 0.1  # one token refilled
+    assert qos.admit("t") == pytest.approx(0.0)
+    stats = qos.stats()["tenants"]["t"]
+    assert stats["admits"] == 3 and stats["denies"] == 1
+    # unknown tenants auto-register under the default quota, isolated
+    assert qos.admit("stranger") >= 0.0
+    assert qos.weight("stranger") == 1.0
+
+
+def test_weighted_fair_pop_serves_least_served_tenant_first(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    qos = QosController([TenantPolicy("hog", weight=1.0, rate=1e6,
+                                      burst=1e6),
+                         TenantPolicy("mouse", weight=4.0, rate=1e6,
+                                      burst=1e6)])
+    batcher = MicroBatcher(engine, start=False, qos=qos)
+    # max_batch_rays=256 (_CFG_OPTS): 64-ray requests pack 4 per batch
+    hogs = [batcher.submit(_rays(64), NEAR, FAR, tenant="hog")
+            for _ in range(4)]
+    assert batcher.pump() == 4  # hog alone: fills the whole batch
+    mice = [batcher.submit(_rays(64), NEAR, FAR, tenant="mouse")
+            for _ in range(2)]
+    hogs += [batcher.submit(_rays(64), NEAR, FAR, tenant="hog")
+             for _ in range(4)]
+    # hog's virtual time is 256 rays deep; mouse joined at the floor and
+    # weighs 4x — the next cut takes BOTH mouse requests ahead of the
+    # hog backlog that arrived before them
+    assert batcher.pump() == 4
+    assert all(m.done() for m in mice)
+    assert sum(h.done() for h in hogs) == 6  # 4 from batch one + 2 fill
+    while batcher.queue_depth():
+        batcher.pump()
+    assert all(h.result(5.0)["tier"] == "full" for h in hogs)
+
+
+def test_quota_denial_is_typed_and_skips_the_queue(setup):
+    cfg, network, params, grid, bbox, engine = setup
+    qos = QosController([TenantPolicy("hog", rate=0.001, burst=1.0)])
+    batcher = MicroBatcher(engine, start=False, qos=qos)
+    ok = batcher.submit(_rays(32), NEAR, FAR, tenant="hog")
+    with pytest.raises(TenantQuotaError) as exc:
+        batcher.submit(_rays(32), NEAR, FAR, tenant="hog")
+    assert exc.value.retry_after_s > 0
+    assert batcher.n_quota_denied == 1
+    assert batcher.queue_depth() == 1  # the denied request never queued
+    assert batcher.pump() == 1
+    assert ok.result(5.0)["tier"] == "full"
+    st = batcher.stats()
+    assert st["n_quota_denied"] == 1
+    assert st["qos"]["tenants"]["hog"]["denies"] == 1
+    # quota pressure is NOT dispatch failure: every breaker stays closed
+    assert st["breaker"]["state"] == "closed"
+    assert qos.breaker("hog").snapshot()["state"] == "closed"
+
+
+def test_tenant_breaker_scopes_blast_radius(setup, monkeypatch):
+    cfg, network, params, grid, bbox, engine = setup
+    qos = QosController(breaker_threshold=2, breaker_cooldown_s=60.0)
+    batcher = MicroBatcher(engine, start=False, qos=qos)
+
+    real = engine.render_flat
+    boom = {"on": True}
+
+    def flaky(*args, **kw):
+        if boom["on"]:
+            raise RuntimeError("tenant-attributable dispatch failure")
+        return real(*args, **kw)
+
+    monkeypatch.setattr(engine, "render_flat", flaky)
+    for _ in range(2):  # two single-tenant batches from "bad" fail
+        f = batcher.submit(_rays(32), NEAR, FAR, tenant="bad")
+        batcher.pump()
+        with pytest.raises(RuntimeError):
+            f.result(5.0)
+    # the failures charged bad's OWN breaker to open...
+    assert qos.breaker("bad").snapshot()["state"] == "open"
+    with pytest.raises(BreakerOpenError):
+        batcher.submit(_rays(32), NEAR, FAR, tenant="bad")
+    # ...while the engine-level breaker — and other tenants — are fine
+    assert batcher.breaker.snapshot()["state"] == "closed"
+    assert batcher.n_dispatch_errors == 2
+    boom["on"] = False
+    f = batcher.submit(_rays(32), NEAR, FAR, tenant="good")
+    batcher.pump()
+    assert f.result(5.0)["tier"] == "full"
+
+
+# -- scene hot-update (publish) -----------------------------------------------
+
+
+def test_publish_swaps_version_and_invalidates_stale_staging():
+    mgr = _versioned_ladder()
+    pub = ScenePublisher(mgr)
+    with mgr.lease("a") as data:
+        assert float(np.asarray(data.params["w"])[0]) == 1.0
+    assert pub.version("a") == 1
+
+    row = pub.publish(SceneRecord("a", epoch=2))
+    assert row["status"] == "ok" and row["to_version"] == 2
+    assert pub.version("a") == 2
+    with mgr.lease("a") as data:
+        assert float(np.asarray(data.params["w"])[0]) == 2.0
+    # the staged host copy is N+1's too: a demote + re-promotion after a
+    # publish must NOT resurrect version N from staging
+    assert mgr.evict("a") is True
+    with mgr.lease("a") as data:
+        assert float(np.asarray(data.params["w"])[0]) == 2.0
+    assert mgr.stats()["repromotions"] == 1
+
+
+def test_torn_next_version_is_contained_and_n_keeps_serving(tmp_path):
+    mgr = _versioned_ladder(verify_checksums=True)
+    pub = ScenePublisher(mgr)
+    with mgr.lease("a"):
+        pass
+    torn = SceneRecord("a", checkpoint=_torn_checkpoint_dir(tmp_path),
+                       epoch=2)
+    with pytest.raises(SceneLoadError, match="torn"):
+        pub.publish(torn)
+    # version N is untouched: still resident, still serving, still v1
+    assert pub.version("a") == 1
+    assert pub.stats()["failed_publishes"] == 1
+    with mgr.lease("a") as data:
+        assert float(np.asarray(data.params["w"])[0]) == 1.0
+    # the registry still names N's artifacts: a reload stays v1
+    assert mgr.registry.get("a").epoch == 1
+
+
+def test_publish_drains_pinned_leases_and_parks_new_acquires():
+    mgr = _versioned_ladder()
+    pub = ScenePublisher(mgr, drain_timeout_s=30.0)
+    mgr.acquire("a")  # the in-flight batch's pin: the drain barrier
+
+    done = {}
+
+    def do_publish():
+        done["row"] = pub.publish(SceneRecord("a", epoch=2))
+
+    th = threading.Thread(target=do_publish)
+    th.start()
+    deadline = time.monotonic() + 5.0
+    while "a" not in mgr._publishing and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert "a" in mgr._publishing
+
+    parked = {}
+
+    def late_acquire():
+        with mgr.lease("a") as data:
+            parked["v"] = float(np.asarray(data.params["w"])[0])
+
+    th2 = threading.Thread(target=late_acquire)
+    th2.start()
+    time.sleep(0.2)
+    assert th.is_alive()  # still draining behind the pin
+    assert "v" not in parked  # the new acquire is parked, not racing
+    mgr.release("a")
+    th.join(timeout=10.0)
+    th2.join(timeout=10.0)
+    assert not th.is_alive() and not th2.is_alive()
+    assert done["row"]["status"] == "ok"
+    assert done["row"]["drain_ms"] > 100.0  # it genuinely waited
+    assert parked["v"] == 2.0  # the parked acquire woke into version N+1
+
+
+def test_publish_drain_timeout_aborts_and_refunds():
+    mgr = _versioned_ladder()
+    pub = ScenePublisher(mgr)
+    mgr.acquire("a")  # held past the timeout
+    with pytest.raises(ScenePublishError, match="drain"):
+        pub.publish(SceneRecord("a", epoch=2), drain_timeout_s=0.2)
+    assert pub.version("a") == 1
+    assert pub.stats()["failed_publishes"] == 1
+    mgr.release("a")
+    # the reservation was refunded: the next publish has budget headroom
+    # (and the aborted attempt never consumed a version number)
+    row = pub.publish(SceneRecord("a", epoch=3), drain_timeout_s=5.0)
+    assert row["status"] == "ok" and pub.version("a") == 2
+
+
+def test_concurrent_publish_is_rejected():
+    mgr = _versioned_ladder()
+    pub = ScenePublisher(mgr, drain_timeout_s=10.0)
+    mgr.acquire("a")
+    th = threading.Thread(
+        target=lambda: pub.publish(SceneRecord("a", epoch=2)))
+    th.start()
+    deadline = time.monotonic() + 5.0
+    while "a" not in mgr._publishing and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with pytest.raises(ScenePublishError, match="in flight"):
+        pub.publish(SceneRecord("a", epoch=9))
+    mgr.release("a")
+    th.join(timeout=10.0)
+    assert pub.version("a") == 2
+
+
+# -- concurrency stress -------------------------------------------------------
+
+
+def test_residency_stress_no_lost_pins_no_double_loads():
+    """8 threads race acquire/release against prefetch, manual demotion,
+    and TTL sweeps from a shared barrier. Afterwards: every pin was
+    released, the HBM budget held, every lease saw bitwise-correct
+    arrays, no race double-committed a load, and the loads ledger
+    balances exactly (loads == disk_loads + repromotions)."""
+    scene_ids = ("a", "b", "c", "d")
+    lock = threading.Lock()
+    loader_calls = {sid: 0 for sid in scene_ids}
+    datas = {
+        sid: SceneData(scene_id=sid,
+                       params={"w": np.full((1000,), i, np.float32)})
+        for i, sid in enumerate(scene_ids)
+    }
+
+    def loader(rec):
+        with lock:
+            loader_calls[rec.scene_id] += 1
+        return datas[rec.scene_id]
+
+    registry = SceneRegistry(SceneRecord(scene_id=s) for s in scene_ids)
+    mgr = TieredResidencyManager(
+        registry, loader, budget_bytes=int(4000 * 2.0),
+        staging_budget_bytes=int(4000 * 4.0), verify_checksums=False,
+    )
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+    problems: list[str] = []
+    overloads = [0]
+
+    def worker(seed: int):
+        rng = random.Random(seed)
+        barrier.wait()
+        for _ in range(40):
+            sid = rng.choice(scene_ids)
+            roll = rng.random()
+            try:
+                if roll < 0.60:
+                    with mgr.lease(sid) as data:
+                        if not np.array_equal(np.asarray(data.params["w"]),
+                                              datas[sid].params["w"]):
+                            problems.append(f"wrong bytes for {sid}")
+                elif roll < 0.80:
+                    mgr.prefetch(sid)
+                elif roll < 0.95:
+                    mgr.evict(sid)
+                else:
+                    mgr.sweep()
+            except ResidencyOverloadError:
+                # legal under max contention: every resident scene pinned
+                with lock:
+                    overloads[0] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not any(t.is_alive() for t in threads), "stress deadlocked"
+    for sid in scene_ids:
+        assert mgr.wait_loaded(sid, timeout=10.0)
+
+    assert problems == []
+    s = mgr.stats()
+    assert s["pinned"] == []                      # no lost pin
+    assert s["resident_bytes"] <= mgr.budget_bytes
+    assert s["staging_bytes"] <= mgr.staging_budget_bytes
+    # every committed load came from exactly one disk walk or one staged
+    # re-promotion; the loader ran at most once per disk walk plus one
+    # per admission overload (the loader runs before admission, so an
+    # all-pinned abort can waste one call — but never double-commit)
+    assert s["loads"] == s["disk_loads"] + s["repromotions"]
+    n_loader = sum(loader_calls.values())
+    assert s["disk_loads"] <= n_loader <= s["disk_loads"] + s["overloads"]
+    assert s["repromotions"] > 0  # the ladder actually exercised
+
+
+# -- zero-recompile matrix ----------------------------------------------------
+
+
+def test_zero_recompiles_across_switch_demote_throttle_and_hot_swap(setup):
+    """The PR's headline acceptance: scene switch under budget churn,
+    demote + re-promotion, tenant throttling, and a hot version swap are
+    ALL argument-value changes to the same prewarmed executables — the
+    CompileTracker total must not move."""
+    cfg, network, params, grid, bbox, engine = setup
+    mgr = _tiered_fleet(params, grid, bbox, budget_scenes=2.5)
+    engine.attach_fleet(mgr)
+    rays = _rays(128)
+    try:
+        before = engine.tracker.total_compiles()
+
+        # scene switches under a budget that demotes
+        outs = {}
+        for sid in ("a", "b", "c", "a"):
+            outs[sid] = engine.render_request(rays, NEAR, FAR, emit=False,
+                                              scene=sid)
+        assert mgr.stats()["demotions"] >= 1
+
+        # explicit demote -> re-promotion (staging path)
+        repromotions = mgr.stats()["repromotions"]
+        assert mgr.evict("a") is True
+        again = engine.render_request(rays, NEAR, FAR, emit=False,
+                                      scene="a")
+        assert mgr.stats()["repromotions"] > repromotions
+        assert np.array_equal(np.asarray(outs["a"]["rgb_map_f"]),
+                              np.asarray(again["rgb_map_f"]))
+
+        # tenant throttle + fair-cut render under QoS
+        qos = QosController([TenantPolicy("hog", rate=0.001, burst=1.0)])
+        batcher = MicroBatcher(engine, start=False, qos=qos)
+        f = batcher.submit(_rays(64), NEAR, FAR, scene="b", tenant="hog")
+        with pytest.raises(TenantQuotaError):
+            batcher.submit(_rays(64), NEAR, FAR, scene="b", tenant="hog")
+        calm = batcher.submit(_rays(64), NEAR, FAR, scene="b",
+                              tenant="calm")
+        while batcher.queue_depth():
+            batcher.pump()
+        assert f.result(5.0)["tier"] == "full"
+        assert calm.result(5.0)["tier"] == "full"
+
+        # hot swap scene b and render through the same executables
+        pub = ScenePublisher(mgr)
+        row = pub.publish(SceneRecord("b", epoch=1))
+        assert row["status"] == "ok"
+        swapped = engine.render_request(rays, NEAR, FAR, emit=False,
+                                        scene="b")
+        assert not np.array_equal(np.asarray(outs["b"]["rgb_map_f"]),
+                                  np.asarray(swapped["rgb_map_f"]))
+
+        assert engine.tracker.total_compiles() == before
+    finally:
+        engine.fleet = None
+        engine.default_scene = "default"
+
+
+# -- telemetry: rows, labels, report ------------------------------------------
+
+
+def test_control_plane_rows_validate_and_carry_tenants(setup, tmp_path):
+    cfg, network, params, grid, bbox, engine = setup
+    path = str(tmp_path / "telemetry.jsonl")
+    emitter = init_run(cfg, component="cp_test", path=path)
+    try:
+        # ladder churn: demoted + manual + ttl evictions, staging loads
+        mgr, _ = _np_ladder(budget_scenes=1.0, staging_ttl_s=5.0)
+        with mgr.lease("a"):
+            pass
+        with mgr.lease("b"):   # demotes a
+            pass
+        with mgr.lease("a"):   # staging re-promotion; demotes b
+            pass
+        mgr.evict("a")         # manual (tier hbm)
+        mgr.sweep(now=time.monotonic() + 60.0)  # ttl (tier staging)
+
+        # qos: one admit, one deny
+        qos = QosController([TenantPolicy("hog", rate=0.001, burst=1.0)])
+        qos.admit("hog")
+        with pytest.raises(TenantQuotaError):
+            qos.admit("hog")
+
+        # publish: ok and torn
+        vmgr = _versioned_ladder(verify_checksums=True)
+        pub = ScenePublisher(vmgr)
+        with vmgr.lease("a"):
+            pass
+        pub.publish(SceneRecord("a", epoch=2))
+        with pytest.raises(SceneLoadError):
+            pub.publish(SceneRecord(
+                "a", checkpoint=_torn_checkpoint_dir(tmp_path), epoch=3))
+
+        # tenant label rides the serve rows
+        batcher = MicroBatcher(engine, start=False, qos=QosController())
+        batcher.submit(_rays(32), NEAR, FAR, tenant="t9").n_rays
+        batcher.pump()
+    finally:
+        emitter.close()
+        init_run(cfg, component="noop",
+                 path=str(tmp_path / "t2.jsonl")).close()
+    rows = [json.loads(line) for line in open(path)]
+    for r in rows:
+        assert validate_row(r) == [], r
+
+    evicts = [r for r in rows if r["kind"] == "scene_evict"]
+    assert {r.get("reason") for r in evicts} >= {"demoted", "manual", "ttl"}
+    assert {r.get("tier") for r in evicts if "tier" in r} >= {"hbm",
+                                                              "staging"}
+    loads = [r for r in rows if r["kind"] == "scene_load"]
+    assert "staging" in {r["source"] for r in loads}
+    assert any("staging" in r and "staging_bytes" in r for r in loads)
+
+    admits = [r for r in rows if r["kind"] == "tenant_admit"]
+    assert {r["decision"] for r in admits} == {"admit", "deny"}
+    denied = [r for r in admits if r["decision"] == "deny"]
+    assert denied and all(r["retry_after_s"] > 0 for r in denied)
+
+    pubs = [r for r in rows if r["kind"] == "scene_publish"]
+    assert {r["status"] for r in pubs} == {"ok", "torn"}
+    ok_pub = [r for r in pubs if r["status"] == "ok"][0]
+    assert ok_pub["from_version"] == 1 and ok_pub["to_version"] == 2
+
+    served = [r for r in rows if r["kind"] == "serve_request"
+              and r.get("status") == "ok"]
+    assert any(r.get("tenant") == "t9" for r in served)
+    assert any(r["kind"] == "serve_batch" and r.get("tenant") == "t9"
+               for r in rows)
+
+
+def test_tlm_report_summarizes_and_gates_control_plane(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import tlm_report
+
+    from nerf_replication_tpu.obs.emit import Emitter
+
+    def write_run(path, *, denies, staged, cold, torn):
+        with Emitter(path, chief=True) as em:
+            em.emit("run_meta", run_id=em.run_id, component="serve",
+                    config_hash="x", process_index=0, process_count=1,
+                    device_count=1, local_device_count=1, platform="cpu")
+            for i in range(8):
+                em.emit("tenant_admit", tenant="hot", decision="admit",
+                        quota_remaining=1.0, rate=10.0, burst=5.0)
+            for i in range(denies):
+                em.emit("tenant_admit", tenant="hot", decision="deny",
+                        quota_remaining=0.0, rate=10.0, burst=5.0,
+                        retry_after_s=0.1)
+            em.emit("tenant_admit", tenant="quiet", decision="admit",
+                    quota_remaining=3.0, rate=100.0, burst=10.0)
+            em.emit("serve_shed", tier="half", queue_depth=9,
+                    n_requests=2, n_rays=128, tenant="hot")
+            for i in range(staged):
+                em.emit("scene_load", scene="s", bytes=1000,
+                        source="staging", resident=1, resident_bytes=1000,
+                        staging=1, staging_bytes=1000)
+            for i in range(cold):
+                em.emit("scene_load", scene="s", bytes=1000, source="cold",
+                        resident=1, resident_bytes=1000, staging=1,
+                        staging_bytes=1000)
+            em.emit("scene_evict", scene="s", bytes=1000, reason="demoted",
+                    tier="hbm", resident=0, resident_bytes=0, staging=1,
+                    staging_bytes=1000)
+            em.emit("scene_publish", scene="s", from_version=1,
+                    to_version=2, drain_ms=12.0, status="ok")
+            for i in range(torn):
+                em.emit("scene_publish", scene="s", from_version=2,
+                        to_version=3, drain_ms=0.0, status="torn")
+
+    base = str(tmp_path / "base.jsonl")
+    cand = str(tmp_path / "cand.jsonl")
+    write_run(base, denies=0, staged=8, cold=2, torn=0)
+    write_run(cand, denies=8, staged=1, cold=9, torn=2)
+
+    s = tlm_report.summarize(tlm_report.load_rows(base))
+    assert s["qos_tenants"]["hot"] == {"admit": 8, "deny": 0, "shed": 1}
+    assert s["qos_deny_rate"] == pytest.approx(0.0)
+    assert s["fleet_staging_loads"] == 8 and s["fleet_demotions"] == 1
+    assert s["fleet_demote_vs_cold"] == pytest.approx(0.8)
+    assert s["fleet_evict_reasons"] == {"demoted": 1}
+    # occupancy is the LAST observed tier gauge — the trailing demote row
+    assert s["fleet_tier_occupancy"] == {"hbm": 0, "staging": 1}
+    assert s["publishes"] == {"ok": 1}
+    assert s["publish_drain_p95_ms"] == pytest.approx(12.0)
+
+    s2 = tlm_report.summarize(tlm_report.load_rows(cand))
+    flags = tlm_report.diff(s, s2, gate_pct=10.0)
+    assert any("deny rate grew" in f for f in flags)
+    assert any("re-promotion share dropped" in f for f in flags)
+    assert any("failed scene publishes grew 0 -> 2" in f for f in flags)
+    assert tlm_report.diff(s, s, gate_pct=10.0) == []
+
+
+def test_qos_bench_rows_validate_as_bench_family():
+    from nerf_replication_tpu.obs.schema import validate_bench_row
+
+    row = {"qos_mode": "wfq", "tenants": 3, "hot_share": 0.75,
+           "quiet_p95_ms": 44.0, "quiet_solo_p95_ms": 42.0,
+           "repromote_speedup": 11.0}
+    assert validate_bench_row(row) == []
+    assert validate_bench_row({"qos_mode": "wfq"})  # missing fields

@@ -26,6 +26,7 @@ from .registry import (
     AOTRegistry,
     PrecompiledFn,
     abstract_like,
+    artifact_census,
     registry_from_cfg,
 )
 
@@ -33,6 +34,7 @@ __all__ = [
     "AOTRegistry",
     "PrecompiledFn",
     "abstract_like",
+    "artifact_census",
     "artifact_key",
     "artifact_path",
     "default_artifact_dir",

@@ -244,6 +244,24 @@ class AOTRegistry:
         return "compiled"
 
 
+def artifact_census(cache_dir: str | None = None) -> dict:
+    """Inventory of the shared artifact dir: what a FRESH replica would
+    warm from. ``scale/``'s replica spawn path reports this before boot
+    (n_artifacts > 0 predicts a ``warm_source == "disk"`` start) and
+    serve_bench asserts on it when proving the zero-build restart."""
+    cache_dir = cache_dir or default_artifact_dir()
+    if not os.path.isdir(cache_dir):
+        return {"dir": cache_dir, "n_artifacts": 0, "bytes": 0}
+    names = [n for n in sorted(os.listdir(cache_dir)) if n.endswith(".aot")]
+    total = 0
+    for n in names:
+        try:
+            total += os.path.getsize(os.path.join(cache_dir, n))
+        except OSError:
+            pass
+    return {"dir": cache_dir, "n_artifacts": len(names), "bytes": total}
+
+
 def registry_from_cfg(cfg, tracker=None) -> AOTRegistry | None:
     """The config-gated registry (``cfg.compile``): None when AOT is
     switched off, so call sites keep their lazy-jit behavior untouched."""

@@ -263,6 +263,31 @@ def default_cfg() -> ConfigNode:
         }
     )
 
+    # replica scale-out knobs (nerf_replication_tpu/scale, docs/scaleout.md):
+    # mesh-sharded dispatch over the data axis, the front-door router, and
+    # the supervisor's closed loop on SLO attainment / per-tenant deny rate
+    cfg.scale = ConfigNode(
+        {
+            "enabled": False,          # supervisor loop on/off
+            "min_replicas": 1,
+            "max_replicas": 4,
+            "out_below": 0.90,         # attainment below -> miss window
+            "in_above": 0.98,          # attainment at/above -> good window
+            "deny_above": 0.05,        # tenant deny rate above -> miss
+            "out_windows": 2,          # consecutive misses before scale-out
+            "in_windows": 5,           # consecutive goods before scale-in
+            "cooldown_out_s": 30.0,
+            "cooldown_in_s": 120.0,
+            "heartbeat_interval_s": 2.0,
+            "heartbeat_timeout_s": 10.0,  # missed beats before dead
+            "drain_timeout_s": 60.0,
+            # "off" = plain jit; "auto" = shard chunks over the data mesh
+            # when >1 device; "force" = mesh even on one device (the
+            # CPU parity-test configuration)
+            "mesh": "off",
+        }
+    )
+
     return cfg
 
 

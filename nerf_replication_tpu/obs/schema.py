@@ -223,6 +223,39 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"counters": (dict,), "gauges": (dict,), "histograms": (dict,)},
         {"slo": (dict,)},
     ),
+    # -- scale-out rows (nerf_replication_tpu/scale, docs/scaleout.md) -------
+    # one per replica lifecycle transition: spawn (supervisor asked for
+    # capacity), ready (warm-up done — warm_source/total_compiles record
+    # whether the shared artifact store made it a zero-build start),
+    # drain (no new admissions; queued work rendering out), retire
+    # (drain complete; detail carries the in-flight failure count, which
+    # the drain-before-retire contract holds at 0), dead (crash or
+    # missed heartbeats)
+    "replica": (
+        {"replica": (str,), "event": (str,)},
+        {"state": (str,), "load": _NUM, "warm_source": (str,),
+         "total_compiles": _NUM, "n_ready": _NUM, "scenes": (list,),
+         "detail": (str,)},
+    ),
+    # one per NON-routine router event (steady-state dispatches ride
+    # metrics counters, not rows): failover (a replica refused or died
+    # mid-submit; n_candidates = remaining options), dead (marked by the
+    # heartbeat sweep), drain (n_failed must be 0), no_replica (total
+    # outage — every candidate gone)
+    "router": (
+        {"event": (str,)},
+        {"replica": (str,), "scene": (str, type(None)),
+         "n_candidates": _NUM, "load": _NUM, "n_failed": _NUM,
+         "detail": (str,)},
+    ),
+    # one per supervisor evaluation window: the closed loop's reasoning
+    # (action: out | in | replace | hold) against the SLO attainment and
+    # tenant deny-rate signals, with the hysteresis streak that led to it
+    "scale_decision": (
+        {"action": (str,), "reason": (str,), "n_replicas": _NUM},
+        {"attainment": _OPT_NUM, "deny_rate": _NUM, "streak": _NUM,
+         "replica": (str,)},
+    ),
     # -- static analysis (nerf_replication_tpu/analysis) ---------------------
     # one per scripts/graftlint.py run: finding counts split new-vs-baseline
     # so the report can watch the baseline shrink (and flag a lint gate
@@ -333,6 +366,16 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # (bench_family is first-match), hence qos_mode and the qos-specific
     # field names.
     "qos_mode": ("tenants", "hot_share", "quiet_p95_ms", "quiet_solo_p95_ms"),
+    # scripts/serve_bench.py --replicas rows (BENCH_SCALE.jsonl): one row
+    # per multi-replica open-loop run through a full scale-out/scale-in
+    # cycle — attainment sagging under single-replica overload, the
+    # supervisor's spawn (the fresh replica's warm source and compile
+    # count record the shared-artifact warm start), recovery, and the
+    # drain-before-retire scale-in. NOTE: must not carry any earlier
+    # discriminator key (bench_family is first-match), hence scale_mode
+    # and the scale-specific field names.
+    "scale_mode": ("replicas_peak", "attainment_low",
+                   "attainment_recovered", "scale_outs", "scale_ins"),
 }
 
 

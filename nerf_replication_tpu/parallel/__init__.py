@@ -27,6 +27,7 @@ from .mesh import (  # noqa: F401
     multihost_init,
 )
 from .sharding import (  # noqa: F401
+    chunk_sharding,
     data_sharding,
     shard_bank,
     tree_shardings,

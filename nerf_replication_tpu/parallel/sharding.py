@@ -67,6 +67,16 @@ def data_sharding(mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def chunk_sharding(mesh) -> NamedSharding:
+    """Serve-path chunk sharding: ``[n_chunks, chunk, C]`` ray chunks
+    split whole-chunks-per-device over the data axis (scale/
+    mesh_dispatch.py). Same leading-axis spec as :func:`data_sharding`;
+    named separately because the serve path's divisibility contract
+    (``n_chunks %% mesh data size == 0``, validated at engine
+    construction) is its own invariant, not the bank-truncation one."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
 def shard_index_pool(pool, bank_n: int, mesh):
     """Shard a precrop index pool over the data axis as LOCAL indices.
 

@@ -1,0 +1,54 @@
+"""Horizontal scale-out for the serving stack (docs/scaleout.md).
+
+Three pieces turn the single-device, single-process server into the
+heavy-traffic shape the ROADMAP north star asks for:
+
+* **Mesh-sharded dispatch** (:mod:`.mesh_dispatch`) — the engine's
+  bucket×tier executables run under ``shard_map`` over the data-parallel
+  mesh (parallel/mesh.py), so one big micro-batch spans every chip.
+  Params/grid replicate; the padded ray chunks shard over the leading
+  chunk axis. Per-ray math is untouched, so the mesh render is
+  BITWISE-equal to the single-device path, and a size-1 mesh falls back
+  to plain ``jax.jit`` — CPU tier-1 covers everything.
+* **Replica runtime** (:mod:`.replica` + :mod:`.router`) — multi-process
+  replicas behind serve.py that warm-start from the shared ``.aot``
+  artifact store (a fresh replica serves in seconds with
+  ``warm_source == "disk"`` and zero compiles), registered via heartbeat
+  with a front-door :class:`Router` doing least-loaded dispatch with
+  scene-affinity and drain-before-retire.
+* **Supervisor** (:mod:`.supervisor`) — a closed loop that spawns and
+  retires replicas against SLO attainment and per-tenant deny rate,
+  with hysteresis, cooldowns, and min/max bounds from the ``scale:``
+  config block.
+"""
+
+from .mesh_dispatch import (
+    MeshDispatchError,
+    mesh_from_scale_cfg,
+    mesh_jit,
+    validate_mesh_buckets,
+)
+from .options import ScaleOptions
+from .replica import (
+    InProcessReplica,
+    ProcessReplica,
+    ReplicaState,
+    ReplicaUnavailableError,
+)
+from .router import NoReplicaAvailableError, Router
+from .supervisor import Supervisor
+
+__all__ = [
+    "InProcessReplica",
+    "MeshDispatchError",
+    "NoReplicaAvailableError",
+    "ProcessReplica",
+    "ReplicaState",
+    "ReplicaUnavailableError",
+    "Router",
+    "ScaleOptions",
+    "Supervisor",
+    "mesh_from_scale_cfg",
+    "mesh_jit",
+    "validate_mesh_buckets",
+]

@@ -1,0 +1,96 @@
+"""Mesh-sharded executable dispatch: one micro-batch spans the mesh.
+
+The engine's bucketed executables ``lax.map`` over ``[n_chunks, chunk,
+C]`` ray chunks. Data parallelism here shards the LEADING chunk axis
+over the mesh's ``data`` axis with ``shard_map``: each device runs the
+identical per-chunk program over its local slice of chunks while the
+params / occupancy grid / bbox replicate. No collective ever runs inside
+the render — every ray's math is the same op sequence on one device as
+on many — so the mesh render is **bitwise-equal** to the single-device
+path (tests/test_scale.py proves it on a forced size-1 mesh, the CPU
+tier-1 configuration).
+
+Had the sharding gone over the per-chunk ray axis instead, the packed
+march's cross-ray candidate sort would have turned into cross-device
+collectives; sharding whole chunks keeps the executable communication-
+free and the parity exact. The cost is a divisibility constraint:
+``bucket // chunk`` must divide by the mesh's data size
+(:func:`validate_mesh_buckets` rejects a config that would silently
+pad or gather at engine construction, not at request time).
+"""
+
+from __future__ import annotations
+
+
+class MeshDispatchError(ValueError):
+    """The serve bucket layout cannot shard over the configured mesh."""
+
+
+def validate_mesh_buckets(buckets, chunk: int, mesh) -> None:
+    """Reject bucket sets whose chunk counts don't divide over the mesh.
+
+    Called at engine construction (install time), so a bad
+    ``serve.buckets`` / ``scale.mesh`` combination fails loudly before
+    warm-up instead of as a mid-request reshard."""
+    from ..parallel.mesh import DATA_AXIS
+
+    n_dev = int(mesh.shape[DATA_AXIS])
+    bad = [int(b) for b in buckets if (int(b) // int(chunk)) % n_dev]
+    if bad:
+        raise MeshDispatchError(
+            f"buckets {bad} have chunk counts not divisible by the mesh "
+            f"data size {n_dev} (chunk={chunk}); adjust serve.buckets so "
+            f"every bucket holds a multiple of {n_dev} chunks"
+        )
+
+
+def mesh_jit(body, mesh, has_grid: bool):
+    """``jax.jit`` of ``body`` with its chunk axis sharded over ``mesh``.
+
+    ``body`` is the engine's UN-jitted executable body — signature
+    ``(params, chunks[, grid, bbox]) -> dict`` with every output leaf
+    carrying the ``n_chunks`` leading axis. Params/grid/bbox replicate
+    (``P()``); chunks and outputs shard over the data axis."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    rep, data = P(), P(DATA_AXIS)
+    in_specs = (rep, data) + ((rep, rep) if has_grid else ())
+    # check_rep off: the body is collective-free by construction (whole
+    # chunks shard; params replicate), and the replication checker costs
+    # trace time without adding safety here
+    mapped = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=data,
+                       check_rep=False)
+    # graftlint: ok(aot: the engine warm path registers every finalized executable with AOTRegistry)
+    return jax.jit(mapped)
+
+
+def mesh_from_scale_cfg(cfg):
+    """The serving mesh the ``scale:`` block asks for (None = off).
+
+    ``scale.mesh`` values: ``"off"`` keeps plain ``jax.jit``; ``"auto"``
+    builds the data-parallel mesh only when more than one device is
+    visible (so CPU tier-1 and single-chip serving keep the default
+    path); ``"force"`` builds it even on one device — the parity-test
+    and bring-up configuration."""
+    from .options import ScaleOptions
+
+    mode = ScaleOptions.from_cfg(cfg).mesh
+    if mode not in ("off", "auto", "force"):
+        raise MeshDispatchError(
+            f"scale.mesh must be off|auto|force, got {mode!r}"
+        )
+    if mode == "off":
+        return None
+    import jax
+
+    if mode == "auto" and len(jax.devices()) <= 1:
+        return None
+    from ..parallel.mesh import make_mesh
+
+    # data-parallel only: every device on the data axis (model_axis=1),
+    # matching the replicated-params partition rules the serve path uses
+    return make_mesh(data_axis=-1, model_axis=1)

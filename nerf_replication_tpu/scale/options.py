@@ -1,0 +1,63 @@
+"""The ``scale:`` config block, parsed once (config/config.py declares
+the defaults; docs/scaleout.md is the operator reference)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ScaleOptions:
+    """Supervisor policy + replica-runtime knobs.
+
+    The out/in thresholds are deliberately ASYMMETRIC (hysteresis): a
+    replica is added when attainment sags below ``out_below`` (or the
+    tenant deny rate climbs past ``deny_above``) for ``out_windows``
+    consecutive evaluations, but removed only after ``in_windows``
+    consecutive evaluations at or above the STRICTER ``in_above`` — so
+    attainment hovering between the two thresholds changes nothing, and
+    the fleet cannot flap. Cooldowns additionally space actions out so
+    one bad window after a spawn can't immediately trigger another.
+    """
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # SLO signal (obs/metrics.slo_view attainment in [0, 1], or the
+    # bench's windowed equivalent) + fleet/qos deny rate
+    out_below: float = 0.90      # attainment below this asks for a replica
+    in_above: float = 0.98       # attainment at/above this allows retire
+    deny_above: float = 0.05     # tenant deny rate above this asks for one
+    out_windows: int = 2         # consecutive bad windows before scale-out
+    in_windows: int = 5          # consecutive good windows before scale-in
+    cooldown_out_s: float = 30.0
+    cooldown_in_s: float = 120.0
+    # replica lifecycle
+    heartbeat_interval_s: float = 2.0
+    heartbeat_timeout_s: float = 10.0
+    drain_timeout_s: float = 60.0
+    # mesh-sharded dispatch: shard each executable's ray chunks over the
+    # data-parallel mesh. "auto" enables it when >1 device is visible;
+    # "force" builds the mesh path even on one device (the parity/test
+    # configuration); "off" keeps plain jax.jit.
+    mesh: str = "off"
+
+    @classmethod
+    def from_cfg(cls, cfg) -> "ScaleOptions":
+        s = cfg.get("scale", {})
+        return cls(
+            enabled=bool(s.get("enabled", False)),
+            min_replicas=max(1, int(s.get("min_replicas", 1))),
+            max_replicas=max(1, int(s.get("max_replicas", 4))),
+            out_below=float(s.get("out_below", 0.90)),
+            in_above=float(s.get("in_above", 0.98)),
+            deny_above=float(s.get("deny_above", 0.05)),
+            out_windows=max(1, int(s.get("out_windows", 2))),
+            in_windows=max(1, int(s.get("in_windows", 5))),
+            cooldown_out_s=float(s.get("cooldown_out_s", 30.0)),
+            cooldown_in_s=float(s.get("cooldown_in_s", 120.0)),
+            heartbeat_interval_s=float(s.get("heartbeat_interval_s", 2.0)),
+            heartbeat_timeout_s=float(s.get("heartbeat_timeout_s", 10.0)),
+            drain_timeout_s=float(s.get("drain_timeout_s", 60.0)),
+            mesh=str(s.get("mesh", "off")),
+        )

@@ -1,0 +1,298 @@
+"""Replica runtime: one serving stack (engine + micro-batcher) behind
+the front-door router.
+
+Two concrete shapes share one duck-typed surface (``replica_id``,
+``state``, ``submit``, ``load``, ``heartbeat``, ``drain``, ``kill``):
+
+* :class:`InProcessReplica` — an engine + MicroBatcher in this process.
+  What serve_bench's ``--replicas`` mode and chaos_run's kill-a-replica
+  scenario spawn: real executables, real warm-start economics (a fresh
+  replica built against the shared ``.aot`` artifact dir reports
+  ``warm_source == "disk"`` with zero compiles), without process
+  plumbing in the way of measurement.
+* :class:`ProcessReplica` — a ``serve.py`` child process reached over
+  HTTP. The production shape: heartbeats are ``GET /healthz`` (which
+  carries the replica block — warm source, compile count, resident
+  scenes), drain is ``POST /drain``.
+
+Lifecycle: ``starting -> ready -> draining -> retired``, with ``dead``
+reachable from anywhere (missed heartbeats or a crash). Draining stops
+NEW admissions at the router while everything already queued renders to
+completion — retirement never fails an in-flight request.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..obs import get_emitter
+from ..obs.metrics import get_metrics
+
+
+class ReplicaState:
+    STARTING = "starting"
+    READY = "ready"
+    DRAINING = "draining"
+    RETIRED = "retired"
+    DEAD = "dead"
+
+
+class ReplicaUnavailableError(RuntimeError):
+    """The replica cannot accept this request (draining/retired/dead);
+    the router fails over to another replica."""
+
+
+def _emit_lifecycle(replica_id: str, event: str, **fields) -> None:
+    get_emitter().emit("replica", replica=replica_id, event=event, **fields)
+    get_metrics().counter("scale_replica_events_total", event=event)
+
+
+class InProcessReplica:
+    """One engine + batcher wearing the replica surface."""
+
+    def __init__(self, replica_id: str, engine, batcher,
+                 clock=time.monotonic):
+        self.replica_id = str(replica_id)
+        self.engine = engine
+        self.batcher = batcher
+        self.clock = clock
+        self.state = ReplicaState.READY
+        self.n_submitted = 0
+        self.spawned_t = clock()
+        stats = engine.stats()
+        self.warm_source = stats.get("warm_source")
+        self.warm_compiles = int(stats.get("total_compiles", 0))
+        _emit_lifecycle(
+            self.replica_id, "ready",
+            state=self.state,
+            warm_source=self.warm_source or "",
+            total_compiles=self.warm_compiles,
+        )
+
+    # -- serving --------------------------------------------------------------
+
+    def accepting(self) -> bool:
+        return self.state == ReplicaState.READY
+
+    def submit(self, rays, near, far, scene=None, tenant=None):
+        """Enqueue on this replica's batcher (router-facing). Raises
+        :class:`ReplicaUnavailableError` when not accepting, so the
+        router's failover loop moves on without losing the request."""
+        if not self.accepting():
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id} is {self.state}"
+            )
+        self.n_submitted += 1
+        return self.batcher.submit(rays, near, far, scene=scene,
+                                   tenant=tenant)
+
+    def load(self) -> int:
+        """Routing load signal: requests queued and not yet completed."""
+        return self.batcher.queue_depth()
+
+    def resident_scenes(self) -> list[str]:
+        return self.engine.resident_scenes()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def heartbeat(self) -> dict:
+        """The registration payload the router sweeps (pull model: one
+        code path for in-process and HTTP replicas)."""
+        if self.state == ReplicaState.DEAD:
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id} is dead"
+            )
+        health = self.batcher.health()
+        return {
+            "replica": self.replica_id,
+            "state": self.state,
+            "ok": bool(health.get("ok")),
+            "load": self.load(),
+            "scenes": self.resident_scenes(),
+            "warm_source": self.warm_source,
+            "total_compiles": int(self.engine.tracker.total_compiles()),
+        }
+
+    def drain(self, timeout_s: float = 60.0) -> int:
+        """Render everything queued, then retire. Returns the number of
+        in-flight requests that FAILED during the drain — the
+        drain-before-retire contract wants exactly zero."""
+        if self.state in (ReplicaState.RETIRED, ReplicaState.DEAD):
+            return 0
+        self.state = ReplicaState.DRAINING
+        _emit_lifecycle(self.replica_id, "drain", state=self.state,
+                        load=self.load())
+        failures_before = (self.batcher.n_timeouts
+                          + self.batcher.n_dispatch_errors
+                          + self.batcher.n_scene_errors)
+        if self.batcher._started:
+            self.batcher.close(drain=True)
+        else:
+            # test/manual-drive batchers (start=False) drain synchronously
+            deadline = self.clock() + timeout_s
+            while self.batcher.queue_depth() and self.clock() < deadline:
+                self.batcher.pump()
+        failed = (self.batcher.n_timeouts
+                  + self.batcher.n_dispatch_errors
+                  + self.batcher.n_scene_errors) - failures_before
+        self.state = ReplicaState.RETIRED
+        _emit_lifecycle(self.replica_id, "retire", state=self.state,
+                        n_ready=0, detail=f"drain_failed={failed}")
+        return failed
+
+    def kill(self) -> None:
+        """Simulated process death (the chaos path): queued futures fail
+        immediately, heartbeats start raising."""
+        self.state = ReplicaState.DEAD
+        _emit_lifecycle(self.replica_id, "dead", state=self.state)
+        # close(drain=False) fails every queued future immediately —
+        # with no worker thread it just never joins one
+        self.batcher.close(drain=False)
+
+    def stats(self) -> dict:
+        return {
+            "replica": self.replica_id,
+            "state": self.state,
+            "n_submitted": self.n_submitted,
+            "warm_source": self.warm_source,
+            "warm_compiles": self.warm_compiles,
+            "total_compiles": int(self.engine.tracker.total_compiles()),
+            "batcher": self.batcher.stats(),
+        }
+
+
+class ProcessReplica:
+    """A ``serve.py`` child process behind the same replica surface.
+
+    Spawn-side only needs argv + environment: the child warms from the
+    SHARED artifact dir (``compile.dir``), so its start-to-serving time
+    is the BENCH_COLDSTART warm number, not a compile. ``submit`` is not
+    implemented at the ray level — HTTP replicas serve whole poses via
+    ``POST /render``; the router treats them as opaque capacity and
+    routes pose requests. Used by operators/scripts, not tier-1 (no
+    subprocess spawns in the test budget)."""
+
+    def __init__(self, replica_id: str, cfg_file: str, host: str,
+                 port: int, python: str = "python",
+                 clock=time.monotonic):
+        self.replica_id = str(replica_id)
+        self.cfg_file = cfg_file
+        self.host = host
+        self.port = int(port)
+        self.python = python
+        self.clock = clock
+        self.state = ReplicaState.STARTING
+        self.proc = None
+        self.n_submitted = 0
+
+    def argv(self) -> list[str]:
+        return [self.python, "serve.py", "--cfg_file", self.cfg_file,
+                "--host", self.host, "--port", str(self.port)]
+
+    def spawn(self, env=None) -> None:
+        import os
+        import subprocess
+
+        _emit_lifecycle(self.replica_id, "spawn", state=self.state)
+        self.proc = subprocess.Popen(
+            self.argv(), env={**os.environ, **(env or {}),
+                              "SCALE_REPLICA_ID": self.replica_id},
+        )
+
+    def _get(self, path: str, timeout: float = 2.0) -> dict:
+        import json
+        import urllib.request
+
+        url = f"http://{self.host}:{self.port}{path}"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    def accepting(self) -> bool:
+        return self.state == ReplicaState.READY
+
+    def load(self) -> int:
+        try:
+            return int(self._get("/healthz").get("queue_depth", 0))
+        # graftlint: ok(swallow: routing probe; unreachable -> sentinel load, sweep owns the dead-marking)
+        except Exception:
+            return 1 << 30  # unreachable sorts last for routing
+
+    def resident_scenes(self) -> list[str]:
+        try:
+            return list(self._get("/healthz")
+                        .get("replica", {}).get("scenes", []))
+        # graftlint: ok(swallow: affinity hint only; empty set just loses the routing preference)
+        except Exception:
+            return []
+
+    def heartbeat(self) -> dict:
+        if self.proc is not None and self.proc.poll() is not None:
+            self.state = ReplicaState.DEAD
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id} exited "
+                f"(code {self.proc.returncode})"
+            )
+        try:
+            health = self._get("/healthz")
+        except Exception as exc:
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id} unreachable: {exc}"
+            ) from exc
+        if self.state == ReplicaState.STARTING:
+            self.state = ReplicaState.READY
+            _emit_lifecycle(self.replica_id, "ready", state=self.state)
+        rep = health.get("replica", {})
+        return {
+            "replica": self.replica_id,
+            "state": self.state,
+            "ok": bool(health.get("ok")),
+            "load": int(health.get("queue_depth", 0)),
+            "scenes": list(rep.get("scenes", [])),
+            "warm_source": rep.get("warm_source"),
+            "total_compiles": int(rep.get("total_compiles", 0)),
+        }
+
+    def submit(self, rays, near, far, scene=None, tenant=None):
+        raise ReplicaUnavailableError(
+            "ProcessReplica serves poses over HTTP (POST /render); "
+            "ray-level submit is the in-process surface"
+        )
+
+    def drain(self, timeout_s: float = 60.0) -> int:
+        self.state = ReplicaState.DRAINING
+        _emit_lifecycle(self.replica_id, "drain", state=self.state)
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"http://{self.host}:{self.port}/drain", method="POST"
+            )
+            urllib.request.urlopen(req, timeout=timeout_s)
+        # graftlint: ok(swallow: best-effort drain request; the wait-loop below is the authority)
+        except Exception:
+            pass  # the wait-loop below is the authority
+        deadline = self.clock() + timeout_s
+        while self.clock() < deadline:
+            try:
+                if int(self._get("/healthz").get("queue_depth", 0)) == 0:
+                    break
+            # graftlint: ok(swallow: unreachable mid-drain means the queue is gone; terminate below)
+            except Exception:
+                break
+            time.sleep(0.2)
+        if self.proc is not None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10.0)
+            # graftlint: ok(swallow: terminate timed out; the kill() IS the handling)
+            except Exception:
+                self.proc.kill()
+        self.state = ReplicaState.RETIRED
+        _emit_lifecycle(self.replica_id, "retire", state=self.state)
+        return 0
+
+    def kill(self) -> None:
+        self.state = ReplicaState.DEAD
+        _emit_lifecycle(self.replica_id, "dead", state=self.state)
+        if self.proc is not None:
+            self.proc.kill()

@@ -1,0 +1,225 @@
+"""Front-door router: least-loaded dispatch with scene-affinity.
+
+The router owns the replica registry. Replicas register at spawn and are
+swept by PULL heartbeats (one code path for in-process and HTTP
+replicas): a beat that keeps failing past ``heartbeat_timeout_s`` marks
+the replica dead — transient hiccups inside the window don't, so a GC
+pause can't trigger a spurious replacement.
+
+Dispatch picks among accepting replicas by **scene-affinity first**
+(prefer a replica whose fleet ladder already holds the request's scene
+resident — routing there is an argument swap; routing elsewhere pays a
+disk load), **least-loaded second** (queue depth from the last beat),
+id-ordered for determinism. Failover is synchronous: a replica that
+refuses or dies mid-submit is excluded and the next candidate tried, so
+the caller sees one submit, not the failure.
+
+Retirement is drain-before-retire: the replica leaves the candidate set
+FIRST (no new admissions), renders everything already queued, and only
+then stops — zero in-flight requests fail (tests/test_scale.py holds
+the count to exactly 0).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..obs import get_emitter
+from ..obs.metrics import get_metrics
+from .replica import ReplicaState, ReplicaUnavailableError
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """Every registered replica is draining, retired, or dead."""
+
+
+class _Entry:
+    def __init__(self, replica, now: float):
+        self.replica = replica
+        self.last_ok_t = now
+        self.beat: dict = {}
+
+
+class Router:
+    def __init__(self, heartbeat_timeout_s: float = 10.0,
+                 clock=time.monotonic):
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+        self.n_dispatches = 0
+        self.n_affinity_hits = 0
+        self.n_failovers = 0
+        self.n_dead_marked = 0
+
+    # -- registry -------------------------------------------------------------
+
+    def register(self, replica) -> None:
+        with self._lock:
+            self._entries[replica.replica_id] = _Entry(replica, self.clock())
+        get_metrics().gauge("scale_replicas_registered",
+                            len(self._entries))
+
+    def deregister(self, replica_id: str) -> None:
+        with self._lock:
+            self._entries.pop(str(replica_id), None)
+
+    def replicas(self) -> list:
+        with self._lock:
+            return [e.replica for e in self._entries.values()]
+
+    def n_ready(self) -> int:
+        return sum(
+            1 for r in self.replicas()
+            if r.state in (ReplicaState.STARTING, ReplicaState.READY)
+        )
+
+    def sweep(self) -> dict:
+        """Pull one heartbeat round. A replica whose beats have failed
+        for longer than ``heartbeat_timeout_s`` is marked dead (and its
+        queued work is already failing — the supervisor replaces it)."""
+        now = self.clock()
+        dead: list[str] = []
+        for entry in list(self._entries.values()):
+            r = entry.replica
+            if r.state in (ReplicaState.RETIRED, ReplicaState.DEAD):
+                continue
+            try:
+                entry.beat = r.heartbeat()
+                entry.last_ok_t = now
+            # graftlint: ok(swallow: the timeout ladder IS the handler — failures inside the window are the hysteresis, past it _mark_dead emits)
+            except Exception as exc:
+                if now - entry.last_ok_t >= self.heartbeat_timeout_s:
+                    self._mark_dead(r, f"heartbeat: {exc}")
+                    dead.append(r.replica_id)
+        return {"t": now, "dead": dead, "n_ready": self.n_ready()}
+
+    def _mark_dead(self, replica, detail: str) -> None:
+        if replica.state in (ReplicaState.DEAD, ReplicaState.DRAINING,
+                             ReplicaState.RETIRED):
+            # draining/retired is a deliberate exit, not a death — marking
+            # it dead would make the supervisor "replace" a retirement
+            return
+        replica.state = ReplicaState.DEAD
+        self.n_dead_marked += 1
+        get_emitter().emit("router", event="dead",
+                           replica=replica.replica_id,
+                           detail=detail[:200])
+        get_metrics().counter("scale_router_events_total", event="dead")
+
+    # -- dispatch -------------------------------------------------------------
+
+    def _candidates(self, scene) -> list[tuple[bool, int, str, object]]:
+        """Accepting replicas as (no_affinity, load, id, replica), sorted
+        so ``[0]`` is the pick: affinity beats load beats id."""
+        out = []
+        for entry in self._entries.values():
+            r = entry.replica
+            if not r.accepting():
+                continue
+            affinity = (
+                scene is not None
+                and scene in entry.beat.get("scenes", ())
+            )
+            try:
+                load = int(r.load())
+            # graftlint: ok(swallow: routing probe; a failed load sorts the replica last, the sweep owns dead-marking)
+            except Exception:
+                load = 1 << 30
+            out.append((not affinity, load, r.replica_id, r))
+        out.sort(key=lambda c: c[:3])
+        return out
+
+    def pick(self, scene=None):
+        """The replica the next request for ``scene`` should land on."""
+        cands = self._candidates(scene)
+        if not cands:
+            raise NoReplicaAvailableError(
+                f"no accepting replica among {len(self._entries)} registered"
+            )
+        return cands[0][3]
+
+    def submit(self, rays, near, far, scene=None, tenant=None):
+        """One request through the front door: pick, submit, fail over.
+
+        A replica that refuses (draining/closed/dead) is skipped; one
+        that dies mid-submit is marked dead and the NEXT candidate gets
+        the request — the caller never sees a failover."""
+        cands = self._candidates(scene)
+        if not cands:
+            get_emitter().emit("router", event="no_replica",
+                               **({} if scene is None
+                                  else {"scene": str(scene)}))
+            raise NoReplicaAvailableError(
+                f"no accepting replica among {len(self._entries)} registered"
+            )
+        last_exc: Exception | None = None
+        for i, (no_aff, load, _rid, replica) in enumerate(cands):
+            try:
+                future = replica.submit(rays, near, far, scene=scene,
+                                        tenant=tenant)
+            except (ReplicaUnavailableError, RuntimeError) as exc:
+                # RuntimeError covers a closed batcher (a racing
+                # kill/retire): treat both as this-replica failures
+                last_exc = exc
+                self.n_failovers += 1
+                self._mark_dead(replica, f"submit: {exc}")
+                get_emitter().emit(
+                    "router", event="failover",
+                    replica=replica.replica_id,
+                    n_candidates=len(cands) - i - 1,
+                    **({} if scene is None else {"scene": str(scene)}),
+                )
+                get_metrics().counter("scale_router_events_total",
+                                      event="failover")
+                continue
+            self.n_dispatches += 1
+            if not no_aff:
+                self.n_affinity_hits += 1
+            get_metrics().counter("scale_router_dispatch_total",
+                                  replica=replica.replica_id)
+            return future
+        raise NoReplicaAvailableError(
+            f"all {len(cands)} accepting replicas failed the submit"
+        ) from last_exc
+
+    # -- retirement -----------------------------------------------------------
+
+    def drain(self, replica_id: str, timeout_s: float = 60.0) -> int:
+        """Drain-before-retire ``replica_id``. Returns the in-flight
+        failure count (the contract wants 0). The replica leaves the
+        candidate set at the state flip inside ``drain`` — before any
+        queued render — so no new work can race in."""
+        entry = self._entries.get(str(replica_id))
+        if entry is None:
+            return 0
+        load_before = 0
+        try:
+            load_before = int(entry.replica.load())
+        # graftlint: ok(swallow: telemetry-only load snapshot; the drain below is the real work)
+        except Exception:
+            pass
+        failed = entry.replica.drain(timeout_s=timeout_s)
+        get_emitter().emit("router", event="drain", replica=str(replica_id),
+                           load=load_before, n_failed=int(failed))
+        get_metrics().counter("scale_router_events_total", event="drain")
+        return failed
+
+    def stats(self) -> dict:
+        per = {}
+        for entry in self._entries.values():
+            per[entry.replica.replica_id] = {
+                "state": entry.replica.state,
+                "load": entry.beat.get("load"),
+                "warm_source": entry.beat.get("warm_source"),
+            }
+        return {
+            "n_registered": len(self._entries),
+            "n_ready": self.n_ready(),
+            "n_dispatches": self.n_dispatches,
+            "n_affinity_hits": self.n_affinity_hits,
+            "n_failovers": self.n_failovers,
+            "n_dead_marked": self.n_dead_marked,
+            "replicas": per,
+        }

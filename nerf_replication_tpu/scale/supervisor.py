@@ -1,0 +1,213 @@
+"""Closed-loop replica supervision against the SLO signal.
+
+PR 10 built the signal (``/metrics`` + the ``/healthz`` SLO block), PR 12
+added per-tenant deny rates; this loop finally ACTS on them. Each
+``step(attainment, deny_rate)`` evaluates one observation window:
+
+* **scale-out** — attainment below ``out_below`` OR deny rate above
+  ``deny_above`` for ``out_windows`` CONSECUTIVE windows, the out
+  cooldown has elapsed, and the fleet is under ``max_replicas``:
+  spawn one replica (warm-started from the shared artifact store, so
+  the capacity arrives in seconds).
+* **scale-in** — attainment at/above the STRICTER ``in_above`` and deny
+  rate at/below ``deny_above`` for ``in_windows`` consecutive windows,
+  the in cooldown has elapsed, and the fleet is over ``min_replicas``:
+  drain-before-retire the least-loaded replica.
+* **replace** — a dead replica (missed heartbeats, crash) is replaced
+  immediately, outside the cooldowns: that is capacity repair, not a
+  scaling decision, and waiting out a cooldown would serve the outage.
+
+The asymmetric thresholds + consecutive-window streaks are the
+hysteresis; the cooldowns bound the rate of change. Both exist so the
+loop converges instead of flapping (tests/test_scale.py drives the
+decision table on a fake clock).
+
+Every decision (including holds) emits a ``scale_decision`` telemetry
+row, so ``tlm_report`` can show the loop's reasoning and ``--diff`` can
+gate on grown SLO-miss windows / replica churn.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..obs import get_emitter
+from ..obs.metrics import get_metrics
+from .options import ScaleOptions
+from .replica import ReplicaState
+
+
+class Supervisor:
+    """One decision loop over a :class:`~.router.Router`.
+
+    ``spawn_fn(index) -> replica`` builds a new replica (serve_bench
+    passes an engine factory against the shared artifact dir; tests pass
+    fakes). The supervisor registers what it spawns."""
+
+    def __init__(self, router, spawn_fn, options: ScaleOptions | None = None,
+                 clock=time.monotonic):
+        self.router = router
+        self.spawn_fn = spawn_fn
+        self.options = options or ScaleOptions()
+        self.clock = clock
+        self._spawn_index = 0
+        self._out_streak = 0
+        self._in_streak = 0
+        # cooldown anchors start "elapsed": the first legitimate streak
+        # may act immediately
+        self._last_out_t = -float("inf")
+        self._last_in_t = -float("inf")
+        self.n_spawned = 0
+        self.n_retired = 0
+        self.n_replaced = 0
+        self.n_miss_windows = 0
+        self.drain_failures = 0
+        self.decisions: list[dict] = []
+
+    # -- capacity actions -----------------------------------------------------
+
+    def _spawn(self, reason: str) -> object:
+        replica = self.spawn_fn(self._spawn_index)
+        self._spawn_index += 1
+        self.n_spawned += 1
+        self.router.register(replica)
+        get_emitter().emit(
+            "replica", replica=replica.replica_id, event="spawn",
+            state=replica.state, n_ready=self.router.n_ready(),
+            detail=reason,
+        )
+        return replica
+
+    def ensure_min(self) -> int:
+        """Bring the fleet up to ``min_replicas`` (boot path)."""
+        spawned = 0
+        while self.router.n_ready() < self.options.min_replicas:
+            self._spawn("ensure_min")
+            spawned += 1
+        return spawned
+
+    def replace_dead(self) -> int:
+        """Sweep heartbeats and replace every dead replica 1:1 (bounded
+        by ``max_replicas``). Runs outside the cooldowns — repair, not
+        scaling."""
+        self.router.sweep()
+        replaced = 0
+        for r in self.router.replicas():
+            if r.state != ReplicaState.DEAD:
+                continue
+            self.router.deregister(r.replica_id)
+            if self.router.n_ready() < self.options.max_replicas:
+                fresh = self._spawn(f"replace:{r.replica_id}")
+                replaced += 1
+                self._decide("replace", f"dead:{r.replica_id}",
+                             replica=fresh.replica_id)
+        self.n_replaced += replaced
+        return replaced
+
+    def _retire_pick(self):
+        """Least-loaded ready replica (fastest drain, least disruption)."""
+        ready = [r for r in self.router.replicas()
+                 if r.state == ReplicaState.READY]
+        if not ready:
+            return None
+
+        def load_of(r):
+            try:
+                return int(r.load())
+            # graftlint: ok(swallow: retire-pick probe; an unreadable load just makes the replica least attractive)
+            except Exception:
+                return 1 << 30
+
+        return min(ready, key=lambda r: (load_of(r), r.replica_id))
+
+    # -- the decision loop ----------------------------------------------------
+
+    def _decide(self, action: str, reason: str, *, attainment=None,
+                deny_rate=None, streak=0, replica=None) -> str:
+        n = self.router.n_ready()
+        row = {"action": action, "reason": reason, "n_replicas": n,
+               "streak": int(streak)}
+        if attainment is not None:
+            row["attainment"] = float(attainment)
+        if deny_rate is not None:
+            row["deny_rate"] = float(deny_rate)
+        if replica is not None:
+            row["replica"] = str(replica)
+        self.decisions.append(row)
+        get_emitter().emit("scale_decision", **row)
+        mx = get_metrics()
+        mx.counter("scale_decisions_total", action=action)
+        mx.gauge("scale_replicas_ready", n)
+        return action
+
+    def step(self, attainment: float | None, deny_rate: float = 0.0) -> str:
+        """Evaluate one observation window; returns the action taken
+        (``out`` / ``in`` / ``replace`` / ``hold``). ``attainment`` is
+        the window's SLO attainment in [0, 1] (None = no traffic, which
+        counts toward scale-IN: an idle fleet should shrink)."""
+        opt = self.options
+        now = self.clock()
+        if self.replace_dead():
+            return "replace"
+        missing = (attainment is not None and attainment < opt.out_below)
+        denying = deny_rate > opt.deny_above
+        good = ((attainment is None or attainment >= opt.in_above)
+                and deny_rate <= opt.deny_above)
+        if missing or denying:
+            self.n_miss_windows += 1
+            self._out_streak += 1
+            self._in_streak = 0
+        elif good:
+            self._in_streak += 1
+            self._out_streak = 0
+        else:
+            # the hysteresis band: neither streak advances
+            self._out_streak = 0
+            self._in_streak = 0
+        n = self.router.n_ready()
+        if (self._out_streak >= opt.out_windows
+                and now - self._last_out_t >= opt.cooldown_out_s
+                and n < opt.max_replicas):
+            self._last_out_t = now
+            self._out_streak = 0
+            reason = "deny_rate" if (denying and not missing) else "slo_miss"
+            fresh = self._spawn(reason)
+            return self._decide("out", reason, attainment=attainment,
+                                deny_rate=deny_rate,
+                                streak=opt.out_windows,
+                                replica=fresh.replica_id)
+        if (self._in_streak >= opt.in_windows
+                and now - self._last_in_t >= opt.cooldown_in_s
+                and n > opt.min_replicas):
+            self._last_in_t = now
+            self._in_streak = 0
+            victim = self._retire_pick()
+            if victim is not None:
+                failed = self.router.drain(victim.replica_id,
+                                           timeout_s=opt.drain_timeout_s)
+                self.drain_failures += int(failed)
+                self.n_retired += 1
+                return self._decide("in", "sustained_attainment",
+                                    attainment=attainment,
+                                    deny_rate=deny_rate,
+                                    streak=opt.in_windows,
+                                    replica=victim.replica_id)
+        return self._decide(
+            "hold",
+            "miss_streak" if self._out_streak else
+            ("good_streak" if self._in_streak else "steady"),
+            attainment=attainment, deny_rate=deny_rate,
+            streak=max(self._out_streak, self._in_streak),
+        )
+
+    def stats(self) -> dict:
+        return {
+            "n_spawned": self.n_spawned,
+            "n_retired": self.n_retired,
+            "n_replaced": self.n_replaced,
+            "n_miss_windows": self.n_miss_windows,
+            "drain_failures": self.drain_failures,
+            "churn": self.n_spawned + self.n_retired,
+            "n_decisions": len(self.decisions),
+            "router": self.router.stats(),
+        }

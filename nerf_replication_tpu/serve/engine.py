@@ -112,7 +112,7 @@ class RenderEngine:
     def __init__(self, cfg, network, params, near, far, grid=None, bbox=None,
                  tracker: CompileTracker | None = None,
                  warmup_families: tuple[str, ...] | None = None,
-                 aot=None):
+                 aot=None, mesh=None):
         import jax.numpy as jnp
 
         from ..renderer.accelerated import MarchOptions
@@ -148,6 +148,18 @@ class RenderEngine:
             else self.eval_options.chunk_size
         )
         self.buckets = _normalize_buckets(self.options.buckets, self.chunk)
+        # mesh-sharded dispatch (scale/mesh_dispatch.py): a data-parallel
+        # mesh shards each executable's chunk axis over the mesh devices;
+        # None (the default, and always the case on a size-1 mesh unless
+        # forced) keeps the plain single-device jit path
+        self.mesh = mesh
+        self._chunks_sharding = None
+        if mesh is not None:
+            from ..parallel.sharding import chunk_sharding
+            from ..scale.mesh_dispatch import validate_mesh_buckets
+
+            validate_mesh_buckets(self.buckets, self.chunk, mesh)
+            self._chunks_sharding = chunk_sharding(mesh)
         self.tracker = tracker or CompileTracker()
         self.cache = PoseCache(
             capacity=self.options.cache_entries,
@@ -241,6 +253,21 @@ class RenderEngine:
         # code path, just one more prewarmed executable set
         return self.network.clone(compute_dtype=jnp.bfloat16)
 
+    def _finalize_fn(self, fn):
+        """Jit an executable body: plain ``jax.jit`` on the single-device
+        path, or the mesh-sharded wrapper (chunks over the data axis,
+        params/grid replicated) when a serving mesh is installed — the
+        body is identical either way, which is why the mesh render stays
+        bitwise-equal to the single-device one."""
+        import jax
+
+        if self.mesh is None:
+            # graftlint: ok(aot: warm-up hands every finalized executable to AOTRegistry.register)
+            return jax.jit(fn)
+        from ..scale.mesh_dispatch import mesh_jit
+
+        return mesh_jit(fn, self.mesh, has_grid=self.use_grid)
+
     def _build_fn(self, bucket: int, family: str):
         import jax
         import jax.numpy as jnp  # noqa: F401  (kept local: no import cost pre-jax)
@@ -261,7 +288,6 @@ class RenderEngine:
             # the AOT warm-up treat every grid-engine family uniformly
             options = self._family_eval_options(family)
 
-            @jax.jit
             def fn(params, rays_p, grid, bbox):
                 apply_fn = lambda pts, vd, m: network.apply(  # noqa: E731
                     params, pts, vd, model=m
@@ -273,7 +299,7 @@ class RenderEngine:
                     rays_p,
                 )
 
-            return fn
+            return self._finalize_fn(fn)
 
         if self.use_grid:
             options = self._family_march_options(family)
@@ -285,7 +311,6 @@ class RenderEngine:
                 # construction, both switch on the same MarchOptions
                 cap = self.packed_cap
 
-                @jax.jit
                 def fn(params, rays_p, grid, bbox):
                     apply_fn = lambda pts, vd, _m: network.apply(  # noqa: E731
                         params, pts, vd, model=model
@@ -298,9 +323,8 @@ class RenderEngine:
                         rays_p,
                     )
 
-                return fn
+                return self._finalize_fn(fn)
 
-            @jax.jit
             def fn(params, rays_p, grid, bbox):
                 apply_fn = lambda pts, vd, _m: network.apply(  # noqa: E731
                     params, pts, vd, model=model
@@ -312,11 +336,10 @@ class RenderEngine:
                     rays_p,
                 )
 
-            return fn
+            return self._finalize_fn(fn)
 
         options = self._family_eval_options(family)
 
-        @jax.jit
         def fn(params, rays_p):
             apply_fn = lambda pts, vd, m: network.apply(  # noqa: E731
                 params, pts, vd, model=m
@@ -326,7 +349,7 @@ class RenderEngine:
                 rays_p,
             )
 
-        return fn
+        return self._finalize_fn(fn)
 
     def _get_fn(self, bucket: int, family: str):
         key = (bucket, family)
@@ -431,6 +454,20 @@ class RenderEngine:
     def _is_default_scene(self, scene_id) -> bool:
         return scene_id is None or scene_id == self.default_scene
 
+    def resident_scenes(self) -> list[str]:
+        """Scene ids served for free right now: the fleet's HBM-resident
+        set plus any host-RAM staged copies (re-promotion is a
+        device_put, no disk walk). The router's scene-affinity signal —
+        routing a request here is an argument swap; routing it to a
+        replica without the scene pays a cold load."""
+        if self.fleet is None:
+            return []
+        ids = list(self.fleet.resident_ids())
+        staged = getattr(self.fleet, "staged_ids", None)
+        if staged is not None:
+            ids.extend(s for s in staged() if s not in ids)
+        return ids
+
     def require_scene(self, scene_id) -> None:
         """Synchronous existence check (submission edge: 404 before a
         bad scene id ever occupies queue capacity)."""
@@ -523,8 +560,13 @@ class RenderEngine:
             # the request rays' host->device copy is the one INTENDED
             # transfer of the serving path; explicit device_put keeps the
             # whole request stream clean under jax.transfer_guard /
-            # analysis.sanitizer()
-            chunks = jax.device_put(chunks)
+            # analysis.sanitizer(). Under a serving mesh the chunks land
+            # directly in their data-axis shards — one placement, no
+            # post-hoc reshard inside the executable.
+            chunks = (
+                jax.device_put(chunks) if self._chunks_sharding is None
+                else jax.device_put(chunks, self._chunks_sharding)
+            )
             fn = self._get_fn(bucket, family)
             params = self.params if scene is None else scene.params
             if self.use_grid:
@@ -769,6 +811,11 @@ class RenderEngine:
             # artifact store), "compiled" means at least one was built
             "warm_source": self.warm_source,
             "warmup_wall_s": round(self.warmup_wall_s, 3),
+            # mesh-sharded dispatch (scale/): None = single-device path
+            "mesh": None if self.mesh is None else {
+                "devices": int(self.mesh.size),
+                "axes": dict(self.mesh.shape),
+            },
             "cache": self.cache.stats(),
             # multi-scene residency (None = single-tenant serving)
             "fleet": None if self.fleet is None else self.fleet.stats(),
@@ -825,6 +872,13 @@ def engine_from_cfg(cfg, cfg_file: str | None = None) -> RenderEngine:
     init_key = jax.random.PRNGKey(int(cfg.get("seed", 0)))
     tracker = CompileTracker()
     aot = registry_from_cfg(cfg, tracker=tracker)
+    # serving mesh (scale: block): shard each executable's chunk axis
+    # over the data-parallel mesh. None on a single device unless forced.
+    from ..scale.mesh_dispatch import mesh_from_scale_cfg
+
+    mesh = mesh_from_scale_cfg(cfg)
+    if mesh is not None:
+        print(f"serving mesh: {dict(mesh.shape)} over {mesh.size} device(s)")
     if aot is not None:
         try:
             params = jax.eval_shape(lambda k: init(network, k), init_key)
@@ -835,7 +889,7 @@ def engine_from_cfg(cfg, cfg_file: str | None = None) -> RenderEngine:
         params = init(network, init_key)
     engine = RenderEngine(
         cfg, network, params, near=test_ds.near, far=test_ds.far,
-        grid=grid, bbox=bbox, tracker=tracker, aot=aot,
+        grid=grid, bbox=bbox, tracker=tracker, aot=aot, mesh=mesh,
     )
     # checkpoint I/O only now — a disk-warm engine is already serving-ready.
     # materialize the init for real (load_network hands the template back

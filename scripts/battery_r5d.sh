@@ -90,7 +90,8 @@ log "stage B: fused at scale (16k/scan8, 65k/scan1 — std OOMs at 65k)"
 FUSED="network.nerf.fused_trunk true network.nerf.fused_tile 512"
 for shape in "16384 8" "65536 1"; do
   set -- $shape
-  BENCH_N_RAYS=$1 BENCH_SCAN_STEPS=$2 BENCH_OPTS="$FUSED" \
+  BENCH_N_RAYS=$1 BENCH_SCAN_STEPS=$2 BENCH_NO_COMPANION=1 \
+  BENCH_OPTS="$FUSED" \
   timeout 2400 python bench.py 2>data/logs/r5b_fused_$1.err \
     | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
 done
@@ -98,6 +99,7 @@ done
 gate
 log "stage C: fused tile axis (256; 1024 retries the VMEM OOM w/ raised limit)"
 for t in 256 1024; do
+  BENCH_NO_COMPANION=1 \
   BENCH_OPTS="network.nerf.fused_trunk true network.nerf.fused_tile $t" \
   timeout 1800 python bench.py 2>data/logs/r5b_fused_t$t.err \
     | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1

@@ -9,9 +9,13 @@
 #   * waits for the two-good-probes gate,
 #   * STARTS only if its estimated duration + 10 min margin fits
 #     before the 06:35 UTC deadline,
+#   * runs under a kill timer capped at the REMAINING headroom (see
+#     `budget` — a stage that overruns its estimate still can't hold
+#     the tunnel past the deadline),
 # and whatever time remains at the end goes to one plain warm-cache
-# `python bench.py` replay (the driver-verifiable headline) plus a
-# BENCH_DEFAULTS re-promotion over the freshest sweep rows.
+# `python bench.py` replay (the driver-verifiable headline); the
+# BENCH_DEFAULTS re-promotion over the freshest sweep rows runs from an
+# EXIT trap, so no wedged gate can skip it.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p data/logs
@@ -58,6 +62,25 @@ fits() {
   [ $(( $(date -u +%s) + need )) -le "$DEADLINE" ]
 }
 
+# budget <ceiling_minutes> -> the stage's `timeout` seconds: its own
+# ceiling, capped at whatever actually remains before the deadline minus
+# the 10-min margin.  `fits` only checks the ESTIMATE at start time — a
+# stage that overruns its estimate would otherwise hold the tunnel
+# straight through the deadline on its fixed kill timer.
+budget() {
+  local s=$(( $1 * 60 ))
+  local cap=$(( DEADLINE - $(date -u +%s) - 600 ))
+  [ "$cap" -lt "$s" ] && s=$cap
+  [ "$s" -lt 60 ] && s=60
+  echo "$s"
+}
+
+# The BENCH_DEFAULTS re-promotion is purely local (reads sweep rows,
+# no chip) — run it on EVERY exit so a wedged tunnel parking a gate at
+# the deadline can't skip it.
+trap 'python scripts/promote_bench_defaults.py BENCH_SWEEP*.jsonl \
+  --config lego.yaml || true' EXIT
+
 NGP_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 \
 task_arg.scan_steps 8"
 CAP="task_arg.ngp_packed_cap_avg_eval 1024"
@@ -65,7 +88,7 @@ CAP="task_arg.ngp_packed_cap_avg_eval 1024"
 gate
 if fits 45; then
   log "stage 5: NGP H=400 quality trail (decoupled eval budget, packed)"
-  timeout 3600 python scripts/quality_run.py --minutes 25 --H 400 \
+  timeout $(budget 60) python scripts/quality_run.py --minutes 25 --H 400 \
     --config lego_hash_packed.yaml --out_prefix QUALITY_NGP_R5 \
     --tag q_ngp_r5 task_arg.ngp_training true \
     task_arg.ngp_packed_march true $NGP_OPTS $CAP \
@@ -75,7 +98,7 @@ else log "skip stage 5 (needs 45 min)"; fi
 gate
 if fits 30; then
   log "stage 6: std quality trail + eval-fps shootout (lego.yaml)"
-  timeout 2700 python scripts/quality_run.py --minutes 15 --H 400 \
+  timeout $(budget 45) python scripts/quality_run.py --minutes 15 --H 400 \
     --config lego.yaml --out_prefix QUALITY_R5 --tag q_std_r5 \
     2>data/logs/r5_quality_std.err | tail -8
 else log "skip stage 6 (needs 30 min)"; fi
@@ -83,7 +106,7 @@ else log "skip stage 6 (needs 30 min)"; fi
 gate
 if fits 20; then
   log "stage 3c-redo: packed + bbox-clip + slow refresh, eval cap preset"
-  timeout 2700 python scripts/bench_ngp.py --seconds 420 \
+  timeout $(budget 45) python scripts/bench_ngp.py --seconds 420 \
     --config lego_hash_packed.yaml --arms ngp_packed \
     --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
     task_arg.max_march_samples 64 task_arg.scan_steps 8 \
@@ -94,7 +117,7 @@ else log "skip stage 3c (needs 20 min)"; fi
 gate
 if fits 40; then
   log "stage D: packed-NGP steady state at 8k rays (600 s)"
-  timeout 2400 python scripts/bench_ngp.py --seconds 600 --n_rays 8192 \
+  timeout $(budget 40) python scripts/bench_ngp.py --seconds 600 --n_rays 8192 \
     --config lego_hash_packed.yaml --arms ngp_packed \
     --out BENCH_NGP.jsonl task_arg.render_step_size 0.015 \
     task_arg.max_march_samples 64 task_arg.scan_steps 8 \
@@ -107,7 +130,7 @@ if fits 25; then
   log "stage 3b: NGP-step cost analysis (validates the PERF.md roofline)"
   for MODE in "" "task_arg.ngp_packed_march true"; do
     BENCH_OPTS="task_arg.render_step_size 0.01 task_arg.max_march_samples 64 $MODE" \
-    timeout 1500 python scripts/profile_step.py --ngp --n_rays 4096 \
+    timeout $(budget 25) python scripts/profile_step.py --ngp --n_rays 4096 \
       --remat false --config lego_hash_packed.yaml --steps 20 \
       2>>data/logs/r5_ngp_profile.err | tee -a PROFILE_STEP.jsonl | tail -2
   done
@@ -117,30 +140,31 @@ gate
 if fits 35; then
   log "stage B/C: fused 16k/scan8 + tile-1024 VMEM retry"
   FUSED="network.nerf.fused_trunk true network.nerf.fused_tile 512"
-  BENCH_N_RAYS=16384 BENCH_SCAN_STEPS=8 BENCH_OPTS="$FUSED" \
-  timeout 1800 python bench.py 2>data/logs/r5b_fused_16384.err \
+  BENCH_N_RAYS=16384 BENCH_SCAN_STEPS=8 BENCH_NO_COMPANION=1 \
+  BENCH_OPTS="$FUSED" \
+  timeout $(budget 30) python bench.py 2>data/logs/r5b_fused_16384.err \
     | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
+  BENCH_NO_COMPANION=1 \
   BENCH_OPTS="network.nerf.fused_trunk true network.nerf.fused_tile 1024" \
-  timeout 1500 python bench.py 2>data/logs/r5b_fused_t1024.err \
+  timeout $(budget 25) python bench.py 2>data/logs/r5b_fused_t1024.err \
     | tee -a BENCH_SWEEP_FUSED.jsonl | tail -1
 else log "skip stage B/C (needs 35 min)"; fi
 
 gate
 if fits 25; then
   log "stage 7: hard-scene trail (thin fence + checker)"
-  timeout 1500 python scripts/quality_run.py --minutes 12 --H 400 \
+  timeout $(budget 25) python scripts/quality_run.py --minutes 12 --H 400 \
     --scene procedural_hard --config lego_hash_packed.yaml \
     --out_prefix QUALITY_HARD --tag q_hard_r5 \
     task_arg.ngp_training true task_arg.ngp_packed_march true $NGP_OPTS \
     $CAP 2>data/logs/r5_quality_hard.err | tail -6
 else log "skip stage 7 (needs 25 min)"; fi
 
-# Closing moves: freshest promotion + one driver-identical warm replay.
+# Closing move: one driver-identical warm replay (the freshest
+# BENCH_DEFAULTS promotion now runs from the EXIT trap, gate or no gate).
 gate
-python scripts/promote_bench_defaults.py BENCH_SWEEP*.jsonl \
-  --config lego.yaml || true
 if fits 2; then
   log "closing: warm-cache driver replay (python bench.py)"
-  timeout 1200 python bench.py 2>data/logs/r5e_replay.err | tail -1
+  timeout $(budget 20) python bench.py 2>data/logs/r5e_replay.err | tail -1
 fi
 log "battery r5e done"

@@ -20,6 +20,19 @@ command line, not a war story.
         --fault fleet.load:truncate:3:1
     python scripts/chaos_run.py serve --scenes 3 --tenants 3 \
         --fault fleet.load:truncate:3:1
+    python scripts/chaos_run.py serve --replicas 3 --requests 48
+
+``--replicas N`` serves the stream through the scale-out front door
+(nerf_replication_tpu/scale): N in-process replicas behind the router,
+a supervisor holding the fleet at N. Halfway through the stream one
+replica's batcher is killed WITHOUT its registry entry knowing — the
+crashed-process shape. Recovery then ALSO requires the router to fail
+the next submit over to a survivor (the caller sees one submit, not
+the crash), the supervisor's next pass to replace the dead replica
+1:1, every post-kill request to complete, drain-before-retire at
+teardown to fail zero in-flight requests, and the whole episode to
+trigger zero recompiles (the replacement warms from the shared
+engine).
 
 ``--scenes N`` puts the serve mode behind a multi-scene fleet
 (nerf_replication_tpu/fleet) with an HBM budget of about half the
@@ -393,6 +406,161 @@ def run_serve(args, plan) -> dict:
     return out
 
 
+def run_serve_replicas(args, plan) -> dict:
+    """Kill-a-replica chaos behind the scale/ front door.
+
+    N replicas (own micro-batchers, one shared warm engine) serve an
+    open stream through the router when one replica's batcher dies
+    mid-load WITHOUT its registry entry knowing (the crashed-process
+    shape: state still says ready). The run recovers iff the router
+    fails the next submit over to a survivor (marking the liar dead),
+    the supervisor replaces it 1:1 outside any cooldown, every
+    post-kill request completes within the SLO bound, drain-before-
+    retire at teardown fails zero in-flight requests, and the whole
+    episode triggers zero recompiles."""
+    import numpy as np
+
+    import jax
+
+    from nerf_replication_tpu.models import init_params_for, make_network
+    from nerf_replication_tpu.obs import configure_tracing, init_run
+    from nerf_replication_tpu.resil import (
+        FlightRecorder,
+        injecting,
+        install_flight_recorder,
+        uninstall_flight_recorder,
+    )
+    from nerf_replication_tpu.scale import (
+        InProcessReplica,
+        NoReplicaAvailableError,
+        ReplicaState,
+        Router,
+        ScaleOptions,
+        Supervisor,
+    )
+    from nerf_replication_tpu.serve import (
+        MicroBatcher,
+        RenderEngine,
+        ServeTimeoutError,
+    )
+
+    scene_root = _scene(args.workdir)
+    cfg = _tiny_cfg(
+        scene_root, args.workdir,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "64",
+         "serve.buckets", "[128, 256]",
+         "serve.max_batch_rays", "256",
+         "serve.max_delay_ms", "5.0",
+         "serve.request_timeout_s", "10.0"],
+    )
+    telem = os.path.join(args.workdir, "record", "telemetry.jsonl")
+    init_run(cfg, component="serve", path=telem)
+    flight_dir = os.path.join(args.workdir, "record")
+    configure_tracing(enabled=True)
+    install_flight_recorder(FlightRecorder(flight_dir))
+    network = make_network(cfg)
+    params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    # ONE warm engine shared by every replica: chaos prices the control
+    # plane (failover/replace), not warm-start economics — serve_bench
+    # --replicas owns that measurement
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox)
+    fleet: list = []
+
+    def spawn(i: int):
+        r = InProcessReplica(f"replica{i}", engine, MicroBatcher(engine))
+        fleet.append(r)
+        return r
+
+    n = max(2, args.replicas)
+    router = Router(heartbeat_timeout_s=5.0)
+    sup = Supervisor(router, spawn, options=ScaleOptions(
+        min_replicas=n, max_replicas=n, cooldown_out_s=1e9,
+        cooldown_in_s=1e9))
+    sup.ensure_min()
+
+    rng = np.random.default_rng(args.seed)
+    steady_base = engine.tracker.total_compiles()
+    kill_at = args.requests // 2
+    killed = None
+    ok = failed = shed = post_kill_failed = 0
+    lats_after: list = []
+    t0_run = time.perf_counter()
+    with injecting(plan):
+        for i in range(args.requests):
+            if i == kill_at:
+                victim = next(r for r in fleet
+                              if r.state == ReplicaState.READY)
+                # the crashed-process shape: the batcher dies (queued
+                # futures fail NOW), the registry still says ready
+                victim.batcher.close(drain=False)
+                killed = victim.replica_id
+                print(f"chaos: killed {killed} at request {i}")
+            if killed and i == kill_at + 4:
+                # the supervisor's periodic pass: sweep + 1:1 replace
+                sup.replace_dead()
+            n_rays = int(rng.integers(32, 257))
+            d = np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n_rays, 3))
+            rays = np.concatenate(
+                [np.tile([0.0, 0.0, 4.0], (n_rays, 1)), d], -1
+            ).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                router.submit(rays, NEAR, FAR).result(timeout=30.0)
+                ok += 1
+                if killed is not None:
+                    lats_after.append(time.perf_counter() - t0)
+            except NoReplicaAvailableError:
+                shed += 1
+            except (ServeTimeoutError, TimeoutError, RuntimeError, OSError):
+                failed += 1
+                if killed is not None:
+                    post_kill_failed += 1
+    wall = time.perf_counter() - t0_run
+    drain_failures = 0
+    for r in fleet:
+        if r.state in (ReplicaState.STARTING, ReplicaState.READY):
+            drain_failures += r.drain(timeout_s=30.0)
+    uninstall_flight_recorder()
+    configure_tracing(enabled=False)
+    p95_after = None
+    if lats_after:
+        lat_sorted = sorted(lats_after)
+        p95_after = lat_sorted[min(len(lat_sorted) - 1,
+                                   int(0.95 * len(lat_sorted)))]
+    return {
+        "mode": "serve",
+        "completed": True,
+        "died": None,
+        "wall_s": round(wall, 2),
+        "n_ok": ok,
+        "n_rejected_503": shed,
+        "n_failed": failed,
+        "worker_restarts": 0,
+        "breaker": {"state": "closed"},
+        "recompiles_steady": engine.tracker.total_compiles() - steady_base,
+        "telemetry": telem,
+        "scale": {
+            "n_replicas": n,
+            "killed": killed,
+            "n_failovers": router.n_failovers,
+            "n_dead_marked": router.n_dead_marked,
+            "n_replaced": sup.n_replaced,
+            "post_kill_failed": post_kill_failed,
+            "post_kill_p95_ms": (None if p95_after is None
+                                 else round(p95_after * 1e3, 1)),
+            "drain_failures": drain_failures,
+            "router": router.stats(),
+        },
+        "flight_dumps": _scan_flight_dumps(flight_dir),
+    }
+
+
 def _scan_flight_dumps(flight_dir: str) -> dict:
     """Validate every flight_<reason>.json the run left and extract which
     injected faults its event ring names (the post-mortem must point at
@@ -527,6 +695,12 @@ def main(argv=None) -> int:
                         "'hot' tenant vs N-1 quiet ones; recovery "
                         "requires the quiet tenants un-shed and the "
                         "throttle dump naming the hot tenant")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="serve mode: N > 1 serves through the scale/ "
+                        "front door and kills one replica mid-load; "
+                        "recovery requires a router failover, a 1:1 "
+                        "supervisor replacement, zero post-kill "
+                        "failures, and a clean drain")
     p.add_argument("--backend", default="cpu",
                    help="platform pin ('cpu', 'cpu:8'; '' = inherit)")
     p.add_argument("--workdir",
@@ -557,11 +731,18 @@ def main(argv=None) -> int:
     print(f"chaos plan (seed {args.seed}): "
           + ("; ".join(specs) if specs else "no faults (baseline run)"))
 
-    outcome = (run_train if args.mode == "train" else run_serve)(args, plan)
+    if args.mode == "train":
+        runner = run_train
+    elif args.replicas > 0:
+        runner = run_serve_replicas
+    else:
+        runner = run_serve
+    outcome = runner(args, plan)
     outcome["faults_injected_by_plan"] = plan.injected()
     summary = summarize_telemetry(outcome["telemetry"])
 
     qos_out = outcome.get("qos") or {}
+    scale_out = outcome.get("scale")
     recovered = bool(
         outcome["completed"]
         and summary["retries_exhausted"] == 0
@@ -578,6 +759,16 @@ def main(argv=None) -> int:
             == qos_out.get("quiet_tenants", -1)
             and qos_out.get("quiet_shed", 1) == 0
             and qos_out.get("quiet_denied", 1) == 0
+        ))
+        # replicas mode: the kill must have been OBSERVED and absorbed —
+        # the router failed over at least once, the supervisor replaced
+        # the dead replica exactly 1:1, no request after the kill was
+        # lost, and drain-before-retire at teardown failed nothing
+        and (scale_out is None or (
+            scale_out.get("n_failovers", 0) >= 1
+            and scale_out.get("n_replaced", 0) == 1
+            and scale_out.get("post_kill_failed", 1) == 0
+            and scale_out.get("drain_failures", 1) == 0
         ))
     )
     flight_ok, flight_problems = check_flight(outcome, summary, plan)

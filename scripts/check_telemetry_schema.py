@@ -8,9 +8,11 @@ The default file set covers every committed measurement trail, including
 the serving load generator's ``BENCH_SERVE.jsonl`` (family ``serve_mode``)
 and its multi-scene fleet trail ``BENCH_FLEET.jsonl`` (family
 ``fleet_mode``), its multi-tenant QoS trail ``BENCH_QOS.jsonl`` (family
-``qos_mode``; all three written by scripts/serve_bench.py), and the
-learned sampler's ``BENCH_SAMPLING.jsonl`` (family ``sampling_mode``,
-written by scripts/bench_sampling.py) via the ``BENCH_*.jsonl`` pattern.
+``qos_mode``), its replica scale-out trail ``BENCH_SCALE.jsonl``
+(family ``scale_mode``, one full scale-out/scale-in cycle per row; all
+four written by scripts/serve_bench.py), and the learned sampler's
+``BENCH_SAMPLING.jsonl`` (family ``sampling_mode``, written by
+scripts/bench_sampling.py) via the ``BENCH_*.jsonl`` pattern.
 
 Files named ``telemetry*.jsonl`` are checked row-by-row against the typed
 telemetry schema (``obs/schema.py:ROW_KINDS``); every other JSONL is

@@ -54,10 +54,25 @@ tracing-off arm of the same mode — acceptance wants ≤ 5%).
   gates on quiet p95 within 15% of solo, re-promotion >= 5x faster than
   cold, and ``compiles_steady == 0`` across throttle + demote churn.
 
+* **replica scale-out** (``--replicas N``) — the scale-out front door
+  (nerf_replication_tpu/scale, docs/scaleout.md): an open-loop stream
+  through the router + supervisor over in-process replicas that warm
+  from a SHARED ``.aot`` artifact dir. A spike phase overloads one
+  replica until SLO attainment trips the supervisor's scale-out, then a
+  sustain phase idles the fleet back down through drain-before-retire —
+  one full scale-out/scale-in cycle per run. The summary row (family
+  ``scale_mode``, appended to ``BENCH_SCALE.jsonl``) gates on attainment
+  recovering after scale-out, the FRESH replica reporting
+  ``warm_source == "disk"`` with zero compiles (artifact warm-start, not
+  a recompile), zero drain failures, and ``compiles_steady == 0`` across
+  the whole cycle.
+
     python scripts/serve_bench.py --backend cpu
     python scripts/serve_bench.py --backend cpu --mode open --rate 200
     python scripts/serve_bench.py --backend cpu --scenes 3 --churn
     python scripts/serve_bench.py --backend cpu --tenants 3
+    python scripts/serve_bench.py --backend cpu --replicas 2 --rate 90 \
+        --sustain-rate 20 --slo-ms 200
     python scripts/tlm_report.py data/record/serve_bench
 """
 
@@ -74,7 +89,7 @@ sys.path.insert(0, _REPO)
 NEAR, FAR = 2.0, 6.0
 
 
-def _bench_cfg(scene_root: str, args):
+def _bench_cfg(scene_root: str, args, extra=()):
     """A miniature lego-schema config sized for the bench backend."""
     from nerf_replication_tpu.config import make_cfg
 
@@ -103,6 +118,7 @@ def _bench_cfg(scene_root: str, args):
             "serve.request_timeout_s", "30.0",
             "serve.shed_queue_depths", str(list(args.shed_depths)),
             "record_dir", args.record_dir,
+            *extra,
         ],
     )
 
@@ -623,6 +639,275 @@ def _stage_summary(spans: list[dict]) -> dict:
     return out
 
 
+# -- replica scale-out mode (--replicas N, docs/scaleout.md) -----------------
+
+
+def _build_scale_shared(args):
+    """(cfg, network, params, grid, bbox): everything replicas share.
+
+    The cfg routes every replica's AOT registry at the SAME artifact dir
+    (``compile.dir``) — replica 0 compiles and serializes, every later
+    spawn deserializes and boots with zero builds (``warm_source ==
+    "disk"``), which is the capacity-in-seconds story the row gates on."""
+    import numpy as np
+
+    import jax
+
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+    from nerf_replication_tpu.models import init_params_for, make_network
+    from nerf_replication_tpu.obs import init_run
+
+    scene_root = os.path.join(args.workdir, "scene")
+    if not os.path.exists(os.path.join(scene_root, "transforms_train.json")):
+        generate_scene(scene_root, scene="procedural", H=16, W=16,
+                       n_train=4, n_test=1)
+    aot_dir = os.path.join(args.workdir, "aot_scale")
+    cfg = _bench_cfg(scene_root, args,
+                     extra=["compile.aot", "True",
+                            "compile.artifacts", "True",
+                            "compile.dir", aot_dir])
+    network = make_network(cfg)
+    params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    init_run(cfg, component="serve_bench",
+             path=os.path.join(args.record_dir, "telemetry.jsonl"))
+    return cfg, network, params, grid, bbox
+
+
+def _make_replica_factory(cfg, shared, fleet: list):
+    """spawn_fn(i) for the supervisor: one FULL stack per replica (own
+    engine, tracker, AOT registry, batcher) so a kill or drain touches
+    nothing the other replicas hold."""
+
+    def spawn(i: int):
+        from nerf_replication_tpu.compile import AOTRegistry
+        from nerf_replication_tpu.obs import CompileTracker
+        from nerf_replication_tpu.obs.emit import config_hash
+        from nerf_replication_tpu.scale import InProcessReplica
+        from nerf_replication_tpu.serve import MicroBatcher, RenderEngine
+
+        network, params, grid, bbox = shared
+        tracker = CompileTracker()
+        aot = AOTRegistry(cache_dir=cfg.compile.dir,
+                          config_hash=config_hash(cfg), tracker=tracker,
+                          artifacts=True)
+        t0 = time.perf_counter()
+        engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                              grid=grid, bbox=bbox, tracker=tracker,
+                              aot=aot)
+        replica = InProcessReplica(f"replica{i}", engine,
+                                   MicroBatcher(engine))
+        replica.boot_s = time.perf_counter() - t0
+        fleet.append(replica)
+        print(f"  replica{i}: warm_source={replica.warm_source} "
+              f"compiles={replica.warm_compiles} "
+              f"boot={replica.boot_s:.2f}s")
+        return replica
+
+    return spawn
+
+
+def _drive_window(router, rng, rate: float, window_s: float, slo_s: float,
+                  args) -> dict:
+    """One open-loop observation window through the front door.
+
+    Requests arrive on a fixed-rate pacer regardless of completions;
+    completion times come from polling ``done()`` so a backlogged future
+    is measured when IT finishes, not when the harvest loop reaches it.
+    Attainment = completed-within-SLO / offered (a shed request — no
+    replica accepting — is a miss by definition)."""
+    import numpy as np
+
+    from nerf_replication_tpu.scale import NoReplicaAvailableError
+
+    interval = 1.0 / max(rate, 1e-6)
+    pending: list = []   # (t_submit, future)
+    lats: list = []
+    shed = failed = 0
+    t_start = time.perf_counter()
+    next_t = t_start
+
+    def _harvest(now: float) -> None:
+        nonlocal failed
+        still = []
+        for t0, f in pending:
+            if f.done():
+                try:
+                    f.result(timeout=0)
+                    lats.append(now - t0)
+                except Exception:
+                    failed += 1
+            else:
+                still.append((t0, f))
+        pending[:] = still
+
+    while True:
+        now = time.perf_counter()
+        if now - t_start >= window_s:
+            break
+        if now < next_t:
+            _harvest(now)
+            time.sleep(min(next_t - now, 0.005))
+            continue
+        n = int(rng.integers(args.min_rays, args.max_rays + 1))
+        d = np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3))
+        rays = np.concatenate(
+            [np.tile([0.0, 0.0, 4.0], (n, 1)), d], -1).astype(np.float32)
+        try:
+            pending.append((time.perf_counter(),
+                            router.submit(rays, NEAR, FAR)))
+        except NoReplicaAvailableError:
+            shed += 1
+        next_t += interval
+    # grace: let the window's backlog land (bounded — an overloaded
+    # window reports its misses instead of stalling the bench)
+    grace_end = time.perf_counter() + max(2.0 * slo_s, 0.5)
+    while pending and time.perf_counter() < grace_end:
+        _harvest(time.perf_counter())
+        time.sleep(0.002)
+    n_late = len(pending)  # never completed inside window + grace: misses
+    offered = len(lats) + failed + shed + n_late
+    within = sum(1 for l in lats if l <= slo_s)
+    return {
+        "offered": offered,
+        "done": len(lats),
+        "within_slo": within,
+        "shed": shed,
+        "failed": failed,
+        "late": n_late,
+        "attainment": (within / offered) if offered else None,
+        "p95_ms": (_percentile(lats, 95) or 0.0) * 1e3,
+    }
+
+
+def _run_scale(args) -> tuple[dict, bool]:
+    """The full scale-out/scale-in cycle; returns (row, failed).
+
+    Phase 1 (spike): ``--rate`` arrivals against ONE replica — attainment
+    drops, the supervisor spawns warm-from-disk capacity. Phase 2
+    (sustain): the spike subsides to ``--sustain-rate``; sustained
+    attainment walks the in-streak until the supervisor drains the extra
+    replica back out. The row gates on the cycle actually happening:
+    >=1 scale-out, >=1 scale-in, fresh replicas warm from disk with zero
+    builds, zero drain failures, zero steady-state recompiles."""
+    import numpy as np
+
+    from nerf_replication_tpu.scale import Router, ScaleOptions, Supervisor
+
+    cfg, network, params, grid, bbox = _build_scale_shared(args)
+    fleet: list = []
+    spawn = _make_replica_factory(cfg, (network, params, grid, bbox), fleet)
+    opts = ScaleOptions(
+        min_replicas=1, max_replicas=max(2, args.replicas),
+        out_below=0.90, in_above=0.95, deny_above=1.0,
+        out_windows=2, in_windows=3,
+        cooldown_out_s=args.window_s, cooldown_in_s=args.window_s,
+        drain_timeout_s=60.0,
+    )
+    router = Router(heartbeat_timeout_s=10.0, clock=time.monotonic)
+    sup = Supervisor(router, spawn, options=opts)
+    print(f"scale: booting replica 0 (cold — compiles + serializes to "
+          f"{cfg.compile.dir})")
+    sup.ensure_min()
+    slo_s = args.slo_ms / 1e3
+    sustain_rate = args.sustain_rate or max(1.0, args.rate / 4.0)
+    rng = np.random.default_rng(args.seed)
+    windows: list = []
+    actions: list = []
+    first_out_i = None
+    phases = [("spike", args.rate, args.spike_windows),
+              ("sustain", sustain_rate, args.sustain_windows)]
+    for phase, rate, n_windows in phases:
+        for _ in range(n_windows):
+            router.sweep()
+            w = _drive_window(router, rng, rate, args.window_s, slo_s, args)
+            action = sup.step(w["attainment"])
+            actions.append(action)
+            if action == "out" and first_out_i is None:
+                first_out_i = len(windows)
+            w.update(phase=phase, rate=rate, action=action,
+                     n_ready=router.n_ready())
+            windows.append(w)
+            att = w["attainment"]
+            print(f"  [{phase}] offered={w['offered']} "
+                  f"attainment={'-' if att is None else f'{att:.3f}'} "
+                  f"p95={w['p95_ms']:.0f}ms shed={w['shed']} "
+                  f"late={w['late']} -> {action} "
+                  f"(replicas={w['n_ready']})")
+    # retire whatever still serves; spawned-but-drained batchers are done
+    for r in fleet:
+        if r.state in ("starting", "ready"):
+            r.drain(timeout_s=30.0)
+    compiles_steady = sum(
+        int(r.engine.tracker.total_compiles()) - r.warm_compiles
+        for r in fleet
+    )
+    fresh = fleet[1:]
+    spike_atts = [w["attainment"] for w in windows
+                  if w["phase"] == "spike" and w["attainment"] is not None]
+    post_atts = ([] if first_out_i is None else
+                 [w["attainment"] for w in windows[first_out_i + 1:]
+                  if w["attainment"] is not None])
+    row = {
+        "scale_mode": "open_loop",
+        "replicas_peak": max(w["n_ready"] for w in windows),
+        "attainment_low": min(spike_atts) if spike_atts else None,
+        "attainment_recovered": max(post_atts) if post_atts else None,
+        "scale_outs": actions.count("out"),
+        "scale_ins": actions.count("in"),
+        "replaces": actions.count("replace"),
+        "drain_failures": sup.drain_failures,
+        "n_replicas_spawned": len(fleet),
+        "warm_source_first": fleet[0].warm_source,
+        "warm_source_fresh": sorted({r.warm_source for r in fresh}),
+        "fresh_compiles": sum(r.warm_compiles for r in fresh),
+        "first_boot_s": round(fleet[0].boot_s, 3),
+        "fresh_boot_s": (round(max(r.boot_s for r in fresh), 3)
+                         if fresh else None),
+        "compiles_steady": compiles_steady,
+        "n_requests": sum(w["offered"] for w in windows),
+        "n_shed": sum(w["shed"] for w in windows),
+        "n_failed": sum(w["failed"] for w in windows),
+        "slo_ms": args.slo_ms,
+        "window_s": args.window_s,
+        "rate_spike": args.rate,
+        "rate_sustain": sustain_rate,
+        "windows": [
+            {k: w[k] for k in ("phase", "attainment", "n_ready", "action",
+                               "offered", "shed", "late")}
+            for w in windows
+        ],
+        "backend": args.backend,
+        "seed": args.seed,
+    }
+    failed = False
+    if row["scale_outs"] < 1 or row["scale_ins"] < 1:
+        print("WARNING: the run never completed a scale-out/scale-in cycle")
+        failed = True
+    if fresh and (row["warm_source_fresh"] != ["disk"]
+                  or row["fresh_compiles"] != 0):
+        print("WARNING: a fresh replica compiled instead of warm-starting "
+              f"from disk (sources {row['warm_source_fresh']}, "
+              f"{row['fresh_compiles']} builds)")
+        failed = True
+    if row["drain_failures"]:
+        print(f"WARNING: drain-before-retire failed "
+              f"{row['drain_failures']} in-flight requests")
+        failed = True
+    if compiles_steady:
+        print(f"WARNING: {compiles_steady} steady-state recompiles across "
+              "the replica fleet")
+        failed = True
+    if (row["attainment_low"] is not None
+            and row["attainment_recovered"] is not None
+            and row["attainment_recovered"] <= row["attainment_low"]):
+        print("WARNING: attainment never recovered after scale-out")
+        failed = True
+    return row, failed
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="serving-engine load generator")
     p.add_argument("--backend", default="cpu",
@@ -663,6 +948,26 @@ def main(argv=None) -> int:
                         "tenant in the contended phase")
     p.add_argument("--out-qos",
                    default=os.path.join(_REPO, "BENCH_QOS.jsonl"))
+    p.add_argument("--replicas", type=int, default=0,
+                   help="N > 0: replica scale-out mode — open-loop load "
+                        "through the scale/ router across a full "
+                        "scale-out/scale-in cycle, max N replicas "
+                        "(replaces other modes; docs/scaleout.md)")
+    p.add_argument("--window-s", type=float, default=2.0,
+                   help="scale mode: observation-window length (one "
+                        "supervisor decision per window)")
+    p.add_argument("--slo-ms", type=float, default=400.0,
+                   help="scale mode: per-request latency SLO the "
+                        "attainment windows are scored against")
+    p.add_argument("--spike-windows", type=int, default=4,
+                   help="scale mode: overload windows at --rate")
+    p.add_argument("--sustain-windows", type=int, default=6,
+                   help="scale mode: post-spike windows at --sustain-rate")
+    p.add_argument("--sustain-rate", type=float, default=0.0,
+                   help="scale mode: arrivals/s after the spike "
+                        "(0 = --rate / 4)")
+    p.add_argument("--out-scale",
+                   default=os.path.join(_REPO, "BENCH_SCALE.jsonl"))
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tracing", default="both",
                    choices=("both", "on", "off"),
@@ -689,6 +994,28 @@ def main(argv=None) -> int:
         get_emitter,
         get_tracer,
     )
+
+    if args.replicas > 0:
+        configure_tracing(enabled=False)  # scale mode prices capacity
+        try:
+            row, failed = _run_scale(args)
+            append_jsonl(args.out_scale, row)
+        finally:
+            get_emitter().close()
+        print(
+            f"scale[open_loop]: peak={row['replicas_peak']} replicas, "
+            f"attainment {row['attainment_low']} -> "
+            f"{row['attainment_recovered']}, "
+            f"{row['scale_outs']} out / {row['scale_ins']} in, "
+            f"fresh warm={row['warm_source_fresh']} "
+            f"({row['fresh_compiles']} builds, "
+            f"{row['fresh_boot_s']}s boot vs {row['first_boot_s']}s cold), "
+            f"drain_failures={row['drain_failures']}, "
+            f"recompiles_steady={row['compiles_steady']}"
+        )
+        print(f"row appended to {args.out_scale}; "
+              f"telemetry in {args.record_dir}")
+        return 1 if (failed and args.strict) else 0
 
     cfg, engine, batcher, warmup_s = _build_stack(args)
     print(f"engine warm: buckets {list(engine.buckets)}, "

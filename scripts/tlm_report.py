@@ -12,14 +12,18 @@ rows) additionally get a per-stage latency breakdown (queue → acquire →
 dispatch → device → scatter p50/p95) and the queue-wait share of the
 stage p95 total; fleet runs add a control-plane block — per-tenant
 admit/deny/shed mix, tier occupancy (HBM vs host-RAM staging), the
-demote-vs-cold reload split, and publish outcomes.
+demote-vs-cold reload split, and publish outcomes. Runs behind the
+scale-out front door (serve_bench/chaos_run ``--replicas``) add a
+scale section — replica lifecycle mix, supervisor decision mix with
+SLO-miss window count, and the router's failover/drain counters.
 ``--diff`` compares run A (baseline) against run B
 (candidate) and flags regressions past ``--gate`` percent (step-time
 p50, peak memory, queue-wait p95 share, tenant deny rate, staging
 re-promotion share) or any compile-count increase / PSNR drop > 0.1 dB
 / growth in unrecovered faults (exhausted retry ladders), breaker
-opens, cold scene loads, failed publishes, or fine-MLP evals/ray (the
-learned-sampling budget); with ``--gate`` the exit code is nonzero when
+opens, cold scene loads, failed publishes, fine-MLP evals/ray (the
+learned-sampling budget), SLO-miss windows, replica churn, or
+drain-failed requests; with ``--gate`` the exit code is nonzero when
 a regression is flagged, so a bench battery can use it as its gate
 against a saved baseline run (e.g. the run behind ``BASELINE.json``).
 
@@ -412,6 +416,50 @@ def summarize(rows: list[dict]) -> dict:
             q["p95_ms"] / p95_total if q and p95_total > 0 else None
         )
 
+    # replica scale-out rows (nerf_replication_tpu/scale): replica
+    # lifecycle events, the router's failover/dead-mark counters, and
+    # the supervisor's per-window decisions. ``slo_miss_windows`` counts
+    # every observation window that missed the SLO (out actions plus the
+    # miss-streak holds building toward one); ``replica_churn`` counts
+    # lifecycle transitions (spawn/retire/dead) — the two numbers the
+    # --diff gate holds. Keys present only when the stream carries
+    # scale rows (serve_bench --replicas / chaos_run --replicas).
+    replica_rows = [r for r in rows if r.get("kind") == "replica"]
+    router_rows = [r for r in rows if r.get("kind") == "router"]
+    decisions = [r for r in rows if r.get("kind") == "scale_decision"]
+    if replica_rows or router_rows or decisions:
+        by_event: dict = {}
+        for r in replica_rows:
+            k = r.get("event", "?")
+            by_event[k] = by_event.get(k, 0) + 1
+        summary["replica_events"] = by_event
+        summary["replica_churn"] = (by_event.get("spawn", 0)
+                                    + by_event.get("retire", 0)
+                                    + by_event.get("dead", 0))
+        summary["router_failovers"] = sum(
+            1 for r in router_rows if r.get("event") == "failover"
+        )
+        summary["router_dead_marked"] = sum(
+            1 for r in router_rows if r.get("event") == "dead"
+        )
+        summary["drain_failed_requests"] = sum(
+            int(r.get("n_failed") or 0)
+            for r in router_rows if r.get("event") == "drain"
+        )
+        by_action: dict = {}
+        for r in decisions:
+            k = r.get("action", "?")
+            by_action[k] = by_action.get(k, 0) + 1
+        summary["scale_decisions"] = by_action
+        summary["slo_miss_windows"] = sum(
+            1 for r in decisions
+            if r.get("reason") in ("slo_miss", "miss_streak")
+        )
+        peaks = [int(r["n_replicas"]) for r in decisions
+                 if r.get("n_replicas") is not None]
+        summary["replicas_peak"] = max(peaks) if peaks else None
+        summary["replicas_last"] = peaks[-1] if peaks else None
+
     # static-analysis rows (scripts/graftlint.py): the latest run's
     # new-vs-baselined split and rule mix — keys present only when the
     # stream carries lint_run rows (logs/graftlint/telemetry.jsonl)
@@ -577,6 +625,26 @@ def print_summary(summary: dict, label: str = "") -> None:
         if share is not None:
             print(f"    queue share: {share * 100:.1f}% of the stage "
                   f"p95 total")
+    if summary.get("replica_events") is not None or summary.get(
+            "scale_decisions") is not None:
+        ev_mix = " ".join(
+            f"{k}:{v}"
+            for k, v in sorted((summary.get("replica_events") or {}).items())
+        )
+        print(f"  scale-out:     churn {summary.get('replica_churn', 0)}"
+              + (f"  ({ev_mix})" if ev_mix else "")
+              + f"  peak replicas: "
+              + str(summary.get("replicas_peak") or "n/a"))
+        act_mix = " ".join(
+            f"{k}:{v}"
+            for k, v in sorted((summary.get("scale_decisions") or {}).items())
+        )
+        print(f"    decisions:   {act_mix or 'none'}"
+              f"  slo-miss windows: {summary.get('slo_miss_windows', 0)}")
+        print(f"    router:      {summary.get('router_failovers', 0)} "
+              f"failover(s), {summary.get('router_dead_marked', 0)} dead, "
+              f"{summary.get('drain_failed_requests', 0)} drain-failed "
+              f"request(s)")
     if summary.get("lint_runs"):
         rule_mix = " ".join(
             f"{k}:{v}"
@@ -683,6 +751,25 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
             f"queue-wait p95 share grew {a * 100:.1f}% -> {b * 100:.1f}% "
             f"of the stage tail"
         )
+    # a candidate missing its SLO in more observation windows than the
+    # baseline is a capacity or batching regression the supervisor had
+    # to paper over with spawns; replica churn growing means the fleet
+    # flapped (spawn/retire/dead cycles) where the baseline held steady —
+    # each flap costs a warm-start and a drain
+    a = base.get("slo_miss_windows") or 0
+    b = cand.get("slo_miss_windows")
+    if b is not None and b > a:
+        flags.append(f"SLO-miss windows grew {a} -> {b}")
+    a = base.get("replica_churn") or 0
+    b = cand.get("replica_churn")
+    if b is not None and b > a:
+        flags.append(f"replica churn grew {a} -> {b} "
+                     f"(fleet flapping: spawn/retire/dead cycles)")
+    a = base.get("drain_failed_requests") or 0
+    b = cand.get("drain_failed_requests")
+    if b is not None and b > a:
+        flags.append(f"drain-failed requests grew {a} -> {b} "
+                     f"(retirement dropped in-flight work)")
     # sweep efficiency DROPPING means the coarse DDA is admitting more
     # dead candidate rows into the sort per useful sample — a traversal
     # regression even when step time hasn't moved yet

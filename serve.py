@@ -28,8 +28,12 @@ API (all JSON):
 * ``GET /healthz`` — supervision view: queue depth, last-dispatch age,
   circuit-breaker state, worker liveness/restarts, plus the ``slo`` block
   (latency attainment vs. ``obs.slo_target_ms``, shed/timeout/error/
-  breaker rates from the live metrics registry). 200 while healthy,
+  breaker rates from the live metrics registry) and the ``replica``
+  block (id, warm-start source, compile count, resident scenes) that
+  scale-out heartbeats read (docs/scaleout.md). 200 while healthy,
   503 when the breaker is open or the worker cannot be kept alive.
+* ``POST /drain`` — drain-before-retire: stop admitting, render
+  everything queued, reply ``{drained, n_failed}`` (scale/replica.py).
 * ``GET /metrics`` — Prometheus text exposition of the live counters/
   gauges/histograms (obs/metrics.py): request counts by status and tier,
   queue depth, per-stage latency histograms fed by the span tracer.
@@ -152,6 +156,19 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
             if self.path == "/healthz":
                 health = batcher.health() if batcher is not None else {"ok": True}
                 health["slo"] = get_metrics().slo_view(slo_target_s)
+                # replica block: what scale/replica.py's ProcessReplica
+                # heartbeat reads (id from the supervisor's spawn env,
+                # warm-start provenance, resident scenes for affinity)
+                import os
+
+                stats = engine.stats() if hasattr(engine, "stats") else {}
+                health["replica"] = {
+                    "id": os.environ.get("SCALE_REPLICA_ID", ""),
+                    "warm_source": stats.get("warm_source"),
+                    "total_compiles": stats.get("total_compiles", 0),
+                    "scenes": (engine.resident_scenes()
+                               if hasattr(engine, "resident_scenes") else []),
+                }
                 return self._reply(200 if health["ok"] else 503, health)
             if self.path == "/stats":
                 stats = engine.stats()
@@ -171,6 +188,19 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
             return self._reply(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/drain":
+                # drain-before-retire entry (scale/replica.py): stop NEW
+                # admissions, render everything queued, report failures.
+                # Retirement (process exit) stays with the supervisor.
+                if batcher is None:
+                    return self._reply(200, {"drained": True, "n_failed": 0})
+                before = (batcher.n_timeouts + batcher.n_dispatch_errors
+                          + batcher.n_scene_errors)
+                batcher.close(drain=True)
+                failed = (batcher.n_timeouts + batcher.n_dispatch_errors
+                          + batcher.n_scene_errors) - before
+                return self._reply(200, {"drained": True,
+                                         "n_failed": int(failed)})
             if self.path != "/render":
                 return self._reply(404, {"error": f"no route {self.path}"})
             try:

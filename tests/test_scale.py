@@ -1,0 +1,527 @@
+"""Scale-out subsystem (nerf_replication_tpu/scale): the supervisor's
+decision table on synthetic metrics (scale-out on missed SLO, scale-in on
+sustained attainment, hysteresis band, cooldowns, dead-replica repair),
+the router's scene-affinity + least-loaded + failover behavior, the
+drain-before-retire zero-failure contract on a REAL MicroBatcher, and
+mesh-sharded dispatch bitwise parity on a forced size-1 mesh. All CPU,
+fake clocks/replicas — no processes, no real chips."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.obs import validate_row
+from nerf_replication_tpu.scale import (
+    InProcessReplica,
+    MeshDispatchError,
+    NoReplicaAvailableError,
+    ReplicaState,
+    ReplicaUnavailableError,
+    Router,
+    ScaleOptions,
+    Supervisor,
+    mesh_from_scale_cfg,
+    validate_mesh_buckets,
+)
+
+NEAR, FAR = 2.0, 6.0
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeReplica:
+    """The replica surface with scripted load/scenes/liveness."""
+
+    def __init__(self, rid, load=0, scenes=()):
+        self.replica_id = str(rid)
+        self.state = ReplicaState.READY
+        self._load = load
+        self.scenes = list(scenes)
+        self.beat_ok = True
+        self.fail_submit = False  # accepting but dies mid-submit
+        self.submits = []
+        self.drains = 0
+        self.drain_failures = 0
+
+    def accepting(self):
+        return self.state == ReplicaState.READY
+
+    def load(self):
+        return self._load
+
+    def heartbeat(self):
+        if not self.beat_ok:
+            raise RuntimeError("beat down")
+        return {"replica": self.replica_id, "state": self.state, "ok": True,
+                "load": self._load, "scenes": self.scenes,
+                "warm_source": "disk", "total_compiles": 0}
+
+    def submit(self, rays, near, far, scene=None, tenant=None):
+        if not self.accepting() or self.fail_submit:
+            raise ReplicaUnavailableError(f"{self.replica_id} {self.state}")
+        self.submits.append(scene)
+        return f"future:{self.replica_id}"
+
+    def drain(self, timeout_s=60.0):
+        self.state = ReplicaState.RETIRED
+        self.drains += 1
+        return self.drain_failures
+
+    def kill(self):
+        self.state = ReplicaState.DEAD
+
+
+def _router(clock, *replicas, timeout_s=10.0):
+    r = Router(heartbeat_timeout_s=timeout_s, clock=clock)
+    for rep in replicas:
+        r.register(rep)
+    r.sweep()  # populate beats (affinity reads the last beat)
+    return r
+
+
+# -- options -----------------------------------------------------------------
+
+
+def test_scale_options_from_cfg_reads_the_scale_block(tmp_path):
+    root = str(tmp_path / "scene")
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=2, n_test=1)
+    cfg = tiny_cfg(root, ["scale.max_replicas", "7",
+                          "scale.out_below", "0.8",
+                          "scale.mesh", "auto"])
+    opt = ScaleOptions.from_cfg(cfg)
+    assert opt.max_replicas == 7
+    assert opt.out_below == pytest.approx(0.8)
+    assert opt.mesh == "auto"
+    # untouched knobs keep the documented defaults
+    assert opt.in_above == pytest.approx(0.98)
+    assert opt.out_windows == 2
+
+
+# -- router: affinity, load, failover, heartbeats ----------------------------
+
+
+def test_router_prefers_scene_affinity_over_load():
+    clock = FakeClock()
+    busy_with_scene = FakeReplica("r0", load=9, scenes=["lego"])
+    idle_without = FakeReplica("r1", load=0)
+    router = _router(clock, busy_with_scene, idle_without)
+    assert router.pick("lego") is busy_with_scene
+    # no affinity anywhere -> least-loaded wins
+    assert router.pick("ship") is idle_without
+    assert router.pick(None) is idle_without
+
+
+def test_router_least_loaded_then_id_order():
+    clock = FakeClock()
+    a = FakeReplica("a", load=3)
+    b = FakeReplica("b", load=1)
+    c = FakeReplica("c", load=1)
+    router = _router(clock, a, b, c)
+    assert router.pick() is b  # load ties break on id
+
+
+def test_router_failover_skips_dying_replica_and_delivers():
+    clock = FakeClock()
+    first = FakeReplica("r0", load=0)
+    second = FakeReplica("r1", load=5)
+    router = _router(clock, first, second)
+    first.fail_submit = True  # dies between the pick and the submit
+    fut = router.submit(np.zeros((4, 6), np.float32), NEAR, FAR)
+    assert fut == "future:r1"
+    assert second.submits == [None]
+    assert router.n_failovers == 1
+    assert first.state == ReplicaState.DEAD  # marked at the failed submit
+
+
+def test_router_raises_when_every_replica_is_gone():
+    clock = FakeClock()
+    only = FakeReplica("r0")
+    router = _router(clock, only)
+    only.kill()
+    with pytest.raises(NoReplicaAvailableError):
+        router.submit(np.zeros((4, 6), np.float32), NEAR, FAR)
+
+
+def test_router_heartbeat_timeout_has_hysteresis():
+    clock = FakeClock()
+    rep = FakeReplica("r0")
+    router = _router(clock, rep, timeout_s=10.0)
+    rep.beat_ok = False
+    clock.advance(5.0)
+    out = router.sweep()  # inside the window: transient, NOT dead
+    assert out["dead"] == []
+    assert rep.state == ReplicaState.READY
+    clock.advance(6.0)
+    out = router.sweep()  # 11 s of failed beats: dead
+    assert out["dead"] == ["r0"]
+    assert rep.state == ReplicaState.DEAD
+
+
+def test_router_does_not_mark_draining_replica_dead_on_submit():
+    clock = FakeClock()
+    draining = FakeReplica("r0")
+    other = FakeReplica("r1")
+    router = _router(clock, draining, other)
+    draining.state = ReplicaState.DRAINING
+    router.submit(np.zeros((4, 6), np.float32), NEAR, FAR)
+    assert draining.state == ReplicaState.DRAINING  # retirement, not death
+
+
+# -- supervisor: the decision table ------------------------------------------
+
+
+def _supervisor(clock, n_start=1, **overrides):
+    opts = ScaleOptions(**{**dict(
+        min_replicas=1, max_replicas=4, out_below=0.90, in_above=0.98,
+        deny_above=0.05, out_windows=2, in_windows=3,
+        cooldown_out_s=30.0, cooldown_in_s=60.0,
+    ), **overrides})
+    router = Router(heartbeat_timeout_s=10.0, clock=clock)
+    spawned = []
+
+    def spawn_fn(i):
+        r = FakeReplica(f"s{i}")
+        spawned.append(r)
+        return r
+
+    sup = Supervisor(router, spawn_fn, options=opts, clock=clock)
+    for _ in range(n_start):
+        sup._spawn("test_boot")
+    return sup, router, spawned
+
+
+def test_scale_out_needs_consecutive_miss_windows():
+    clock = FakeClock()
+    sup, router, spawned = _supervisor(clock)
+    assert sup.step(0.5) == "hold"          # first miss: streak 1 of 2
+    assert router.n_ready() == 1
+    clock.advance(10.0)
+    assert sup.step(0.5) == "out"           # second consecutive miss
+    assert router.n_ready() == 2
+    assert sup.decisions[-1]["reason"] == "slo_miss"
+
+
+def test_single_miss_between_good_windows_never_scales():
+    clock = FakeClock()
+    sup, router, _ = _supervisor(clock)
+    for att in (0.99, 0.5, 0.99, 0.5, 0.99):
+        sup.step(att)
+        clock.advance(10.0)
+    assert router.n_ready() == 1  # streak never reached out_windows
+
+
+def test_scale_out_cooldown_blocks_back_to_back_spawns():
+    clock = FakeClock()
+    sup, router, _ = _supervisor(clock, cooldown_out_s=100.0)
+    sup.step(0.5)
+    assert sup.step(0.5) == "out"
+    assert router.n_ready() == 2
+    # still missing, streak re-fills, but the cooldown gate holds
+    assert sup.step(0.5) == "hold"
+    assert sup.step(0.5) == "hold"
+    assert router.n_ready() == 2
+    clock.advance(101.0)
+    assert sup.step(0.5) == "out"
+    assert router.n_ready() == 3
+
+
+def test_scale_out_respects_max_replicas():
+    clock = FakeClock()
+    sup, router, _ = _supervisor(clock, max_replicas=2, cooldown_out_s=0.0)
+    sup.step(0.5)
+    assert sup.step(0.5) == "out"
+    assert router.n_ready() == 2
+    sup.step(0.5)
+    assert sup.step(0.5) == "hold"  # at max: no spawn
+    assert router.n_ready() == 2
+
+
+def test_deny_rate_alone_triggers_scale_out():
+    clock = FakeClock()
+    sup, router, _ = _supervisor(clock)
+    sup.step(0.99, deny_rate=0.2)  # attainment fine, tenants denied
+    assert sup.step(0.99, deny_rate=0.2) == "out"
+    assert sup.decisions[-1]["reason"] == "deny_rate"
+
+
+def test_scale_in_on_sustained_attainment_drains_least_loaded():
+    clock = FakeClock()
+    sup, router, spawned = _supervisor(clock, n_start=2, in_windows=3,
+                                       cooldown_in_s=0.0)
+    spawned[0]._load = 4
+    spawned[1]._load = 0
+    sup.step(0.99)
+    sup.step(0.99)
+    assert sup.step(0.99) == "in"
+    assert spawned[1].state == ReplicaState.RETIRED  # least-loaded victim
+    assert spawned[0].state == ReplicaState.READY
+    assert spawned[1].drains == 1
+    assert sup.drain_failures == 0
+    assert router.n_ready() == 1
+
+
+def test_scale_in_respects_min_replicas():
+    clock = FakeClock()
+    sup, router, _ = _supervisor(clock, n_start=1, in_windows=2,
+                                 cooldown_in_s=0.0)
+    sup.step(0.99)
+    assert sup.step(0.99) == "hold"  # at min: the fleet never empties
+    assert router.n_ready() == 1
+
+
+def test_idle_fleet_counts_toward_scale_in():
+    clock = FakeClock()
+    sup, router, _ = _supervisor(clock, n_start=2, in_windows=2,
+                                 cooldown_in_s=0.0)
+    sup.step(None)  # no traffic at all
+    assert sup.step(None) == "in"
+    assert router.n_ready() == 1
+
+
+def test_hysteresis_band_resets_both_streaks():
+    clock = FakeClock()
+    sup, router, _ = _supervisor(clock, n_start=2, in_windows=2,
+                                 cooldown_in_s=0.0, cooldown_out_s=0.0)
+    sup.step(0.5)    # miss streak 1
+    sup.step(0.94)   # in the band (0.90..0.98): both streaks reset
+    assert sup.step(0.5) == "hold"   # miss streak restarts at 1
+    sup.step(0.99)   # good streak 1
+    sup.step(0.94)   # band again
+    assert sup.step(0.99) == "hold"  # good streak restarts at 1
+    assert router.n_ready() == 2     # nothing ever fired
+
+
+def test_replace_dead_runs_outside_cooldowns():
+    clock = FakeClock()
+    sup, router, spawned = _supervisor(clock, n_start=2,
+                                       cooldown_out_s=1e9)
+    victim = spawned[0]
+    victim.beat_ok = False
+    clock.advance(11.0)  # past heartbeat_timeout_s
+    assert sup.step(0.99) == "replace"
+    assert sup.n_replaced == 1
+    assert router.n_ready() == 2  # 1:1 replacement despite the cooldown
+    assert victim.replica_id not in [
+        r.replica_id for r in router.replicas()]
+
+
+def test_supervisor_decisions_are_valid_telemetry_rows():
+    clock = FakeClock()
+    sup, _, _ = _supervisor(clock)
+    sup.step(0.5)
+    sup.step(0.5)
+    sup.step(0.99)
+    for d in sup.decisions:
+        row = {"v": 1, "kind": "scale_decision", "t": 0.0, **d}
+        assert validate_row(row) == [], row
+
+
+# -- drain-before-retire on a REAL batcher -----------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_stack(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_scale"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4, n_test=1)
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "64",
+         "serve.buckets", "[128]",
+         "serve.max_batch_rays", "128",
+         "serve.max_delay_ms", "40.0",
+         "serve.request_timeout_s", "5.0",
+         "compile.aot", "False"],
+    )
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.models.nerf.network import init_params
+
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    return cfg, network, params, grid, bbox
+
+
+def _rays(n: int, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (n, 1)),
+         np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3))],
+        -1,
+    ).astype(np.float32)
+
+
+def test_drain_before_retire_fails_zero_in_flight(serve_stack):
+    from nerf_replication_tpu.serve import MicroBatcher, RenderEngine
+
+    cfg, network, params, grid, bbox = serve_stack
+    clock = FakeClock()
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox)
+    batcher = MicroBatcher(engine, clock=clock, start=False)
+    replica = InProcessReplica("r0", engine, batcher, clock=clock)
+    futures = [replica.submit(_rays(32, seed=i), NEAR, FAR)
+               for i in range(4)]
+    assert replica.load() == 4
+    clock.advance(1.0)  # past the delay edge so pump() cuts immediately
+    failed = replica.drain(timeout_s=30.0)
+    assert failed == 0                       # the contract
+    assert replica.state == ReplicaState.RETIRED
+    for f in futures:
+        out = f.result(timeout=0.1)          # every queued request rendered
+        assert out["rgb_map_f"].shape == (32, 3)
+    with pytest.raises(ReplicaUnavailableError):
+        replica.submit(_rays(8), NEAR, FAR)  # no admissions after retire
+
+
+def test_killed_replica_fails_queued_and_router_fails_over(serve_stack):
+    from nerf_replication_tpu.serve import MicroBatcher, RenderEngine
+    from nerf_replication_tpu.serve.batcher import ServeTimeoutError
+
+    cfg, network, params, grid, bbox = serve_stack
+    clock = FakeClock()
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox)
+    doomed = InProcessReplica(
+        "doomed", engine, MicroBatcher(engine, clock=clock, start=False),
+        clock=clock)
+    backup = InProcessReplica(
+        "backup", engine, MicroBatcher(engine, clock=clock, start=False),
+        clock=clock)
+    router = Router(heartbeat_timeout_s=10.0, clock=clock)
+    router.register(doomed)
+    router.register(backup)
+    router.sweep()
+    fut = doomed.submit(_rays(16), NEAR, FAR)
+    doomed.kill()
+    with pytest.raises(ServeTimeoutError):
+        fut.result(timeout=0.1)  # queued work fails AT the kill, not later
+    fut2 = router.submit(_rays(16), NEAR, FAR)  # front door fails over
+    clock.advance(1.0)
+    backup.batcher.pump()
+    assert fut2.result(timeout=0.1)["rgb_map_f"].shape == (16, 3)
+    assert router.n_failovers == 0  # dead replica never entered candidates
+    assert backup.stats()["n_submitted"] == 1
+
+
+# -- mesh-sharded dispatch ---------------------------------------------------
+
+
+def test_validate_mesh_buckets_rejects_indivisible_layouts():
+    class FakeMesh:
+        def __init__(self, n):
+            self.shape = {"data": n, "model": 1}
+
+    validate_mesh_buckets([128, 256], 64, FakeMesh(1))  # size 1: all fine
+    validate_mesh_buckets([128, 256], 16, FakeMesh(8))  # 8, 16 chunks % 8
+    with pytest.raises(MeshDispatchError):
+        validate_mesh_buckets([128, 256], 64, FakeMesh(3))  # 2, 4 chunks
+
+
+def test_mesh_mode_off_and_auto_single_device_disable_the_mesh(serve_stack):
+    cfg, *_ = serve_stack
+    root = cfg.train_dataset.data_root
+    assert mesh_from_scale_cfg(tiny_cfg(root)) is None  # default off
+    if len(jax.devices()) == 1:
+        assert mesh_from_scale_cfg(
+            tiny_cfg(root, ["scale.mesh", "auto"])) is None
+    with pytest.raises(MeshDispatchError):
+        mesh_from_scale_cfg(tiny_cfg(root, ["scale.mesh", "sideways"]))
+
+
+def test_mesh_forced_render_is_bitwise_equal_to_single_device(serve_stack):
+    """The acceptance contract: the SAME request through the mesh-sharded
+    executables composites bitwise-identically to the plain-jit path.
+    Under conftest's 8-device CPU emulation this is a real 8-way shard —
+    chunk 16 so the 128-ray bucket holds 8 chunks, one per device."""
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.models.nerf.network import init_params
+    from nerf_replication_tpu.serve import RenderEngine
+
+    base_cfg, _, _, grid, bbox = serve_stack
+    cfg = tiny_cfg(
+        base_cfg.train_dataset.data_root,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "16",
+         "serve.buckets", "[128]",
+         "serve.max_batch_rays", "128",
+         "compile.aot", "False",
+         "scale.mesh", "force"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    mesh = mesh_from_scale_cfg(cfg)
+    assert mesh is not None and int(mesh.size) == len(jax.devices())
+    plain = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                         grid=grid, bbox=bbox)
+    sharded = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                           grid=grid, bbox=bbox, mesh=mesh)
+    assert sharded.stats()["mesh"]["devices"] == len(jax.devices())
+    for n in (37, 100, 128):
+        rays = _rays(n)
+        for tier in ("full", "bf16", "reduced_k", "coarse"):
+            a = plain.render_request(rays, NEAR, FAR, tier=tier, emit=False)
+            b = sharded.render_request(rays, NEAR, FAR, tier=tier,
+                                       emit=False)
+            for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+                assert np.array_equal(np.asarray(a[k]),
+                                      np.asarray(b[k])), (tier, k, n)
+    # zero steady-state recompiles through the mesh path
+    before = sharded.tracker.total_compiles()
+    for n in (1, 64, 128, 200):
+        rays = np.tile(_rays(1), (n, 1))
+        sharded.render_request(rays, NEAR, FAR, tier="full", emit=False)
+    assert sharded.tracker.total_compiles() == before
+
+
+# -- telemetry schema --------------------------------------------------------
+
+
+def test_scale_row_kinds_validate():
+    rows = [
+        {"v": 1, "kind": "replica", "t": 0.0, "replica": "r0",
+         "event": "spawn", "state": "ready", "warm_source": "disk",
+         "total_compiles": 0, "scenes": ["lego"], "n_ready": 2},
+        {"v": 1, "kind": "router", "t": 0.0, "event": "failover",
+         "replica": "r0", "scene": "lego", "n_candidates": 1},
+        {"v": 1, "kind": "router", "t": 0.0, "event": "drain",
+         "replica": "r1", "load": 3, "n_failed": 0},
+        {"v": 1, "kind": "scale_decision", "t": 0.0, "action": "out",
+         "reason": "slo_miss", "n_replicas": 2, "attainment": 0.8,
+         "deny_rate": 0.0, "streak": 2, "replica": "s1"},
+    ]
+    for row in rows:
+        assert validate_row(row) == [], row
+    bad = {"v": 1, "kind": "scale_decision", "t": 0.0, "action": "out"}
+    assert validate_row(bad) != []  # reason + n_replicas are required
+
+
+def test_scale_mode_bench_family_validates():
+    from nerf_replication_tpu.obs.schema import validate_bench_row
+
+    row = {"scale_mode": "open_loop", "replicas_peak": 3,
+           "attainment_low": 0.6, "attainment_recovered": 0.97,
+           "scale_outs": 2, "scale_ins": 1}
+    assert validate_bench_row(row) == [], row

@@ -24,6 +24,7 @@ from .metrics import MetricsRegistry, get_metrics, reset_metrics
 from .profiling import ProfileWindow, annotate
 from .schema import SCHEMA_VERSION, validate_bench_row, validate_row
 from .trace import (
+    TRACE_HEADER,
     Span,
     SpanContext,
     Tracer,
@@ -31,10 +32,12 @@ from .trace import (
     current_ctx,
     current_span,
     get_tracer,
+    trace_headers,
 )
 
 __all__ = [
     "SCHEMA_VERSION",
+    "TRACE_HEADER",
     "Emitter",
     "MetricsRegistry",
     "NullEmitter",
@@ -54,6 +57,7 @@ __all__ = [
     "init_run",
     "reset_metrics",
     "sample_memory",
+    "trace_headers",
     "validate_bench_row",
     "validate_row",
 ]

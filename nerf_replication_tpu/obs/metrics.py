@@ -46,18 +46,24 @@ def _label_str(key: tuple) -> str:
 
 
 class _Histogram:
-    __slots__ = ("buckets", "counts", "total", "count")
+    __slots__ = ("buckets", "counts", "total", "count", "exemplars")
 
     def __init__(self, buckets: tuple[float, ...]):
         self.buckets = buckets
         self.counts = [0] * (len(buckets) + 1)  # +1: the +Inf bucket
         self.total = 0.0
         self.count = 0
+        # bucket index -> (trace_id, value): last-seen sampling keeps the
+        # exemplar fresh at O(1) with no reservoir bookkeeping
+        self.exemplars: dict[int, tuple[str, float]] = {}
 
-    def observe(self, value: float) -> None:
-        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+    def observe(self, value: float, trace_id: str | None = None) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        self.counts[i] += 1
         self.total += value
         self.count += 1
+        if trace_id is not None:
+            self.exemplars[i] = (str(trace_id), float(value))
 
     def cumulative(self) -> list[int]:
         out, acc = [], 0
@@ -89,13 +95,17 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[k] = float(value)
 
-    def observe(self, name: str, value: float, **labels) -> None:
+    def observe(self, name: str, value: float, trace_id: str | None = None,
+                **labels) -> None:
+        """Histogram update; ``trace_id`` (when the caller is inside a
+        traced request) is kept as the bucket's exemplar — the join key
+        from an aggregate back to one concrete trace."""
         k = (name, _label_key(labels))
         with self._lock:
             h = self._hists.get(k)
             if h is None:
                 h = self._hists[k] = _Histogram(self.buckets)
-            h.observe(float(value))
+            h.observe(float(value), trace_id=trace_id)
 
     # -- reads ---------------------------------------------------------------
 
@@ -104,7 +114,8 @@ class MetricsRegistry:
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
-            hists = {k: (h.buckets, h.cumulative(), h.total, h.count)
+            hists = {k: (h.buckets, h.cumulative(), h.total, h.count,
+                         dict(h.exemplars))
                      for k, h in self._hists.items()}
         lines: list[str] = []
         seen: set[str] = set()
@@ -118,18 +129,26 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {name} gauge")
                 seen.add(name)
             lines.append(f"{name}{_label_str(key)} {_fmt(val)}")
-        for (name, key), (buckets, cum, total, count) in sorted(hists.items()):
+        for (name, key), (buckets, cum, total, count, ex) in sorted(
+                hists.items()):
             if name not in seen:
                 lines.append(f"# TYPE {name} histogram")
                 seen.add(name)
-            for edge, c in zip(buckets, cum):
+            for i, (edge, c) in enumerate(zip(buckets, cum)):
                 le = dict(key)
                 le["le"] = _fmt(edge)
-                lines.append(f"{name}_bucket{_label_str(_label_key(le))} {c}")
+                line = f"{name}_bucket{_label_str(_label_key(le))} {c}"
+                if i in ex:  # OpenMetrics exemplar suffix
+                    tid, val = ex[i]
+                    line += f' # {{trace_id="{tid}"}} {_fmt(val)}'
+                lines.append(line)
             inf = dict(key)
             inf["le"] = "+Inf"
-            lines.append(
-                f"{name}_bucket{_label_str(_label_key(inf))} {cum[-1]}")
+            line = f"{name}_bucket{_label_str(_label_key(inf))} {cum[-1]}"
+            if len(buckets) in ex:
+                tid, val = ex[len(buckets)]
+                line += f' # {{trace_id="{tid}"}} {_fmt(val)}'
+            lines.append(line)
             lines.append(f"{name}_sum{_label_str(key)} {_fmt(total)}")
             lines.append(f"{name}_count{_label_str(key)} {count}")
         return "\n".join(lines) + "\n"
@@ -148,10 +167,42 @@ class MetricsRegistry:
                         "counts": list(h.counts),
                         "sum": h.total,
                         "count": h.count,
+                        "exemplars": {
+                            str(i): {"trace_id": t, "value": v}
+                            for i, (t, v) in sorted(h.exemplars.items())
+                        },
                     }
                     for (n, k), h in self._hists.items()
                 },
             }
+
+    def slo_miss_exemplars(self, target_s: float, limit: int = 8,
+                           name: str = "serve_request_latency_seconds",
+                           ) -> list[str]:
+        """Exemplar trace ids of requests that missed the latency target:
+        the evidence a ``scale_decision`` row links to. Reads exemplars
+        from every bucket whose edge is >= ``target_s``; when no miss has
+        an exemplar (yet), falls back to the slowest exemplars seen so an
+        observed fleet always yields at least one join key."""
+        miss: list[tuple[float, str]] = []
+        seen_any: list[tuple[float, str]] = []
+        with self._lock:
+            for (n, _key), h in self._hists.items():
+                if n != name:
+                    continue
+                lo = bisect.bisect_left(h.buckets, target_s)
+                for i, (tid, val) in h.exemplars.items():
+                    seen_any.append((val, tid))
+                    if i >= lo:
+                        miss.append((val, tid))
+        pool = miss if miss else seen_any
+        out: list[str] = []
+        for _val, tid in sorted(pool, reverse=True):
+            if tid not in out:
+                out.append(tid)
+            if len(out) >= limit:
+                break
+        return out
 
     def slo_view(self, target_s: float) -> dict:
         """Attainment vs. the latency target + failure rates, aggregated

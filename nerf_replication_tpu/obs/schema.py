@@ -206,7 +206,11 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
     # is on the tracer's clock (perf_counter), NOT unix time — only
     # differences and within-run ordering are meaningful. stage tags the
     # latency taxonomy (queue | acquire | load | dispatch | device |
-    # scatter); joined/source attribute prefetch joins in fleet residency.
+    # scatter | route | failover); joined/source attribute prefetch joins
+    # in fleet residency. remote_parent marks a span whose parent ctx was
+    # restored from a Traceparent header (the cross-process join point —
+    # trace_view --fleet resolves it in the merged file set, so it is not
+    # an orphan); replica names the process that emitted the span.
     "span": (
         {"trace_id": (str,), "span_id": (str,), "name": (str,),
          "start_s": _NUM, "dur_s": _NUM},
@@ -214,7 +218,8 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
          "tier": (str,), "scene": (str, type(None)), "status": (str,),
          "tenant": (str, type(None)), "n_rays": _NUM, "n_requests": _NUM,
          "joined": (str,), "source": (str,), "family": (str,),
-         "bucket": _NUM, "queue_depth": _NUM, "detail": (str,)},
+         "bucket": _NUM, "queue_depth": _NUM, "detail": (str,),
+         "remote_parent": (bool, int), "replica": (str,)},
     ),
     # one per live-aggregation dump (obs/metrics.py snapshot()): the
     # counters/gauges/histograms behind GET /metrics, serialized for
@@ -250,11 +255,15 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
     ),
     # one per supervisor evaluation window: the closed loop's reasoning
     # (action: out | in | replace | hold) against the SLO attainment and
-    # tenant deny-rate signals, with the hysteresis streak that led to it
+    # tenant deny-rate signals, with the hysteresis streak that led to it.
+    # evidence links the decision to what the loop saw: the attainment
+    # series, per-replica queue depths, the deny rate, and exemplar trace
+    # ids of SLO-missing requests (deep-checked by validate_row) — every
+    # out/in must name its evidence, not just assert a miss.
     "scale_decision": (
         {"action": (str,), "reason": (str,), "n_replicas": _NUM},
         {"attainment": _OPT_NUM, "deny_rate": _NUM, "streak": _NUM,
-         "replica": (str,)},
+         "replica": (str,), "evidence": (dict,)},
     ),
     # -- static analysis (nerf_replication_tpu/analysis) ---------------------
     # one per scripts/graftlint.py run: finding counts split new-vs-baseline
@@ -300,6 +309,58 @@ def validate_row(row) -> list[str]:
             errors.append(
                 f"{kind}: field {field!r} is {type(value).__name__}"
             )
+    if kind == "span":
+        errors += _validate_span_ctx(row)
+    elif kind == "scale_decision" and isinstance(row.get("evidence"), dict):
+        errors += _validate_evidence(row["evidence"])
+    return errors
+
+
+def _validate_span_ctx(row: dict) -> list[str]:
+    """Deep checks for the propagated span context: ids must stay
+    alphanumeric (the Traceparent header joins them with a dash), and a
+    remote-parented span must actually name its parent."""
+    errors = []
+    for field in ("trace_id", "span_id"):
+        val = row.get(field)
+        if isinstance(val, str) and not val.isalnum():
+            errors.append(
+                f"span: {field} {val!r} is not alphanumeric "
+                "(breaks Traceparent propagation)"
+            )
+    if row.get("remote_parent") and not isinstance(row.get("parent_id"), str):
+        errors.append("span: remote_parent set but parent_id missing")
+    return errors
+
+
+def _validate_evidence(ev: dict) -> list[str]:
+    """Deep checks for a scale_decision evidence block (the shape the
+    supervisor commits and docs/scaleout.md documents)."""
+    errors = []
+    series = ev.get("attainment_series")
+    if not isinstance(series, list) or not all(
+            isinstance(a, (*_NUM, type(None))) for a in series):
+        errors.append("scale_decision: evidence.attainment_series must be "
+                      "a list of numbers/nulls")
+    depths = ev.get("queue_depths")
+    if not isinstance(depths, dict) or not all(
+            isinstance(k, str) and isinstance(v, _NUM)
+            for k, v in (depths or {}).items()):
+        errors.append("scale_decision: evidence.queue_depths must map "
+                      "replica id -> depth")
+    if not isinstance(ev.get("deny_rate"), _NUM):
+        errors.append("scale_decision: evidence.deny_rate must be numeric")
+    tids = ev.get("exemplar_trace_ids")
+    if not isinstance(tids, list) or not all(
+            isinstance(t, str) and t.isalnum() for t in tids):
+        errors.append("scale_decision: evidence.exemplar_trace_ids must be "
+                      "a list of alphanumeric trace ids")
+    known = {"attainment_series", "queue_depths", "deny_rate",
+             "exemplar_trace_ids", "window"}
+    for field in ev:
+        if field not in known:
+            errors.append(
+                f"scale_decision: unknown evidence field {field!r}")
     return errors
 
 

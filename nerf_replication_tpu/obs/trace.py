@@ -40,22 +40,61 @@ from .emit import get_emitter
 # sentinel: "inherit the calling thread's current span as parent"
 _INHERIT = object()
 
+# the HTTP header that carries a span context across a process boundary
+# (W3C-traceparent-shaped: one value, ids joined by a dash)
+TRACE_HEADER = "Traceparent"
+
 _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "obs_trace_current", default=None
 )
 
 
 class SpanContext:
-    """The portable half of a span: what crosses a thread seam."""
+    """The portable half of a span: what crosses a thread seam — or, via
+    :meth:`to_header` / :meth:`from_header`, a process boundary."""
 
-    __slots__ = ("trace_id", "span_id")
+    __slots__ = ("trace_id", "span_id", "remote")
 
-    def __init__(self, trace_id: str, span_id: str):
+    def __init__(self, trace_id: str, span_id: str, remote: bool = False):
         self.trace_id = trace_id
         self.span_id = span_id
+        # True when this ctx was restored from a header: children record
+        # ``remote_parent`` so fleet merges can tell propagated parents
+        # from locally-missing ones
+        self.remote = bool(remote)
+
+    def to_header(self) -> str:
+        """``trace_id-span_id`` — ids are alphanumeric by construction
+        (hex counters, sanitized prefixes), so the dash is unambiguous."""
+        return f"{self.trace_id}-{self.span_id}"
+
+    @classmethod
+    def from_header(cls, value: str | None) -> "SpanContext | None":
+        """Parse a :data:`TRACE_HEADER` value; None on anything
+        malformed (propagation must never fail a request)."""
+        if not value or not isinstance(value, str):
+            return None
+        trace_id, sep, span_id = value.strip().rpartition("-")
+        if not sep or not trace_id or not span_id:
+            return None
+        if not (trace_id.isalnum() and span_id.isalnum()):
+            return None
+        return cls(trace_id, span_id, remote=True)
 
     def __repr__(self) -> str:  # debugging aid only
-        return f"SpanContext({self.trace_id}/{self.span_id})"
+        flag = "!remote" if self.remote else ""
+        return f"SpanContext({self.trace_id}/{self.span_id}{flag})"
+
+
+def trace_headers(ctx: "SpanContext | None" = None) -> dict[str, str]:
+    """Headers to stamp on an outbound fleet HTTP call: the given ctx
+    (or the calling thread's current one) as :data:`TRACE_HEADER`, or
+    ``{}`` when there is nothing to propagate."""
+    if ctx is None:
+        ctx = current_ctx()
+    if ctx is None:
+        return {}
+    return {TRACE_HEADER: ctx.to_header()}
 
 
 class Span:
@@ -104,18 +143,25 @@ class Tracer:
     """Span factory + sink fan-out. One per process via :func:`get_tracer`;
     tests construct their own with a fake clock for determinism."""
 
-    def __init__(self, enabled: bool = False, clock=time.perf_counter):
+    def __init__(self, enabled: bool = False, clock=time.perf_counter,
+                 id_prefix: str = ""):
         self.enabled = bool(enabled)
         self.clock = clock
+        # ids must stay alphanumeric (the header joins them with a dash,
+        # from_header splits on it) — strip anything else from the prefix
+        self.id_prefix = "".join(c for c in str(id_prefix) if c.isalnum())
         self._ids = itertools.count(1)
         self._id_lock = threading.Lock()
         self._sinks: list = []
+        self.n_spans = 0
+        self.n_remote_parented = 0
+        self.n_dropped_sink = 0
 
     # -- ids / clock ---------------------------------------------------------
 
     def _next_id(self) -> str:
         with self._id_lock:
-            return f"{next(self._ids):08x}"
+            return f"{self.id_prefix}{next(self._ids):08x}"
 
     def now(self) -> float:
         """The tracer's clock — call sites stamp seam-crossing times with
@@ -136,16 +182,18 @@ class Tracer:
 
     # -- span lifecycle ------------------------------------------------------
 
-    def _resolve_parent(self, parent) -> tuple[str, str | None]:
-        """(trace_id, parent_span_id) for a new span. ``parent`` is the
-        _INHERIT sentinel (use this thread's current span), None (new
-        root/trace), or an explicit SpanContext carried across a seam."""
+    def _resolve_parent(self, parent) -> tuple[str, str | None, bool]:
+        """(trace_id, parent_span_id, remote) for a new span. ``parent``
+        is the _INHERIT sentinel (use this thread's current span), None
+        (new root/trace), or an explicit SpanContext carried across a
+        seam — possibly one restored from a :data:`TRACE_HEADER`."""
         if parent is _INHERIT:
             cur = _current.get()
             parent = cur.context if cur is not None else None
         if parent is None:
-            return self._next_id(), None
-        return parent.trace_id, parent.span_id
+            return self._next_id(), None, False
+        return (parent.trace_id, parent.span_id,
+                bool(getattr(parent, "remote", False)))
 
     @contextmanager
     def span(self, name: str, *, parent=_INHERIT, **attrs):
@@ -156,9 +204,11 @@ class Tracer:
         if not self.enabled:
             yield _NULL_SPAN
             return
-        trace_id, parent_id = self._resolve_parent(parent)
+        trace_id, parent_id, remote = self._resolve_parent(parent)
         ctx = SpanContext(trace_id, self._next_id())
         sp = Span(self, name, ctx, parent_id, self.clock(), dict(attrs))
+        if remote:
+            sp.attrs.setdefault("remote_parent", True)
         token = _current.set(sp)
         try:
             yield sp
@@ -176,9 +226,11 @@ class Tracer:
         at cut time, scatter measured per-request inside the batch)."""
         if not self.enabled:
             return
-        trace_id, parent_id = self._resolve_parent(parent)
+        trace_id, parent_id, remote = self._resolve_parent(parent)
         ctx = SpanContext(trace_id, self._next_id())
         sp = Span(self, name, ctx, parent_id, start_s, dict(attrs))
+        if remote:
+            sp.attrs.setdefault("remote_parent", True)
         if dur_s is None:
             dur_s = (end_s if end_s is not None else self.clock()) - start_s
         self._finish(sp, start_s + max(0.0, dur_s))
@@ -194,6 +246,9 @@ class Tracer:
             "thread": threading.current_thread().name,
             **sp.attrs,
         }
+        self.n_spans += 1
+        if row.get("remote_parent"):
+            self.n_remote_parented += 1
         # graftlint: ok(emit-hot: span finish is the telemetry boundary itself, host-side after dispatch)
         get_emitter().emit("span", **row)
         stage = row.get("stage")
@@ -204,7 +259,21 @@ class Tracer:
             get_metrics().observe("serve_stage_seconds", row["dur_s"],
                                   stage=str(stage))
         for sink in list(self._sinks):
-            sink(row)
+            try:
+                sink(row)
+            # graftlint: ok(swallow: a broken sink must not fail the traced request; the drop is counted and surfaced via stats()/healthz)
+            except Exception:
+                self.n_dropped_sink += 1
+
+    def stats(self) -> dict:
+        """Tracing health for ``/healthz`` and heartbeats: spans emitted,
+        sink drops, and how many spans parented under a remote ctx."""
+        return {
+            "enabled": self.enabled,
+            "spans": self.n_spans,
+            "dropped_sink": self.n_dropped_sink,
+            "remote_parented": self.n_remote_parented,
+        }
 
 
 def current_ctx() -> SpanContext | None:
@@ -228,9 +297,13 @@ def get_tracer() -> Tracer:
     return _tracer
 
 
-def configure_tracing(enabled: bool = True, clock=None) -> Tracer:
+def configure_tracing(enabled: bool = True, clock=None,
+                      id_prefix: str = "") -> Tracer:
     """Replace the process tracer (serve.py startup, test setup). A fresh
-    tracer resets the id counter — deterministic ids per configure."""
+    tracer resets the id counter — deterministic ids per configure.
+    ``id_prefix`` (e.g. the replica id) keeps span ids unique across the
+    fleet so a ``--fleet`` merge joins on propagated ids collision-free."""
     global _tracer
-    _tracer = Tracer(enabled=enabled, clock=clock or time.perf_counter)
+    _tracer = Tracer(enabled=enabled, clock=clock or time.perf_counter,
+                     id_prefix=id_prefix)
     return _tracer

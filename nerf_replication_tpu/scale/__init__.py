@@ -19,9 +19,20 @@ heavy-traffic shape the ROADMAP north star asks for:
 * **Supervisor** (:mod:`.supervisor`) — a closed loop that spawns and
   retires replicas against SLO attainment and per-tenant deny rate,
   with hysteresis, cooldowns, and min/max bounds from the ``scale:``
-  config block.
+  config block. With an evidence source attached (the fleet metrics
+  aggregator), every decision row links to the attainment series, queue
+  depths, and exemplar trace ids it acted on.
+* **Fleet metrics** (:mod:`.fleet_metrics`) — per-replica ``/metrics``
+  scrapes merged into one Prometheus body with a ``replica`` label
+  (``GET /fleet/metrics``), plus the fleet SLO view the supervisor
+  reads — one signal for the loop and the operator both.
 """
 
+from .fleet_metrics import (
+    FleetMetricsAggregator,
+    make_fleet_server,
+    merge_scrapes,
+)
 from .mesh_dispatch import (
     MeshDispatchError,
     mesh_from_scale_cfg,
@@ -39,6 +50,7 @@ from .router import NoReplicaAvailableError, Router
 from .supervisor import Supervisor
 
 __all__ = [
+    "FleetMetricsAggregator",
     "InProcessReplica",
     "MeshDispatchError",
     "NoReplicaAvailableError",
@@ -48,6 +60,8 @@ __all__ = [
     "Router",
     "ScaleOptions",
     "Supervisor",
+    "make_fleet_server",
+    "merge_scrapes",
     "mesh_from_scale_cfg",
     "mesh_jit",
     "validate_mesh_buckets",

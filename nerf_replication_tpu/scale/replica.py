@@ -27,6 +27,7 @@ import time
 
 from ..obs import get_emitter
 from ..obs.metrics import get_metrics
+from ..obs.trace import trace_headers
 
 
 class ReplicaState:
@@ -74,7 +75,12 @@ class InProcessReplica:
     def accepting(self) -> bool:
         return self.state == ReplicaState.READY
 
-    def submit(self, rays, near, far, scene=None, tenant=None):
+    # the router passes the routed request's SpanContext explicitly
+    # (InProcessReplica shares the router's process, so "propagation" is
+    # an argument, not a header) — see Router.submit
+    accepts_ctx = True
+
+    def submit(self, rays, near, far, scene=None, tenant=None, ctx=None):
         """Enqueue on this replica's batcher (router-facing). Raises
         :class:`ReplicaUnavailableError` when not accepting, so the
         router's failover loop moves on without losing the request."""
@@ -84,7 +90,7 @@ class InProcessReplica:
             )
         self.n_submitted += 1
         return self.batcher.submit(rays, near, far, scene=scene,
-                                   tenant=tenant)
+                                   tenant=tenant, ctx=ctx)
 
     def load(self) -> int:
         """Routing load signal: requests queued and not yet completed."""
@@ -149,6 +155,17 @@ class InProcessReplica:
         # with no worker thread it just never joins one
         self.batcher.close(drain=False)
 
+    # -- fleet metrics --------------------------------------------------------
+
+    def metrics_source_id(self) -> str:
+        """In-process replicas all write the PROCESS registry — the fleet
+        aggregator dedups scrapes on this id so N in-process replicas
+        contribute one copy, not N."""
+        return "process"
+
+    def scrape_metrics(self) -> str:
+        return get_metrics().render_prometheus()
+
     def stats(self) -> dict:
         return {
             "replica": self.replica_id,
@@ -203,8 +220,13 @@ class ProcessReplica:
         import json
         import urllib.request
 
-        url = f"http://{self.host}:{self.port}{path}"
-        with urllib.request.urlopen(url, timeout=timeout) as r:
+        # every fleet HTTP call carries the caller's span ctx (no-op
+        # headers outside a traced request) — the child parents under it
+        req = urllib.request.Request(
+            f"http://{self.host}:{self.port}{path}",
+            headers=trace_headers(),
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read().decode())
 
     def accepting(self) -> bool:
@@ -250,6 +272,9 @@ class ProcessReplica:
             "scenes": list(rep.get("scenes", [])),
             "warm_source": rep.get("warm_source"),
             "total_compiles": int(rep.get("total_compiles", 0)),
+            # tracing health rides the heartbeat for free (spans emitted,
+            # sink drops, remote-parented count) — serve.py /healthz
+            "trace": dict(rep.get("trace", {})),
         }
 
     def submit(self, rays, near, far, scene=None, tenant=None):
@@ -258,6 +283,46 @@ class ProcessReplica:
             "ray-level submit is the in-process surface"
         )
 
+    def render(self, body: dict, timeout_s: float = 30.0) -> dict:
+        """``POST /render`` one pose request, stamping the caller's span
+        ctx as the :data:`~..obs.trace.TRACE_HEADER` — the child's
+        ``serve.request`` span parents under the router's dispatch span,
+        which is what makes one routed request ONE trace."""
+        import json
+        import urllib.request
+
+        if not self.accepting():
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id} is {self.state}"
+            )
+        self.n_submitted += 1
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            f"http://{self.host}:{self.port}/render",
+            data=data, method="POST",
+            headers={"Content-Type": "application/json", **trace_headers()},
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    # -- fleet metrics --------------------------------------------------------
+
+    def metrics_source_id(self) -> str:
+        """Each child process owns its registry — scrape every one."""
+        return self.replica_id
+
+    def scrape_metrics(self, timeout: float = 2.0) -> str:
+        """Raw ``GET /metrics`` text from the child (Prometheus
+        exposition; exemplar suffixes included) for the fleet merge."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{self.host}:{self.port}/metrics",
+            headers=trace_headers(),
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read().decode()
+
     def drain(self, timeout_s: float = 60.0) -> int:
         self.state = ReplicaState.DRAINING
         _emit_lifecycle(self.replica_id, "drain", state=self.state)
@@ -265,9 +330,11 @@ class ProcessReplica:
             import urllib.request
 
             req = urllib.request.Request(
-                f"http://{self.host}:{self.port}/drain", method="POST"
+                f"http://{self.host}:{self.port}/drain", method="POST",
+                headers=trace_headers(),
             )
-            urllib.request.urlopen(req, timeout=timeout_s)
+            with urllib.request.urlopen(req, timeout=timeout_s):
+                pass  # response body unused; the with closes the socket
         # graftlint: ok(swallow: best-effort drain request; the wait-loop below is the authority)
         except Exception:
             pass  # the wait-loop below is the authority

@@ -27,6 +27,7 @@ import time
 
 from ..obs import get_emitter
 from ..obs.metrics import get_metrics
+from ..obs.trace import get_tracer
 from .replica import ReplicaState, ReplicaUnavailableError
 
 
@@ -140,49 +141,132 @@ class Router:
             )
         return cands[0][3]
 
+    def _no_replica(self, scene) -> NoReplicaAvailableError:
+        get_emitter().emit("router", event="no_replica",
+                           **({} if scene is None
+                              else {"scene": str(scene)}))
+        get_metrics().counter("scale_router_events_total",
+                              event="no_replica")
+        return NoReplicaAvailableError(
+            f"no accepting replica among {len(self._entries)} registered"
+        )
+
+    def _record_failover(self, trs, replica, exc, n_left, scene,
+                         t0: float) -> None:
+        self.n_failovers += 1
+        self._mark_dead(replica, f"submit: {exc}")
+        get_emitter().emit(
+            "router", event="failover",
+            replica=replica.replica_id,
+            n_candidates=n_left,
+            **({} if scene is None else {"scene": str(scene)}),
+        )
+        trs.record("route.failover", start_s=t0, stage="failover",
+                   replica=replica.replica_id,
+                   status=f"error:{type(exc).__name__}")
+        get_metrics().counter("scale_router_events_total",
+                              event="failover")
+
     def submit(self, rays, near, far, scene=None, tenant=None):
         """One request through the front door: pick, submit, fail over.
 
         A replica that refuses (draining/closed/dead) is skipped; one
         that dies mid-submit is marked dead and the NEXT candidate gets
-        the request — the caller never sees a failover."""
-        cands = self._candidates(scene)
-        if not cands:
-            get_emitter().emit("router", event="no_replica",
-                               **({} if scene is None
-                                  else {"scene": str(scene)}))
+        the request — the caller never sees a failover.
+
+        Runs under a ``route.submit`` span (stage ``route``) covering
+        pick + enqueue; the replica's queue/batch/scatter spans parent
+        under it (in-process: the ctx is passed as an argument), so a
+        routed request stays ONE trace."""
+        trs = get_tracer()
+        with trs.span("route.submit", stage="route",
+                      **({} if scene is None
+                         else {"scene": str(scene)})) as sp:
+            cands = self._candidates(scene)
+            if not cands:
+                raise self._no_replica(scene)
+            last_exc: Exception | None = None
+            for i, (no_aff, load, _rid, replica) in enumerate(cands):
+                t_try = trs.now()
+                try:
+                    # FakeReplica doubles in tests predate the ctx
+                    # argument — only replicas advertising accepts_ctx
+                    # get the explicit SpanContext
+                    if getattr(replica, "accepts_ctx", False):
+                        future = replica.submit(rays, near, far, scene=scene,
+                                                tenant=tenant, ctx=sp.ctx)
+                    else:
+                        future = replica.submit(rays, near, far, scene=scene,
+                                                tenant=tenant)
+                except (ReplicaUnavailableError, RuntimeError) as exc:
+                    # RuntimeError covers a closed batcher (a racing
+                    # kill/retire): treat both as this-replica failures
+                    last_exc = exc
+                    self._record_failover(trs, replica, exc,
+                                          len(cands) - i - 1, scene, t_try)
+                    continue
+                self.n_dispatches += 1
+                if not no_aff:
+                    self.n_affinity_hits += 1
+                sp.set(replica=replica.replica_id)
+                get_metrics().counter("scale_router_dispatch_total",
+                                      replica=replica.replica_id)
+                return future
             raise NoReplicaAvailableError(
-                f"no accepting replica among {len(self._entries)} registered"
-            )
-        last_exc: Exception | None = None
-        for i, (no_aff, load, _rid, replica) in enumerate(cands):
-            try:
-                future = replica.submit(rays, near, far, scene=scene,
-                                        tenant=tenant)
-            except (ReplicaUnavailableError, RuntimeError) as exc:
-                # RuntimeError covers a closed batcher (a racing
-                # kill/retire): treat both as this-replica failures
-                last_exc = exc
-                self.n_failovers += 1
-                self._mark_dead(replica, f"submit: {exc}")
-                get_emitter().emit(
-                    "router", event="failover",
-                    replica=replica.replica_id,
-                    n_candidates=len(cands) - i - 1,
-                    **({} if scene is None else {"scene": str(scene)}),
-                )
-                get_metrics().counter("scale_router_events_total",
-                                      event="failover")
-                continue
-            self.n_dispatches += 1
-            if not no_aff:
-                self.n_affinity_hits += 1
-            get_metrics().counter("scale_router_dispatch_total",
-                                  replica=replica.replica_id)
-            return future
-        raise NoReplicaAvailableError(
-            f"all {len(cands)} accepting replicas failed the submit"
-        ) from last_exc
+                f"all {len(cands)} accepting replicas failed the submit"
+            ) from last_exc
+
+    def render(self, body: dict, scene=None, timeout_s: float = 30.0) -> dict:
+        """Route one whole-pose request to an HTTP replica (the
+        :class:`~.replica.ProcessReplica` surface): pick, POST /render
+        with the span ctx stamped as the Traceparent header, fail over on
+        a 5xx/transport failure. The root ``route.submit`` span plus a
+        ``route.dispatch`` span per attempt make the router's share of
+        the wall time explicit in the merged fleet trace."""
+        import urllib.error
+
+        trs = get_tracer()
+        scene = scene if scene is not None else body.get("scene")
+        with trs.span("route.submit",
+                      **({} if scene is None
+                         else {"scene": str(scene)})) as root:
+            cands = [c for c in self._candidates(scene)
+                     if hasattr(c[3], "render")]
+            if not cands:
+                raise self._no_replica(scene)
+            last_exc: Exception | None = None
+            for i, (no_aff, _load, _rid, replica) in enumerate(cands):
+                t_try = trs.now()
+                try:
+                    # route.dispatch wraps the whole HTTP round trip; the
+                    # child's serve.request parents under ITS ctx via the
+                    # propagated header
+                    with trs.span("route.dispatch", stage="route",
+                                  replica=replica.replica_id):
+                        out = replica.render(body, timeout_s=timeout_s)
+                except urllib.error.HTTPError as exc:
+                    if exc.code < 500:
+                        raise  # the request is bad, not the replica
+                    last_exc = exc
+                    self._record_failover(trs, replica, exc,
+                                          len(cands) - i - 1, scene, t_try)
+                    continue
+                except (ReplicaUnavailableError, urllib.error.URLError,
+                        OSError) as exc:
+                    last_exc = exc
+                    self._record_failover(trs, replica, exc,
+                                          len(cands) - i - 1, scene, t_try)
+                    continue
+                self.n_dispatches += 1
+                if not no_aff:
+                    self.n_affinity_hits += 1
+                root.set(replica=replica.replica_id)
+                get_metrics().counter("scale_router_dispatch_total",
+                                      replica=replica.replica_id)
+                return out
+            raise NoReplicaAvailableError(
+                f"all {len(cands)} accepting replicas failed the render"
+            ) from last_exc
 
     # -- retirement -----------------------------------------------------------
 
@@ -205,6 +289,16 @@ class Router:
                            load=load_before, n_failed=int(failed))
         get_metrics().counter("scale_router_events_total", event="drain")
         return failed
+
+    def load_view(self) -> dict[str, int]:
+        """Per-replica queue depth from the last heartbeat round — the
+        ``queue_depths`` half of a scale decision's evidence block."""
+        out: dict[str, int] = {}
+        for entry in self._entries.values():
+            load = entry.beat.get("load")
+            if load is not None:
+                out[entry.replica.replica_id] = int(load)
+        return out
 
     def stats(self) -> dict:
         per = {}

@@ -30,9 +30,11 @@ gate on grown SLO-miss windows / replica churn.
 from __future__ import annotations
 
 import time
+from collections import deque
 
 from ..obs import get_emitter
 from ..obs.metrics import get_metrics
+from ..resil import dump_flight, note_flight
 from .options import ScaleOptions
 from .replica import ReplicaState
 
@@ -42,14 +44,26 @@ class Supervisor:
 
     ``spawn_fn(index) -> replica`` builds a new replica (serve_bench
     passes an engine factory against the shared artifact dir; tests pass
-    fakes). The supervisor registers what it spawns."""
+    fakes). The supervisor registers what it spawns.
+
+    ``evidence_source`` (optional) links every decision to what the loop
+    saw: any object with ``slo_miss_exemplars(target_s)`` — the process
+    :class:`~..obs.metrics.MetricsRegistry` or a fleet
+    :class:`~.fleet_metrics.FleetMetricsAggregator`. With it attached,
+    each ``scale_decision`` row carries an ``evidence`` block (attainment
+    series, per-replica queue depths, deny rate, exemplar trace ids of
+    SLO-missing requests) and every out/in dumps a
+    ``flight_scale_<dir>.json`` naming that evidence."""
 
     def __init__(self, router, spawn_fn, options: ScaleOptions | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, evidence_source=None,
+                 slo_target_s: float = 0.25):
         self.router = router
         self.spawn_fn = spawn_fn
         self.options = options or ScaleOptions()
         self.clock = clock
+        self.evidence_source = evidence_source
+        self.slo_target_s = float(slo_target_s)
         self._spawn_index = 0
         self._out_streak = 0
         self._in_streak = 0
@@ -57,6 +71,8 @@ class Supervisor:
         # may act immediately
         self._last_out_t = -float("inf")
         self._last_in_t = -float("inf")
+        self._attainment_history: deque = deque(maxlen=16)
+        self._last_deny_rate = 0.0
         self.n_spawned = 0
         self.n_retired = 0
         self.n_replaced = 0
@@ -122,6 +138,25 @@ class Supervisor:
 
     # -- the decision loop ----------------------------------------------------
 
+    def _evidence(self) -> dict | None:
+        """The metric-window snapshot a decision links to (None when no
+        evidence source is attached — the pre-PR-15 decision shape)."""
+        if self.evidence_source is None:
+            return None
+        try:
+            tids = list(self.evidence_source.slo_miss_exemplars(
+                self.slo_target_s))
+        # graftlint: ok(swallow: evidence must never fail the decision that cites it; an empty id list is itself visible to the --diff gate)
+        except Exception:
+            tids = []
+        return {
+            "attainment_series": [None if a is None else round(float(a), 4)
+                                  for a in self._attainment_history],
+            "queue_depths": self.router.load_view(),
+            "deny_rate": round(float(self._last_deny_rate), 4),
+            "exemplar_trace_ids": tids,
+        }
+
     def _decide(self, action: str, reason: str, *, attainment=None,
                 deny_rate=None, streak=0, replica=None) -> str:
         n = self.router.n_ready()
@@ -133,11 +168,29 @@ class Supervisor:
             row["deny_rate"] = float(deny_rate)
         if replica is not None:
             row["replica"] = str(replica)
+        evidence = self._evidence()
+        if evidence is not None:
+            row["evidence"] = evidence
         self.decisions.append(row)
         get_emitter().emit("scale_decision", **row)
         mx = get_metrics()
         mx.counter("scale_decisions_total", action=action)
         mx.gauge("scale_replicas_ready", n)
+        if action in ("out", "in"):
+            # the post-mortem trail: the flight ring gets the decision
+            # with its evidence, then flight_scale_<dir>.json snapshots
+            # the spans (the exemplar traces among them) at the moment
+            # the loop acted
+            note_flight(point="scale.decision", action=action,
+                        reason=reason, n_replicas=n,
+                        **({} if replica is None
+                           else {"replica": str(replica)}),
+                        **({} if evidence is None
+                           else {"evidence": evidence}))
+            dump_flight(f"scale_{action}",
+                        detail=f"{reason}; exemplars="
+                               + ",".join((evidence or {}).get(
+                                   "exemplar_trace_ids", [])[:4]))
         return action
 
     def step(self, attainment: float | None, deny_rate: float = 0.0) -> str:
@@ -147,6 +200,9 @@ class Supervisor:
         counts toward scale-IN: an idle fleet should shrink)."""
         opt = self.options
         now = self.clock()
+        self._attainment_history.append(
+            None if attainment is None else float(attainment))
+        self._last_deny_rate = float(deny_rate)
         if self.replace_dead():
             return "replace"
         missing = (attainment is not None and attainment < opt.out_below)
@@ -199,6 +255,21 @@ class Supervisor:
             attainment=attainment, deny_rate=deny_rate,
             streak=max(self._out_streak, self._in_streak),
         )
+
+    def step_from_fleet(self, aggregator) -> str:
+        """One window read straight off the fleet aggregator — the loop
+        acts on the SAME merged signal ``GET /fleet/metrics`` shows the
+        operator. A window where nothing completed (attainment None) but
+        replicas hold queued work is total overload, not idleness: it
+        counts as attainment 0.0 so the loop scales OUT instead of
+        reading a wedged fleet as a shrink signal."""
+        w = aggregator.window()
+        attainment = w["attainment"]
+        if attainment is None:
+            backlog = sum(aggregator.router.load_view().values())
+            if backlog > 0 or w.get("no_replica", 0) > 0:
+                attainment = 0.0
+        return self.step(attainment, deny_rate=w["deny_rate"])
 
     def stats(self) -> dict:
         return {

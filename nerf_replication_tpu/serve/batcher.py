@@ -199,8 +199,14 @@ class MicroBatcher:
     # -- submission -----------------------------------------------------------
 
     def submit(self, rays, near, far, scene: str | None = None,
-               tenant: str | None = None) -> ServeFuture:
+               tenant: str | None = None, ctx=None) -> ServeFuture:
         """Enqueue a [N, C] ray request; returns a future.
+
+        ``ctx`` (a :class:`~..obs.trace.SpanContext`) explicitly parents
+        the request's spans when the submitter is NOT on the traced
+        thread — an in-process replica relaying a routed request passes
+        the router's ctx here; default None captures the calling
+        thread's current span as before.
 
         Bounds are validated HERE (BakedBoundsError raises to the caller
         synchronously) so a bad request never occupies queue capacity,
@@ -247,7 +253,8 @@ class MicroBatcher:
             )
         trs = get_tracer()
         pending = _Pending(rays, ServeFuture(rays.shape[0]), self.clock(),
-                           scene=scene, tenant=tenant, ctx=current_ctx(),
+                           scene=scene, tenant=tenant,
+                           ctx=ctx if ctx is not None else current_ctx(),
                            t_trace=trs.now())
         with self._cond:
             if self._stop:
@@ -424,6 +431,8 @@ class MicroBatcher:
                            tier="none")
                 # graftlint: ok(emit-hot: timeout fail-fast path, not per-ray work)
                 mx.observe("serve_request_latency_seconds", waited,
+                           trace_id=(p.ctx.trace_id if p.ctx is not None
+                                     else None),
                            tier="none")
             else:
                 live.append(p)
@@ -624,9 +633,13 @@ class MicroBatcher:
             # graftlint: ok(emit-hot: per-request counter+histogram, lock-cheap post-sync)
             mx.counter("serve_requests_total", status="ok", tier=tier,
                        **t_labels)
+            # the request's trace_id rides the bucket as an exemplar:
+            # scale_decision evidence joins from aggregate to trace here
             # graftlint: ok(emit-hot: per-request counter+histogram, lock-cheap post-sync)
-            mx.observe("serve_request_latency_seconds", latency_s, tier=tier,
-                       **t_labels)
+            mx.observe("serve_request_latency_seconds", latency_s,
+                       trace_id=(p.ctx.trace_id if p.ctx is not None
+                                 else None),
+                       tier=tier, **t_labels)
             p.future.set_result(sliced)
         # graftlint: ok(emit-hot: one gauge store per batch)
         mx.gauge("serve_queue_depth", queue_depth)

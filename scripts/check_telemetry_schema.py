@@ -15,7 +15,12 @@ four written by scripts/serve_bench.py), and the learned sampler's
 scripts/bench_sampling.py) via the ``BENCH_*.jsonl`` pattern.
 
 Files named ``telemetry*.jsonl`` are checked row-by-row against the typed
-telemetry schema (``obs/schema.py:ROW_KINDS``); every other JSONL is
+telemetry schema (``obs/schema.py:ROW_KINDS``) — including the fleet-obs
+deep checks: span rows' propagated-context fields (alnum trace/span ids;
+``remote_parent`` only ever alongside a parent id) and ``scale_decision``
+rows' ``evidence`` block (attainment series, per-replica queue depths,
+deny rate, alnum exemplar trace ids — unknown evidence keys are
+errors). Every other JSONL is
 checked structurally against the known bench row families — so a bench
 script that drifts shape (the pre-PR-1 failure mode: three incompatible
 row families grew across ten scripts) fails here instead of silently

@@ -60,12 +60,19 @@ tracing-off arm of the same mode — acceptance wants ≤ 5%).
   from a SHARED ``.aot`` artifact dir. A spike phase overloads one
   replica until SLO attainment trips the supervisor's scale-out, then a
   sustain phase idles the fleet back down through drain-before-retire —
-  one full scale-out/scale-in cycle per run. The summary row (family
+  one full scale-out/scale-in cycle per run. The supervisor reads its
+  attainment/deny-rate windows off the fleet metrics aggregator
+  (``Supervisor.step_from_fleet``) — the same merged signal
+  ``GET /fleet/metrics`` exposes — and each out/in decision carries that
+  window as evidence. ``--tracing both`` runs the cycle twice (off, then
+  on — the shared artifact dir makes the second arm warm) and prices the
+  fleet tracing as ``trace_overhead_pct``. The summary row (family
   ``scale_mode``, appended to ``BENCH_SCALE.jsonl``) gates on attainment
   recovering after scale-out, the FRESH replica reporting
   ``warm_source == "disk"`` with zero compiles (artifact warm-start, not
-  a recompile), zero drain failures, and ``compiles_steady == 0`` across
-  the whole cycle.
+  a recompile), zero drain failures, ``compiles_steady == 0`` across
+  the whole cycle, and — with tracing on — every capacity action citing
+  >= 1 exemplar trace id.
 
     python scripts/serve_bench.py --backend cpu
     python scripts/serve_bench.py --backend cpu --mode open --rate 200
@@ -794,7 +801,12 @@ def _run_scale(args) -> tuple[dict, bool]:
     builds, zero drain failures, zero steady-state recompiles."""
     import numpy as np
 
-    from nerf_replication_tpu.scale import Router, ScaleOptions, Supervisor
+    from nerf_replication_tpu.scale import (
+        FleetMetricsAggregator,
+        Router,
+        ScaleOptions,
+        Supervisor,
+    )
 
     cfg, network, params, grid, bbox = _build_scale_shared(args)
     fleet: list = []
@@ -807,23 +819,32 @@ def _run_scale(args) -> tuple[dict, bool]:
         drain_timeout_s=60.0,
     )
     router = Router(heartbeat_timeout_s=10.0, clock=time.monotonic)
-    sup = Supervisor(router, spawn, options=opts)
+    slo_s = args.slo_ms / 1e3
+    # the supervisor reads the SAME merged signal GET /fleet/metrics
+    # shows the operator, and cites it: every out/in decision row carries
+    # the aggregator's attainment window + SLO-miss exemplar trace ids
+    agg = FleetMetricsAggregator(router, slo_target_s=slo_s)
+    sup = Supervisor(router, spawn, options=opts,
+                     evidence_source=agg, slo_target_s=slo_s)
     print(f"scale: booting replica 0 (cold — compiles + serializes to "
           f"{cfg.compile.dir})")
     sup.ensure_min()
-    slo_s = args.slo_ms / 1e3
+    # prime the delta baseline: the process registry is cumulative across
+    # tracing arms, and the first window must not inherit earlier arms
+    agg.window()
     sustain_rate = args.sustain_rate or max(1.0, args.rate / 4.0)
     rng = np.random.default_rng(args.seed)
     windows: list = []
     actions: list = []
     first_out_i = None
+    t_cycle = time.perf_counter()
     phases = [("spike", args.rate, args.spike_windows),
               ("sustain", sustain_rate, args.sustain_windows)]
     for phase, rate, n_windows in phases:
         for _ in range(n_windows):
             router.sweep()
             w = _drive_window(router, rng, rate, args.window_s, slo_s, args)
-            action = sup.step(w["attainment"])
+            action = sup.step_from_fleet(agg)
             actions.append(action)
             if action == "out" and first_out_i is None:
                 first_out_i = len(windows)
@@ -836,6 +857,7 @@ def _run_scale(args) -> tuple[dict, bool]:
                   f"p95={w['p95_ms']:.0f}ms shed={w['shed']} "
                   f"late={w['late']} -> {action} "
                   f"(replicas={w['n_ready']})")
+    wall_s = time.perf_counter() - t_cycle
     # retire whatever still serves; spawned-but-drained batchers are done
     for r in fleet:
         if r.state in ("starting", "ready"):
@@ -850,6 +872,10 @@ def _run_scale(args) -> tuple[dict, bool]:
     post_atts = ([] if first_out_i is None else
                  [w["attainment"] for w in windows[first_out_i + 1:]
                   if w["attainment"] is not None])
+    acted = [d for d in sup.decisions if d["action"] in ("out", "in")]
+    with_ev = [d for d in acted
+               if (d.get("evidence") or {}).get("exemplar_trace_ids")]
+    done_total = sum(w["done"] for w in windows)
     row = {
         "scale_mode": "open_loop",
         "replicas_peak": max(w["n_ready"] for w in windows),
@@ -870,6 +896,10 @@ def _run_scale(args) -> tuple[dict, bool]:
         "n_requests": sum(w["offered"] for w in windows),
         "n_shed": sum(w["shed"] for w in windows),
         "n_failed": sum(w["failed"] for w in windows),
+        "rps": done_total / wall_s if wall_s else 0.0,
+        "actions_with_evidence": len(with_ev),
+        "actions_evidence_free": len(acted) - len(with_ev),
+        "fleet_scrape_rounds": agg.stats()["n_scrape_rounds"],
         "slo_ms": args.slo_ms,
         "window_s": args.window_s,
         "rate_spike": args.rate,
@@ -996,24 +1026,61 @@ def main(argv=None) -> int:
     )
 
     if args.replicas > 0:
-        configure_tracing(enabled=False)  # scale mode prices capacity
+        # tracing arms like the closed/open modes: the off arm prices raw
+        # capacity, the on arm prices the fleet-tracing instrumentation
+        # (trace_overhead_pct) and must link every capacity action to
+        # exemplar evidence. The shared AOT dir persists across arms, so
+        # only the first arm's replica 0 pays the cold compile.
+        arms = {"both": (False, True), "off": (False,), "on": (True,)}[
+            args.tracing]
+        failed = False
+        rps_off = None
         try:
-            row, failed = _run_scale(args)
-            append_jsonl(args.out_scale, row)
+            for traced in arms:
+                configure_tracing(enabled=traced)
+                spans: list = []
+                if traced:
+                    get_tracer().add_sink(spans.append)
+                row, arm_failed = _run_scale(args)
+                failed = failed or arm_failed
+                row["tracing"] = int(traced)
+                if traced:
+                    row.update(_stage_summary(spans))
+                    if rps_off:
+                        row["trace_overhead_pct"] = (
+                            (rps_off - row["rps"]) / rps_off * 100.0
+                        )
+                    if row["actions_evidence_free"]:
+                        print(f"WARNING: {row['actions_evidence_free']} "
+                              "capacity action(s) carried no exemplar "
+                              "evidence with tracing on")
+                        failed = True
+                else:
+                    rps_off = row["rps"]
+                append_jsonl(args.out_scale, row)
+                extra = ""
+                if traced and row.get("trace_overhead_pct") is not None:
+                    extra = (f" trace_overhead="
+                             f"{row['trace_overhead_pct']:+.1f}%")
+                print(
+                    f"scale[tracing {'on' if traced else 'off'}]: "
+                    f"peak={row['replicas_peak']} replicas, "
+                    f"attainment {row['attainment_low']} -> "
+                    f"{row['attainment_recovered']}, "
+                    f"{row['scale_outs']} out / {row['scale_ins']} in, "
+                    f"fresh warm={row['warm_source_fresh']} "
+                    f"({row['fresh_compiles']} builds, "
+                    f"{row['fresh_boot_s']}s boot vs "
+                    f"{row['first_boot_s']}s cold), "
+                    f"evidence={row['actions_with_evidence']}/"
+                    f"{row['actions_with_evidence'] + row['actions_evidence_free']}, "
+                    f"drain_failures={row['drain_failures']}, "
+                    f"recompiles_steady={row['compiles_steady']}" + extra
+                )
         finally:
+            configure_tracing(enabled=False)
             get_emitter().close()
-        print(
-            f"scale[open_loop]: peak={row['replicas_peak']} replicas, "
-            f"attainment {row['attainment_low']} -> "
-            f"{row['attainment_recovered']}, "
-            f"{row['scale_outs']} out / {row['scale_ins']} in, "
-            f"fresh warm={row['warm_source_fresh']} "
-            f"({row['fresh_compiles']} builds, "
-            f"{row['fresh_boot_s']}s boot vs {row['first_boot_s']}s cold), "
-            f"drain_failures={row['drain_failures']}, "
-            f"recompiles_steady={row['compiles_steady']}"
-        )
-        print(f"row appended to {args.out_scale}; "
+        print(f"rows appended to {args.out_scale}; "
               f"telemetry in {args.record_dir}")
         return 1 if (failed and args.strict) else 0
 

@@ -9,8 +9,11 @@ compile seconds), peak device memory / host RSS, final PSNR, and — when
 the run carries resil rows — injected/detected faults, retry-ladder
 outcomes, and circuit-breaker opens. Runs that traced (obs/trace.py span
 rows) additionally get a per-stage latency breakdown (queue → acquire →
-dispatch → device → scatter p50/p95) and the queue-wait share of the
-stage p95 total; fleet runs add a control-plane block — per-tenant
+dispatch → device → scatter p50/p95), the queue-wait share of the
+stage p95 total, and a fleet-trace block — orphan-span rate,
+remote-parent resolution (Traceparent propagation health), and the
+per-replica stage breakdown joined on propagated trace ids; fleet runs
+add a control-plane block — per-tenant
 admit/deny/shed mix, tier occupancy (HBM vs host-RAM staging), the
 demote-vs-cold reload split, and publish outcomes. Runs behind the
 scale-out front door (serve_bench/chaos_run ``--replicas``) add a
@@ -22,8 +25,9 @@ p50, peak memory, queue-wait p95 share, tenant deny rate, staging
 re-promotion share) or any compile-count increase / PSNR drop > 0.1 dB
 / growth in unrecovered faults (exhausted retry ladders), breaker
 opens, cold scene loads, failed publishes, fine-MLP evals/ray (the
-learned-sampling budget), SLO-miss windows, replica churn, or
-drain-failed requests; with ``--gate`` the exit code is nonzero when
+learned-sampling budget), SLO-miss windows, replica churn, drain-failed
+requests, orphan-span rate, or evidence-free scale actions; with
+``--gate`` the exit code is nonzero when
 a regression is flagged, so a bench battery can use it as its gate
 against a saved baseline run (e.g. the run behind ``BASELINE.json``).
 
@@ -416,6 +420,55 @@ def summarize(rows: list[dict]) -> dict:
             q["p95_ms"] / p95_total if q and p95_total > 0 else None
         )
 
+    # fleet-trace health (obs/trace.py Traceparent propagation): how well
+    # the span tree holds together. An ORPHAN is a span whose parent id
+    # appears nowhere in this stream AND that is not remote-parented —
+    # remote-parented spans with an absent parent are the expected shape
+    # of a single replica's file (the parent lives in the router's file;
+    # trace_view --fleet resolves them across files). Keys present only
+    # when the run traced.
+    if span_rows:
+        ids = {r.get("span_id") for r in span_rows}
+        orphans = 0
+        remote = 0
+        remote_resolved = 0
+        for r in span_rows:
+            parent = r.get("parent_id")
+            if r.get("remote_parent"):
+                remote += 1
+                if parent in ids:
+                    remote_resolved += 1
+                continue
+            if parent is not None and parent not in ids:
+                orphans += 1
+        summary["trace_orphans"] = orphans
+        summary["trace_orphan_rate"] = orphans / len(span_rows)
+        summary["trace_remote_parented"] = remote
+        summary["trace_remote_resolved"] = remote_resolved
+        # per-replica stage breakdown: route.dispatch/route.submit spans
+        # carry the replica they landed on; their trace ids attribute the
+        # replica-side stage spans of the same request
+        trace_replica: dict = {}
+        for r in span_rows:
+            if r.get("replica") and r.get("trace_id"):
+                trace_replica.setdefault(r["trace_id"], str(r["replica"]))
+        if trace_replica:
+            per_replica: dict = {}
+            for r in stage_rows:
+                rid = r.get("replica") or trace_replica.get(r.get("trace_id"))
+                if rid is None:
+                    continue
+                per_replica.setdefault(str(rid), []).append(
+                    float(r["dur_s"]))
+            summary["fleet_stage_by_replica"] = {
+                rid: {
+                    "n": len(durs),
+                    "p50_ms": _percentile(durs, 50) * 1e3,
+                    "p95_ms": _percentile(durs, 95) * 1e3,
+                }
+                for rid, durs in sorted(per_replica.items())
+            }
+
     # replica scale-out rows (nerf_replication_tpu/scale): replica
     # lifecycle events, the router's failover/dead-mark counters, and
     # the supervisor's per-window decisions. ``slo_miss_windows`` counts
@@ -459,6 +512,17 @@ def summarize(rows: list[dict]) -> dict:
                  if r.get("n_replicas") is not None]
         summary["replicas_peak"] = max(peaks) if peaks else None
         summary["replicas_last"] = peaks[-1] if peaks else None
+        # evidence linkage: every out/in should carry the metric-window
+        # snapshot it acted on (attainment series, queue depths, exemplar
+        # trace ids). An EVIDENCE-FREE action is a capacity change the
+        # post-mortem cannot reconstruct — the count --diff gates on.
+        acted = [r for r in decisions if r.get("action") in ("out", "in")]
+        with_ev = [r for r in acted
+                   if isinstance(r.get("evidence"), dict)
+                   and r["evidence"].get("exemplar_trace_ids")]
+        summary["scale_actions"] = len(acted)
+        summary["scale_actions_with_evidence"] = len(with_ev)
+        summary["scale_actions_evidence_free"] = len(acted) - len(with_ev)
 
     # static-analysis rows (scripts/graftlint.py): the latest run's
     # new-vs-baselined split and rule mix — keys present only when the
@@ -625,6 +689,15 @@ def print_summary(summary: dict, label: str = "") -> None:
         if share is not None:
             print(f"    queue share: {share * 100:.1f}% of the stage "
                   f"p95 total")
+    if summary.get("trace_orphan_rate") is not None:
+        print(f"  fleet trace:   orphans {summary['trace_orphans']} "
+              f"({summary['trace_orphan_rate'] * 100:.1f}% of spans)  "
+              f"remote parents {summary['trace_remote_parented']} "
+              f"({summary['trace_remote_resolved']} resolved in-stream)")
+        by_rep = summary.get("fleet_stage_by_replica") or {}
+        for rid, v in by_rep.items():
+            print(f"    {rid:<12} {v['n']} stage span(s)  "
+                  f"p50 {v['p50_ms']:.2f} ms  p95 {v['p95_ms']:.2f} ms")
     if summary.get("replica_events") is not None or summary.get(
             "scale_decisions") is not None:
         ev_mix = " ".join(
@@ -641,6 +714,13 @@ def print_summary(summary: dict, label: str = "") -> None:
         )
         print(f"    decisions:   {act_mix or 'none'}"
               f"  slo-miss windows: {summary.get('slo_miss_windows', 0)}")
+        if summary.get("scale_actions"):
+            print(f"    evidence:    "
+                  f"{summary['scale_actions_with_evidence']}/"
+                  f"{summary['scale_actions']} capacity action(s) carry "
+                  f"exemplar-linked evidence "
+                  f"({summary['scale_actions_evidence_free']} "
+                  f"evidence-free)")
         print(f"    router:      {summary.get('router_failovers', 0)} "
               f"failover(s), {summary.get('router_dead_marked', 0)} dead, "
               f"{summary.get('drain_failed_requests', 0)} drain-failed "
@@ -770,6 +850,26 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     if b is not None and b > a:
         flags.append(f"drain-failed requests grew {a} -> {b} "
                      f"(retirement dropped in-flight work)")
+    # a candidate orphaning a larger share of its spans has broken trace
+    # propagation somewhere — requests whose trees no longer reconstruct.
+    # The 0.02 absolute floor keeps near-zero baselines from flagging on
+    # a single torn span.
+    a = base.get("trace_orphan_rate")
+    b = cand.get("trace_orphan_rate")
+    if (b is not None and (b - (a or 0.0)) > 0.02
+            and (not a or pct(a, b) > gate_pct)):
+        flags.append(
+            f"trace orphan-span rate grew {(a or 0.0) * 100:.1f}% -> "
+            f"{b * 100:.1f}% (broken span propagation)"
+        )
+    # a capacity action without its evidence block is a scale decision
+    # the post-mortem cannot tie to the window that caused it — any
+    # growth means a supervisor ran detached from the fleet aggregator
+    a = base.get("scale_actions_evidence_free") or 0
+    b = cand.get("scale_actions_evidence_free")
+    if b is not None and b > a:
+        flags.append(f"evidence-free scale actions grew {a} -> {b} "
+                     f"(decisions detached from the fleet signal)")
     # sweep efficiency DROPPING means the coarse DDA is admitting more
     # dead candidate rows into the sort per useful sample — a traversal
     # regression even when step time hasn't moved yet

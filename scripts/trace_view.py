@@ -3,6 +3,8 @@
     python scripts/trace_view.py data/record/lego/telemetry.jsonl
     python scripts/trace_view.py flight_breaker_open.json --out trace.json
     python scripts/trace_view.py telemetry.jsonl --trace 00000001
+    python scripts/trace_view.py --fleet router/telemetry.jsonl \
+        replica0/telemetry.jsonl replica1/telemetry.jsonl
 
 Reads spans from either source — a run's ``telemetry.jsonl`` (rows with
 ``kind: span``) or a flight-recorder dump (its ``spans`` list) — and
@@ -12,8 +14,18 @@ queue → acquire → dispatch → device → scatter stages of each request
 render as nested bars across the HTTP, batcher-worker, and prefetch
 threads. ``--trace`` filters to one request's trace id.
 
-Span ``start_s`` is on the tracer's clock (perf_counter); the export
-rebases to the earliest span so timestamps start at 0 µs. Host-only
+``--fleet`` merges SEVERAL files — the router's telemetry plus one per
+replica — into one trace with a process lane per file, joined on the
+propagated trace/span ids (``obs/trace.py`` Traceparent propagation
+keeps ids globally unique via per-replica prefixes). Cross-process
+spans carry ``remote_parent``; the merge resolves them against the
+whole file set and reports the orphan-span rate (spans whose parent id
+appears in NO file) — the health number for fleet-trace propagation.
+
+Span ``start_s`` is on each process's tracer clock (perf_counter);
+every file is rebased independently to its earliest span, so lanes
+align at 0 but cross-process gaps are approximate (clocks aren't
+synchronized — the join is the ids, not the timestamps). Host-only
 (no JAX import).
 """
 
@@ -53,15 +65,17 @@ def load_spans(path: str) -> list[dict]:
         return spans
 
 
-def to_chrome(spans: list[dict]) -> dict:
-    """Chrome trace-event JSON for a span list (complete events + thread
-    name metadata). Nesting is positional: Chrome stacks events on the
-    same tid by time containment, which parent/child spans satisfy by
-    construction (a child's [start, end) sits inside its parent's)."""
+_EVENT_ARG_KEYS = ("stage", "tier", "scene", "status", "n_rays", "joined",
+                   "source", "family", "bucket", "replica", "remote_parent")
+
+
+def _span_events(spans: list[dict], pid: int,
+                 threads: dict[str, int]) -> list[dict]:
+    """Complete ("X") events for one process lane, rebased to the lane's
+    earliest span. ``threads`` maps thread name -> tid within this pid."""
     if not spans:
-        return {"traceEvents": []}
+        return []
     t0 = min(float(s["start_s"]) for s in spans)
-    threads: dict[str, int] = {}
     events = []
     for s in spans:
         thread = str(s.get("thread", "main"))
@@ -71,8 +85,7 @@ def to_chrome(spans: list[dict]) -> dict:
             "span_id": s.get("span_id"),
             "parent_id": s.get("parent_id"),
         }
-        for k in ("stage", "tier", "scene", "status", "n_rays", "joined",
-                  "source", "family", "bucket"):
+        for k in _EVENT_ARG_KEYS:
             if s.get(k) is not None:
                 args[k] = s[k]
         events.append({
@@ -81,40 +94,162 @@ def to_chrome(spans: list[dict]) -> dict:
             "cat": str(s.get("stage") or "span"),
             "ts": (float(s["start_s"]) - t0) * 1e6,
             "dur": float(s.get("dur_s", 0.0)) * 1e6,
-            "pid": 1,
+            "pid": pid,
             "tid": tid,
             "args": args,
         })
     for thread, tid in threads.items():
         events.append({
-            "ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
             "args": {"name": thread},
         })
+    return events
+
+
+def to_chrome(spans: list[dict]) -> dict:
+    """Chrome trace-event JSON for one span list (complete events +
+    thread name metadata). Nesting is positional: Chrome stacks events
+    on the same tid by time containment, which parent/child spans
+    satisfy by construction (a child's [start, end) sits inside its
+    parent's)."""
+    if not spans:
+        return {"traceEvents": []}
+    events = _span_events(spans, pid=1, threads={})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _fleet_labels(paths: list[str]) -> list[str]:
+    """One lane label per file: the file stem, disambiguated by its
+    parent dir (then an index) when stems repeat — N replicas usually
+    all log to ``telemetry.jsonl``."""
+    stems = [os.path.splitext(os.path.basename(p))[0] for p in paths]
+    labels = []
+    for i, (path, stem) in enumerate(zip(paths, stems)):
+        if stems.count(stem) > 1:
+            parent = os.path.basename(os.path.dirname(os.path.abspath(path)))
+            stem = f"{parent}/{stem}" if parent else stem
+        labels.append(stem)
+    seen: dict[str, int] = {}
+    for i, lab in enumerate(labels):
+        n = seen.get(lab, 0)
+        seen[lab] = n + 1
+        if n:
+            labels[i] = f"{lab}#{n}"
+    return labels
+
+
+def merge_fleet(paths: list[str], trace: str | None = None
+                ) -> tuple[dict, dict]:
+    """Merge per-process telemetry files into one Chrome trace (a
+    process lane per file) and compute the join stats: orphan spans
+    (parent id in NO file), resolved remote parents (the cross-process
+    joins propagation exists for), and duplicate span ids (a replica
+    missing its id prefix). Returns (chrome_doc, stats)."""
+    per_file: list[list[dict]] = []
+    for path in paths:
+        spans = load_spans(path)
+        if trace:
+            spans = [s for s in spans if s.get("trace_id") == trace]
+        per_file.append(spans)
+    all_ids: set[str] = set()
+    dup_ids: set[str] = set()
+    for spans in per_file:
+        for s in spans:
+            sid = s.get("span_id")
+            if sid in all_ids:
+                dup_ids.add(sid)
+            all_ids.add(sid)
+    labels = _fleet_labels(paths)
+    events: list[dict] = []
+    n_spans = 0
+    n_orphans = 0
+    n_remote = 0
+    n_remote_resolved = 0
+    for pid, (label, spans) in enumerate(zip(labels, per_file), start=1):
+        events.extend(_span_events(spans, pid=pid, threads={}))
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        })
+        for s in spans:
+            n_spans += 1
+            parent = s.get("parent_id")
+            if s.get("remote_parent"):
+                n_remote += 1
+            if parent is None:
+                continue
+            if parent in all_ids:
+                if s.get("remote_parent"):
+                    n_remote_resolved += 1
+            else:
+                n_orphans += 1
+    stats = {
+        "files": {lab: len(spans) for lab, spans in zip(labels, per_file)},
+        "spans": n_spans,
+        "traces": len({s.get("trace_id")
+                       for spans in per_file for s in spans}),
+        "orphans": n_orphans,
+        "orphan_rate": round(n_orphans / n_spans, 4) if n_spans else 0.0,
+        "remote_parented": n_remote,
+        "remote_resolved": n_remote_resolved,
+        "duplicate_span_ids": sorted(dup_ids),
+    }
+    return {"traceEvents": events, "displayTimeUnit": "ms"}, stats
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="span rows -> Chrome trace JSON")
-    p.add_argument("path", help="telemetry.jsonl or flight_<reason>.json")
+    p.add_argument("paths", nargs="+",
+                   help="telemetry.jsonl / flight_<reason>.json "
+                        "(several with --fleet)")
     p.add_argument("--out", default=None,
-                   help="output path (default: <path stem>_trace.json)")
+                   help="output path (default: <first path stem>_trace.json)")
     p.add_argument("--trace", default=None,
                    help="only spans of this trace_id")
+    p.add_argument("--fleet", action="store_true",
+                   help="merge all paths into one trace with a process "
+                        "lane per file, joined on propagated ids; "
+                        "reports the orphan-span rate")
     args = p.parse_args(argv)
 
-    spans = load_spans(args.path)
+    if len(args.paths) > 1 and not args.fleet:
+        p.error("multiple paths require --fleet")
+
+    out = args.out
+    if out is None:
+        stem = os.path.splitext(os.path.basename(args.paths[0]))[0]
+        out = os.path.join(os.path.dirname(args.paths[0]) or ".",
+                           f"{stem}_{'fleet_' if args.fleet else ''}"
+                           f"trace.json")
+
+    if args.fleet:
+        doc, stats = merge_fleet(args.paths, trace=args.trace)
+        if not stats["spans"]:
+            print(f"{', '.join(args.paths)}: no span rows"
+                  + (f" for trace {args.trace}" if args.trace else ""))
+            return 1
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        lanes = ", ".join(f"{k}: {v}" for k, v in stats["files"].items())
+        print(f"{out}: {stats['spans']} spans, {stats['traces']} traces "
+              f"across {len(stats['files'])} lanes ({lanes})")
+        print(f"orphan spans: {stats['orphans']}/{stats['spans']} "
+              f"(rate {stats['orphan_rate']}), remote parents resolved: "
+              f"{stats['remote_resolved']}/{stats['remote_parented']}")
+        if stats["duplicate_span_ids"]:
+            print("WARNING: duplicate span ids across files (missing "
+                  f"id_prefix?): {stats['duplicate_span_ids'][:8]}")
+        return 0
+
+    spans = load_spans(args.paths[0])
     if args.trace:
         spans = [s for s in spans if s.get("trace_id") == args.trace]
     if not spans:
-        print(f"{args.path}: no span rows"
+        print(f"{args.paths[0]}: no span rows"
               + (f" for trace {args.trace}" if args.trace else ""))
         return 1
-    out = args.out
-    if out is None:
-        stem = os.path.splitext(os.path.basename(args.path))[0]
-        out = os.path.join(os.path.dirname(args.path) or ".",
-                           f"{stem}_trace.json")
     doc = to_chrome(spans)
     with open(out, "w") as f:
         json.dump(doc, f, indent=1)

@@ -132,6 +132,7 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
         UnknownSceneError,
     )
     from nerf_replication_tpu.obs import get_metrics, get_tracer
+    from nerf_replication_tpu.obs.trace import TRACE_HEADER, SpanContext
     from nerf_replication_tpu.resil import BreakerOpenError, report
     from nerf_replication_tpu.serve.batcher import ServeTimeoutError
 
@@ -162,12 +163,22 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                 import os
 
                 stats = engine.stats() if hasattr(engine, "stats") else {}
+                trs = get_tracer().stats()
                 health["replica"] = {
                     "id": os.environ.get("SCALE_REPLICA_ID", ""),
                     "warm_source": stats.get("warm_source"),
                     "total_compiles": stats.get("total_compiles", 0),
                     "scenes": (engine.resident_scenes()
                                if hasattr(engine, "resident_scenes") else []),
+                    # tracing health, surfaced to the router's heartbeat:
+                    # spans emitted, sink drops, and how many spans
+                    # parented under a propagated (router) ctx
+                    "trace": {
+                        "enabled": trs["enabled"],
+                        "spans": trs["spans"],
+                        "dropped_sink": trs["dropped_sink"],
+                        "remote_parented": trs["remote_parented"],
+                    },
                 }
                 return self._reply(200 if health["ok"] else 503, health)
             if self.path == "/stats":
@@ -194,11 +205,19 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                 # Retirement (process exit) stays with the supervisor.
                 if batcher is None:
                     return self._reply(200, {"drained": True, "n_failed": 0})
-                before = (batcher.n_timeouts + batcher.n_dispatch_errors
-                          + batcher.n_scene_errors)
-                batcher.close(drain=True)
-                failed = (batcher.n_timeouts + batcher.n_dispatch_errors
-                          + batcher.n_scene_errors) - before
+                # the drain is traced too: a propagated header parents it
+                # under the router's retirement flow
+                with get_tracer().span(
+                    "serve.drain",
+                    parent=SpanContext.from_header(
+                        self.headers.get(TRACE_HEADER)),
+                ) as sp:
+                    before = (batcher.n_timeouts + batcher.n_dispatch_errors
+                              + batcher.n_scene_errors)
+                    batcher.close(drain=True)
+                    failed = (batcher.n_timeouts + batcher.n_dispatch_errors
+                              + batcher.n_scene_errors) - before
+                    sp.set(detail=f"drain_failed={int(failed)}")
                 return self._reply(200, {"drained": True,
                                          "n_failed": int(failed)})
             if self.path != "/render":
@@ -208,13 +227,18 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                 body = json.loads(self.rfile.read(length) or b"{}")
                 scene = body.get("scene")
                 tenant = body.get("tenant")
-                # the REQUEST's root span: parent=None starts a fresh
-                # trace on this handler thread; the batcher submit below
-                # captures it into the queue entry, making every
-                # downstream stage (worker/prefetch threads included) a
-                # descendant
+                # the REQUEST's root span: a propagated Traceparent
+                # header (the router's dispatch span) parents this
+                # process's whole tree under the router's trace — one
+                # routed request, ONE trace. Without the header,
+                # parent=None starts a fresh trace as before; either
+                # way the batcher submit captures the ctx into the
+                # queue entry, making every downstream stage
+                # (worker/prefetch threads included) a descendant.
                 with get_tracer().span(
-                    "serve.request", parent=None,
+                    "serve.request",
+                    parent=SpanContext.from_header(
+                        self.headers.get(TRACE_HEADER)),
                     scene=None if scene is None else str(scene),
                     tenant=None if tenant is None else str(tenant),
                 ):
@@ -298,7 +322,12 @@ def main(argv=None) -> int:
     flight_dir = str(o.get("flight_dir", "")) or str(
         cfg.get("record_dir", "."))
     slo_target_ms = float(o.get("slo_target_ms", 100.0))
-    configure_tracing(enabled=trace_on)
+    # a replica's span ids carry its id as a prefix, so a --fleet merge
+    # of several replicas' telemetry joins on globally-unique ids
+    import os
+
+    configure_tracing(enabled=trace_on,
+                      id_prefix=os.environ.get("SCALE_REPLICA_ID", ""))
     install_flight_recorder(FlightRecorder(flight_dir, capacity=trace_ring))
     # SIGTERM: the guard's handler dumps the flight ring, then the poll
     # loop below drains and exits cleanly (a preempted replica leaves a

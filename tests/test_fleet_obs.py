@@ -1,0 +1,747 @@
+"""Fleet-wide observability tests (PR 15): Traceparent propagation
+across the router -> ProcessReplica HTTP boundary, the fleet metrics
+aggregator (merge, dead-replica skips, exemplar harvesting), evidence-
+linked scale decisions, and the cross-replica trace assembly tooling
+(trace_view --fleet, tlm_report's fleet-trace section and --diff gates).
+
+The headline test is a REAL two-process run: a serve.py-shaped child
+process (stdlib HTTP server over a fake engine) is spawned, one pose
+request is routed through Router.render with tracing on, and the child's
+span tree must parent under the router's dispatch span via the
+propagated header — reconstructing >= 95% of the routed wall time with
+zero orphan spans in the merged Chrome trace.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from nerf_replication_tpu.obs import (  # noqa: E402
+    SCHEMA_VERSION,
+    TRACE_HEADER,
+    SpanContext,
+    configure_tracing,
+    get_metrics,
+    get_tracer,
+    reset_metrics,
+    trace_headers,
+    validate_row,
+)
+from nerf_replication_tpu.obs import emit as emit_mod  # noqa: E402
+from nerf_replication_tpu.obs.schema import validate_bench_row  # noqa: E402
+from nerf_replication_tpu.resil import (  # noqa: E402
+    FlightRecorder,
+    install_flight_recorder,
+    uninstall_flight_recorder,
+)
+from nerf_replication_tpu.scale import (  # noqa: E402
+    FleetMetricsAggregator,
+    ProcessReplica,
+    ReplicaState,
+    Router,
+    ScaleOptions,
+    Supervisor,
+    make_fleet_server,
+    merge_scrapes,
+)
+from nerf_replication_tpu.scale.fleet_metrics import (  # noqa: E402
+    relabel_sample,
+)
+
+
+def _load_script(name):
+    path = os.path.join(_REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def telem(tmp_path, monkeypatch):
+    """Route the process emitter at a scratch JSONL; yields its path."""
+    path = str(tmp_path / "telemetry.jsonl")
+    em = emit_mod.Emitter(path, chief=True)
+    monkeypatch.setattr(emit_mod, "_active", em)
+    yield path
+    em.close()
+
+
+@pytest.fixture
+def traced(telem):
+    """Tracing ON with a span-collecting sink; resets after."""
+    reset_metrics()
+    configure_tracing(enabled=True)
+    spans = []
+    get_tracer().add_sink(spans.append)
+    yield spans
+    configure_tracing(enabled=False)
+    reset_metrics()
+
+
+# -- header propagation (SpanContext.to_header/from_header) -------------------
+
+
+def test_span_context_header_round_trip():
+    ctx = SpanContext("r0abc123", "00000007")
+    restored = SpanContext.from_header(ctx.to_header())
+    assert restored is not None
+    assert restored.trace_id == "r0abc123"
+    assert restored.span_id == "00000007"
+    # a restored ctx is marked remote: children record remote_parent so
+    # the fleet merge can tell propagated parents from torn ones
+    assert restored.remote is True
+    assert ctx.remote is False
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "nodashhere", "-abc", "abc-", "a b-c", "abc-d!f",
+    "tr@ce-span", 42,
+])
+def test_from_header_rejects_malformed(bad):
+    assert SpanContext.from_header(bad) is None
+
+
+def test_trace_headers_ambient_and_explicit(traced):
+    # outside any span there is nothing to propagate
+    assert trace_headers() == {}
+    explicit = SpanContext("t1", "s1")
+    assert trace_headers(explicit) == {TRACE_HEADER: "t1-s1"}
+    with get_tracer().span("outer", parent=None) as sp:
+        hdrs = trace_headers()
+        assert hdrs == {TRACE_HEADER: sp.ctx.to_header()}
+
+
+def test_remote_parented_span_row_validates(traced):
+    trs = get_tracer()
+    ctx = SpanContext.from_header("parenttrace-parentspan")
+    with trs.span("serve.request", parent=ctx):
+        pass
+    row = traced[-1]
+    assert row["remote_parent"] is True
+    assert row["trace_id"] == "parenttrace"
+    assert row["parent_id"] == "parentspan"
+    full = {"v": SCHEMA_VERSION, "kind": "span", "t": 0.0, **row}
+    assert validate_row(full) == []
+
+
+def test_replica_id_prefix_keeps_span_ids_unique(telem):
+    configure_tracing(enabled=True, id_prefix="rep-0")  # dash stripped
+    rows = []
+    get_tracer().add_sink(rows.append)
+    with get_tracer().span("x", parent=None):
+        pass
+    configure_tracing(enabled=False)
+    assert rows[0]["span_id"].startswith("rep0")
+    assert rows[0]["span_id"].isalnum()
+
+
+# -- fleet metrics: merge + aggregator ----------------------------------------
+
+
+_PROM_A = """\
+# TYPE serve_request_latency_seconds histogram
+serve_request_latency_seconds_bucket{le="0.1"} 8
+serve_request_latency_seconds_bucket{le="0.25"} 8
+serve_request_latency_seconds_bucket{le="1"} 10 # {trace_id="slowA1"} 0.7
+serve_request_latency_seconds_bucket{le="+Inf"} 10
+serve_request_latency_seconds_sum 2.4
+serve_request_latency_seconds_count 10
+# TYPE serve_requests_total counter
+serve_requests_total{status="ok"} 10
+# TYPE scale_router_dispatch_total counter
+scale_router_dispatch_total{replica="r1"} 3
+"""
+
+_PROM_B = """\
+# TYPE serve_request_latency_seconds histogram
+serve_request_latency_seconds_bucket{le="0.1"} 5
+serve_request_latency_seconds_bucket{le="0.25"} 5
+serve_request_latency_seconds_bucket{le="1"} 5
+serve_request_latency_seconds_bucket{le="+Inf"} 5
+serve_request_latency_seconds_sum 0.2
+serve_request_latency_seconds_count 5
+# TYPE serve_requests_total counter
+serve_requests_total{status="ok"} 5
+"""
+
+
+def test_relabel_sample_injects_and_renames_collision():
+    out = relabel_sample('serve_requests_total{status="ok"} 10', "rep0")
+    assert out == 'serve_requests_total{replica="rep0",status="ok"} 10'
+    # a pre-existing replica label (the router talking about OTHER
+    # replicas) is renamed, not clobbered — the federation collision rule
+    out = relabel_sample('scale_router_dispatch_total{replica="r1"} 3',
+                         "router")
+    assert 'exported_replica="r1"' in out
+    assert 'replica="router"' in out
+    # exemplar suffixes ride along untouched
+    out = relabel_sample(
+        'x_bucket{le="1"} 2 # {trace_id="t9"} 0.7', "rep1")
+    assert out.endswith('# {trace_id="t9"} 0.7')
+    assert 'replica="rep1"' in out
+
+
+def test_merge_scrapes_groups_series_per_source():
+    merged = merge_scrapes({"rep0": _PROM_A, "rep1": _PROM_B})
+    # one TYPE line per metric, not one per source
+    assert merged.count("# TYPE serve_requests_total counter") == 1
+    assert merged.count(
+        "# TYPE serve_request_latency_seconds histogram") == 1
+    assert 'serve_requests_total{replica="rep0",status="ok"} 10' in merged
+    assert 'serve_requests_total{replica="rep1",status="ok"} 5' in merged
+    # the exemplar survived the merge with its source's replica label
+    assert '# {trace_id="slowA1"} 0.7' in merged
+
+
+class _ScrapeReplica:
+    """Replica double wearing only the fleet-metrics surface."""
+
+    def __init__(self, replica_id, text, state=ReplicaState.READY,
+                 source_id=None, load=0):
+        self.replica_id = replica_id
+        self.text = text
+        self.state = state
+        self._source_id = source_id or replica_id
+        self._load = load
+
+    def accepting(self):
+        return self.state == ReplicaState.READY
+
+    def metrics_source_id(self):
+        return self._source_id
+
+    def scrape_metrics(self):
+        if isinstance(self.text, Exception):
+            raise self.text
+        return self.text
+
+    def load(self):
+        return self._load
+
+    def heartbeat(self):
+        return {"replica": self.replica_id, "state": self.state,
+                "ok": True, "load": self._load, "scenes": []}
+
+    def drain(self, timeout_s=60.0):
+        self.state = ReplicaState.RETIRED
+        return 0
+
+
+@pytest.fixture
+def metrics_reset():
+    reset_metrics()
+    yield
+    reset_metrics()
+
+
+def test_aggregator_skips_dead_and_dedups_shared_registry(metrics_reset):
+    router = Router()
+    router.register(_ScrapeReplica("rep0", _PROM_A))
+    router.register(_ScrapeReplica("rep1", _PROM_B,
+                                   state=ReplicaState.DEAD))
+    router.register(_ScrapeReplica("rep2", _PROM_B,
+                                   state=ReplicaState.RETIRED))
+    router.register(_ScrapeReplica("rep3", RuntimeError("conn refused")))
+    # two in-process replicas sharing one registry: one scrape, not two
+    router.register(_ScrapeReplica("rep4", _PROM_B, source_id="process"))
+    router.register(_ScrapeReplica("rep5", _PROM_B, source_id="process"))
+    agg = FleetMetricsAggregator(router, slo_target_s=0.25)
+    scrapes = agg.scrape()
+    assert sorted(scrapes) == ["process", "rep0"]
+    reasons = {s["replica"]: s["reason"] for s in agg.skipped}
+    assert reasons["rep1"] == ReplicaState.DEAD
+    assert reasons["rep2"] == ReplicaState.RETIRED
+    assert reasons["rep3"].startswith("unreachable")
+    assert agg.stats()["n_scrape_failures"] == 1
+
+
+def test_aggregator_slo_view_and_window_deltas(metrics_reset):
+    router = Router()
+    rep = _ScrapeReplica("rep0", _PROM_A)
+    router.register(rep)
+    agg = FleetMetricsAggregator(router, slo_target_s=0.25)
+    view = agg.slo_view()
+    assert view["replicas_scraped"] == 1
+    assert view["attainment"] == pytest.approx(0.8)  # 8 of 10 under 250 ms
+    assert view["requests"] == 10
+
+    # first window diffs against zeros: cumulative-since-start
+    w1 = agg.window()
+    assert w1["attainment"] == pytest.approx(0.8)
+    assert w1["requests"] == 10
+    # the SLO-missing bucket's exemplar is the window's evidence join key
+    assert w1["exemplar_trace_ids"] == ["slowA1"]
+
+    # next window: 10 more requests, all fast -> delta attainment 1.0
+    rep.text = _PROM_A.replace(
+        'le="0.1"} 8', 'le="0.1"} 18').replace(
+        'le="0.25"} 8', 'le="0.25"} 18').replace(
+        'le="1"} 10', 'le="1"} 20').replace(
+        'le="+Inf"} 10', 'le="+Inf"} 20').replace(
+        '_count 10', '_count 20').replace(
+        'serve_requests_total{status="ok"} 10',
+        'serve_requests_total{status="ok"} 20')
+    w2 = agg.window()
+    assert w2["attainment"] == pytest.approx(1.0)
+    assert w2["requests"] == 10
+
+
+def test_aggregator_exemplars_match_registry_duck_type(metrics_reset):
+    """The Supervisor calls ``slo_miss_exemplars(target_s)`` on whatever
+    evidence source it holds — the aggregator must accept the positional
+    target like MetricsRegistry does (a target of 0.25 must not be
+    swallowed as the ``limit``)."""
+    router = Router()
+    router.register(_ScrapeReplica("rep0", _PROM_A))
+    agg = FleetMetricsAggregator(router, slo_target_s=0.25)
+    agg.scrape()
+    assert agg.slo_miss_exemplars(0.25) == ["slowA1"]
+    assert agg.slo_miss_exemplars() == ["slowA1"]
+    # a target above every harvested edge filters the pool empty
+    assert agg.slo_miss_exemplars(5.0) == []
+
+
+def test_registry_exemplar_sampling_and_miss_pool(metrics_reset):
+    mx = get_metrics()
+    mx.observe("serve_request_latency_seconds", 0.01, trace_id="fast1")
+    mx.observe("serve_request_latency_seconds", 0.6, trace_id="slow1")
+    mx.observe("serve_request_latency_seconds", 1.8, trace_id="slow2")
+    mx.observe("serve_request_latency_seconds", 0.02)  # no trace: no exemplar
+    # misses only, slowest first
+    assert mx.slo_miss_exemplars(0.25) == ["slow2", "slow1"]
+    # nothing missed the (huge) target with an exemplar -> fall back to
+    # the slowest seen so evidence is never empty on an observed fleet
+    assert mx.slo_miss_exemplars(100.0) == ["slow2", "slow1", "fast1"]
+    text = mx.render_prometheus()
+    assert '# {trace_id="slow1"} 0.6' in text
+    assert '# {trace_id="slow2"} 1.8' in text
+
+
+def test_fleet_server_endpoints(metrics_reset):
+    router = Router()
+    router.register(_ScrapeReplica("rep0", _PROM_A))
+    agg = FleetMetricsAggregator(router, slo_target_s=0.25)
+    server = make_fleet_server(agg, port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/metrics", timeout=5) as r:
+            body = r.read().decode()
+            assert r.status == 200
+        assert 'replica="rep0"' in body
+        assert '# {trace_id="slowA1"} 0.7' in body
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/slo", timeout=5) as r:
+            slo = json.loads(r.read().decode())
+        assert slo["attainment"] == pytest.approx(0.8)
+        assert slo["replicas_scraped"] == 1
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/nope", timeout=5)
+        assert exc.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# -- evidence-linked scale decisions ------------------------------------------
+
+
+def test_supervisor_decision_carries_fleet_evidence(telem, tmp_path,
+                                                    metrics_reset):
+    router = Router()
+    router.register(_ScrapeReplica("rep0", _PROM_A, load=3))
+    agg = FleetMetricsAggregator(router, slo_target_s=0.25)
+    spawned = []
+
+    def spawn(i):
+        r = _ScrapeReplica(f"fresh{i}", _PROM_B)
+        spawned.append(r)
+        return r
+
+    opts = ScaleOptions(min_replicas=1, max_replicas=3, out_below=0.9,
+                        out_windows=1, cooldown_out_s=0.0)
+    sup = Supervisor(router, spawn, options=opts, evidence_source=agg,
+                     slo_target_s=0.25)
+    install_flight_recorder(FlightRecorder(str(tmp_path), capacity=64))
+    try:
+        router.sweep()  # populate beats so load_view has depths
+        action = sup.step_from_fleet(agg)  # attainment 0.8 < 0.9 -> out
+    finally:
+        uninstall_flight_recorder()
+    assert action == "out"
+    assert len(spawned) == 1
+    decision = sup.decisions[-1]
+    ev = decision["evidence"]
+    assert ev["exemplar_trace_ids"] == ["slowA1"]
+    assert ev["attainment_series"][-1] == pytest.approx(0.8)
+    assert ev["queue_depths"] == {"rep0": 3}
+    assert isinstance(ev["deny_rate"], float)
+    # the emitted telemetry row passes the deep evidence checks
+    full = {"v": SCHEMA_VERSION, "kind": "scale_decision", "t": 0.0,
+            **decision}
+    assert validate_row(full) == []
+    # ... and the scale-out dumped a flight snapshot naming the evidence
+    dump_path = tmp_path / "flight_scale_out.json"
+    assert dump_path.exists()
+    dump = json.loads(dump_path.read_text())
+    assert "slowA1" in dump["detail"]
+
+
+def test_wedged_fleet_window_scales_out_not_in(telem, metrics_reset):
+    """Attainment None with queued work is overload, not idleness."""
+    router = Router()
+    rep = _ScrapeReplica("rep0", "", load=9)  # nothing completing, deep queue
+    router.register(rep)
+    agg = FleetMetricsAggregator(router, slo_target_s=0.25)
+    opts = ScaleOptions(min_replicas=1, max_replicas=3, out_windows=1,
+                        cooldown_out_s=0.0)
+    sup = Supervisor(router, lambda i: _ScrapeReplica(f"f{i}", ""),
+                     options=opts, evidence_source=agg)
+    router.sweep()
+    assert sup.step_from_fleet(agg) == "out"
+
+
+def test_scale_bench_row_shape_stays_in_family():
+    """The serve_bench --replicas row with the PR-15 tracing/evidence
+    fields must still land in the scale_mode bench family (no key may
+    collide with an earlier first-match discriminator)."""
+    row = {
+        "scale_mode": True, "tracing": "on", "rps": 42.0,
+        "replicas_peak": 2, "attainment_low": 0.6,
+        "attainment_recovered": 0.99, "scale_outs": 1, "scale_ins": 1,
+        "actions_with_evidence": 2, "actions_evidence_free": 0,
+        "fleet_scrape_rounds": 12, "trace_overhead_pct": 1.5,
+        "compiles_steady": 0,
+    }
+    assert validate_bench_row(row) == []
+
+
+# -- trace assembly tooling ---------------------------------------------------
+
+
+def _write_spans(path, spans):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for s in spans:
+            f.write(json.dumps(
+                {"v": SCHEMA_VERSION, "kind": "span", "t": 0.0, **s}) + "\n")
+
+
+def _span(trace_id, span_id, name, start_s, dur_s, parent_id=None, **attrs):
+    return {"trace_id": trace_id, "span_id": span_id, "name": name,
+            "start_s": start_s, "dur_s": dur_s, "parent_id": parent_id,
+            "thread": "main", **attrs}
+
+
+def test_trace_view_fleet_merge_stats(tmp_path):
+    router_path = str(tmp_path / "router" / "telemetry.jsonl")
+    rep_path = str(tmp_path / "rep0" / "telemetry.jsonl")
+    _write_spans(router_path, [
+        _span("t1", "r1", "route.submit", 0.0, 0.5, stage="route"),
+        _span("t1", "r2", "route.dispatch", 0.01, 0.48, parent_id="r1",
+              stage="route", replica="rep0"),
+    ])
+    _write_spans(rep_path, [
+        _span("t1", "rep0a", "serve.request", 100.0, 0.4, parent_id="r2",
+              remote_parent=True),
+        _span("t1", "rep0b", "serve.dispatch", 100.1, 0.2,
+              parent_id="rep0a", stage="dispatch"),
+        _span("t1", "rep0c", "orphaned", 100.3, 0.1, parent_id="gone"),
+    ])
+    tv = _load_script("trace_view")
+    doc, stats = tv.merge_fleet([router_path, rep_path])
+    assert stats["spans"] == 5
+    assert stats["traces"] == 1
+    assert stats["orphans"] == 1  # only the torn parent, not the remote one
+    assert stats["remote_parented"] == 1
+    assert stats["remote_resolved"] == 1
+    assert stats["duplicate_span_ids"] == []
+    # one process lane per file, labeled by parent dir (stems collide)
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["name"] == "process_name"}
+    assert names == {"router/telemetry", "rep0/telemetry"}
+    # each lane rebased independently to its earliest span
+    starts = [e["ts"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert min(starts) == 0.0
+    assert max(starts) < 1e6  # the 100 s clock offset did not survive
+
+    # duplicate span ids across files (a replica missing its id_prefix)
+    dup_path = str(tmp_path / "rep1" / "telemetry.jsonl")
+    _write_spans(dup_path, [_span("t2", "r1", "serve.request", 0.0, 0.1)])
+    _, stats = tv.merge_fleet([router_path, rep_path, dup_path])
+    assert stats["duplicate_span_ids"] == ["r1"]
+
+
+def test_trace_view_fleet_cli_and_trace_filter(tmp_path, capsys):
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _write_spans(a, [_span("t1", "s1", "route.submit", 0.0, 0.2),
+                     _span("t9", "s9", "stray", 0.0, 0.1)])
+    _write_spans(b, [_span("t1", "s2", "serve.request", 0.0, 0.15,
+                           parent_id="s1", remote_parent=True)])
+    tv = _load_script("trace_view")
+    out = str(tmp_path / "fleet.json")
+    rc = tv.main([a, b, "--fleet", "--trace", "t1", "--out", out])
+    assert rc == 0
+    doc = json.loads(open(out).read())
+    x_events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(x_events) == 2  # the stray t9 span was filtered out
+    printed = capsys.readouterr().out
+    assert "orphan spans: 0/2" in printed
+    # multiple paths without --fleet is an argparse error
+    with pytest.raises(SystemExit):
+        tv.main([a, b])
+
+
+def test_tlm_report_fleet_trace_section_and_diff_gates(tmp_path):
+    tlm = _load_script("tlm_report")
+    clean_rows = [
+        _row("span", **_span("t1", "r1", "route.submit", 0.0, 0.5,
+                             stage="route", replica="rep0")),
+        _row("span", **_span("t1", "s1", "serve.dispatch", 0.1, 0.3,
+                             parent_id="r1", stage="dispatch")),
+        _row("scale_decision", action="out", reason="slo_miss",
+             n_replicas=2, streak=2, evidence={
+                 "attainment_series": [0.7], "queue_depths": {"rep0": 4},
+                 "deny_rate": 0.0, "exemplar_trace_ids": ["t1"]}),
+    ]
+    base = tlm.summarize(clean_rows)
+    assert base["trace_orphans"] == 0
+    assert base["trace_remote_parented"] == 0
+    assert base["scale_actions"] == 1
+    assert base["scale_actions_with_evidence"] == 1
+    assert base["scale_actions_evidence_free"] == 0
+    # the propagated-trace join attributes replica-side stages
+    assert base["fleet_stage_by_replica"]["rep0"]["n"] == 2
+
+    broken_rows = [
+        _row("span", **_span("t1", "r1", "route.submit", 0.0, 0.5,
+                             stage="route")),
+        _row("span", **_span("t1", "s1", "serve.dispatch", 0.1, 0.3,
+                             parent_id="gone", stage="dispatch")),
+        _row("scale_decision", action="out", reason="slo_miss",
+             n_replicas=2, streak=2),
+    ]
+    cand = tlm.summarize(broken_rows)
+    assert cand["trace_orphans"] == 1
+    assert cand["scale_actions_evidence_free"] == 1
+    flags = tlm.diff(base, cand, gate_pct=5.0)
+    assert any("orphan-span rate grew" in f for f in flags)
+    assert any("evidence-free scale actions grew" in f for f in flags)
+    # no self-regression: a run diffed against itself stays silent
+    assert not any("orphan" in f or "evidence" in f
+                   for f in tlm.diff(base, base, gate_pct=5.0))
+
+
+def _row(kind, **fields):
+    return {"v": SCHEMA_VERSION, "kind": kind, "t": 0.0, **fields}
+
+
+# -- the two-process propagation test -----------------------------------------
+
+# A serve.py-shaped child: the REAL make_server/render_pose HTTP stack
+# over a fake engine whose render emits dispatch/device stage spans
+# around sleeps — so the parent can assert the child's tree parents
+# under the router's propagated ctx and covers >= 95% of the request.
+_SERVE_CHILD = """\
+import json, os, sys, time
+
+repo_dir, telem_path = sys.argv[1:3]
+sys.path.insert(0, repo_dir)
+
+import numpy as np
+
+from nerf_replication_tpu.obs import configure_tracing, get_tracer
+from nerf_replication_tpu.obs import emit as emit_mod
+
+emit_mod._active = emit_mod.Emitter(telem_path, chief=True)
+configure_tracing(enabled=True,
+                  id_prefix=os.environ.get("SCALE_REPLICA_ID", ""))
+
+import serve as serve_cli
+
+
+class Options:
+    request_timeout_s = 5.0
+
+
+class Engine:
+    default_camera = {"H": 8, "W": 8, "focal": 10.0}
+    options = Options()
+
+    def stats(self):
+        return {"warm_source": "disk", "total_compiles": 0}
+
+    def render_view(self, c2w, H, W, focal, via=None, scene=None):
+        trs = get_tracer()
+        with trs.span("serve.dispatch", stage="dispatch"):
+            time.sleep(0.4)
+        with trs.span("serve.device", stage="device"):
+            time.sleep(0.4)
+        return np.zeros((H, W, 3), np.uint8), {"tier": "full",
+                                               "cache_hit": False}
+
+
+serve_cli._resolve_pose({"theta": 0.0})  # imports paid before the timed path
+server = serve_cli.make_server(Engine(), None, port=0, slo_target_ms=100.0)
+print(json.dumps({"port": server.server_address[1]}), flush=True)
+server.serve_forever()
+"""
+
+
+def _read_child_port(proc, timeout_s=240.0):
+    """First stdout line (the child's port report) with a watchdog."""
+    out = {}
+
+    def read():
+        out["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    return out.get("line", "")
+
+
+def _load_spans(path):
+    spans = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                row = json.loads(line)
+                if row.get("kind") == "span":
+                    spans.append(row)
+    return spans
+
+
+def test_two_process_trace_spans_router_and_replica(tmp_path, monkeypatch):
+    """The tentpole acceptance: ONE routed request is ONE trace across
+    two real processes. The child's serve.request parents under the
+    router's route.dispatch via the propagated Traceparent header; both
+    sides reconstruct >= 95% of their wall time; the merged fleet trace
+    has zero orphan spans."""
+    router_telem = str(tmp_path / "router" / "telemetry.jsonl")
+    os.makedirs(os.path.dirname(router_telem), exist_ok=True)
+    em = emit_mod.Emitter(router_telem, chief=True)
+    monkeypatch.setattr(emit_mod, "_active", em)
+    reset_metrics()
+    configure_tracing(enabled=True, id_prefix="router")
+
+    child_telem = str(tmp_path / "rep0" / "telemetry.jsonl")
+    os.makedirs(os.path.dirname(child_telem), exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SCALE_REPLICA_ID="rep0")
+    err_path = tmp_path / "child_stderr.txt"
+    with open(err_path, "w") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SERVE_CHILD, _REPO, child_telem],
+            stdout=subprocess.PIPE, stderr=errf, text=True, env=env,
+            cwd=_REPO,
+        )
+    try:
+        line = _read_child_port(proc)
+        assert line, ("child never reported a port; stderr:\n"
+                      + err_path.read_text()[-2000:])
+        port = json.loads(line)["port"]
+
+        rep = ProcessReplica("rep0", cfg_file="unused.yaml",
+                             host="127.0.0.1", port=port)
+        rep.proc = proc
+        router = Router(heartbeat_timeout_s=30.0)
+        router.register(rep)
+        router.sweep()
+        assert rep.state == ReplicaState.READY
+
+        out = router.render({"theta": 40.0, "phi": -30.0, "radius": 4.0,
+                             "H": 8, "W": 8, "focal": 10.0},
+                            timeout_s=60.0)
+        assert out["h"] == 8 and out["tier"] == "full"
+
+        # the /healthz replica block surfaces tracing health: the child
+        # counted its serve.request as remote-parented
+        beat = rep.heartbeat()
+        assert beat["trace"]["enabled"] is True
+        assert beat["trace"]["remote_parented"] >= 1
+        assert beat["trace"]["spans"] >= 3
+
+        # fleet metrics aggregate the child's registry over HTTP with a
+        # replica label (serve_stage_seconds fed by its stage spans)
+        agg = FleetMetricsAggregator(router, slo_target_s=0.25)
+        merged = agg.render()
+        assert 'replica="rep0"' in merged
+        assert "serve_stage_seconds" in merged
+
+        # drain-before-retire shuts the child down cleanly
+        assert router.drain("rep0", timeout_s=30.0) == 0
+        assert rep.state == ReplicaState.RETIRED
+        proc.wait(timeout=15)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        proc.stdout.close()
+        configure_tracing(enabled=False)
+        reset_metrics()
+    em.close()
+
+    router_spans = _load_spans(router_telem)
+    child_spans = _load_spans(child_telem)
+    submits = [s for s in router_spans if s["name"] == "route.submit"]
+    dispatches = [s for s in router_spans if s["name"] == "route.dispatch"]
+    requests = [s for s in child_spans if s["name"] == "serve.request"]
+    assert len(submits) == 1 and len(dispatches) == 1 and len(requests) == 1
+    submit, dispatch, request = submits[0], dispatches[0], requests[0]
+
+    # the propagated header made the child's root a child of the
+    # router's dispatch span — one request, one trace, two processes
+    assert request["remote_parent"] is True
+    assert request["parent_id"] == dispatch["span_id"]
+    assert request["trace_id"] == submit["trace_id"]
+    assert dispatch["parent_id"] == submit["span_id"]
+    # per-process id prefixes keep the merged id space collision-free
+    assert request["span_id"].startswith("rep0")
+    assert submit["span_id"].startswith("router")
+
+    stages = {s["name"]: s for s in child_spans
+              if s["name"] in ("serve.dispatch", "serve.device")}
+    assert set(stages) == {"serve.dispatch", "serve.device"}
+    for s in stages.values():
+        assert s["parent_id"] == request["span_id"]
+        assert s["trace_id"] == request["trace_id"]
+
+    # >= 95% of the routed wall time reconstructs on both sides
+    stage_sum = sum(s["dur_s"] for s in stages.values())
+    assert stage_sum >= 0.95 * request["dur_s"]
+    assert dispatch["dur_s"] >= 0.95 * submit["dur_s"]
+
+    # every emitted row (spans, replica lifecycle, router events) is
+    # schema-clean end to end
+    for path in (router_telem, child_telem):
+        with open(path) as f:
+            for line in f:
+                row = json.loads(line)
+                assert validate_row(row) == [], row
+
+    # the merged fleet trace joins the two files with zero orphans
+    tv = _load_script("trace_view")
+    doc, stats = tv.merge_fleet([router_telem, child_telem])
+    assert stats["orphans"] == 0
+    assert stats["remote_parented"] >= 1
+    assert stats["remote_resolved"] == stats["remote_parented"]
+    assert stats["duplicate_span_ids"] == []
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["name"] == "process_name"}
+    assert lanes == {"router/telemetry", "rep0/telemetry"}

@@ -322,15 +322,21 @@ def test_metrics_prometheus_rendering_parses(tmp_path):
         mx.counter("serve_requests_total", status="ok", tier="full")
         mx.counter("serve_requests_total", 2, status="timeout", tier="none")
         mx.gauge("serve_queue_depth", 3)
-        for v in (0.004, 0.02, 0.02, 0.7):
+        for v in (0.004, 0.02, 0.02):
             mx.observe("serve_request_latency_seconds", v, tier="full")
+        # the slow observe carries a trace id -> its bucket renders an
+        # OpenMetrics exemplar suffix (` # {trace_id="..."} <value>`)
+        mx.observe("serve_request_latency_seconds", 0.7, trace_id="t0042",
+                   tier="full")
         text = mx.render_prometheus()
         line_re = re.compile(
-            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(e[+-]?\d+)?$"
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(e[+-]?\d+)?"
+            r'( # \{trace_id="[a-zA-Z0-9]+"\} [0-9.e+-]+)?$'
         )
         for line in text.strip().splitlines():
             assert line.startswith("# TYPE") or line_re.match(line), line
         assert 'serve_requests_total{status="ok",tier="full"} 1' in text
+        assert '# {trace_id="t0042"} 0.7' in text
         # histogram: cumulative buckets end at +Inf == _count
         bucket_vals = [
             float(m.group(1)) for m in re.finditer(

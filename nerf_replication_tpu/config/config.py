@@ -260,6 +260,26 @@ def default_cfg() -> ConfigNode:
             "trace_ring": 256,       # flight recorder span-ring capacity
             "flight_dir": "",        # "" -> record_dir (flight_<reason>.json)
             "slo_target_ms": 100.0,  # /healthz SLO attainment target
+            # multi-window multi-burn-rate alerting (obs/alerts.py), the
+            # incident correlator, and the capacity ledger — serve.py
+            # wires all three when enabled
+            "alerts": {
+                "enabled": True,
+                "slo_objective": 0.99,    # latency-SLO attainment objective
+                "deny_objective": 0.99,   # tenant-admission objective
+                "fast_burn": 14.4,        # page threshold (x budget)
+                "slow_burn": 6.0,         # ticket threshold (x budget)
+                "fast_short_s": 300.0,    # page windows: 5m AND 1h
+                "fast_long_s": 3600.0,
+                "slow_short_s": 1800.0,   # ticket windows: 30m AND 6h
+                "slow_long_s": 21600.0,
+                "clear_hold_s": 60.0,     # hysteresis before an alert clears
+                "orphan_grace_s": 30.0,   # span-parent arrival grace
+                "orphan_rate_max": 0.05,  # orphan-span rate ticket threshold
+                "thrash_per_min_max": 6.0,  # demote+repromote churn/min
+                "view_window_s": 300.0,   # /healthz windowed SLO view
+                "capacity_every_s": 30.0,  # capacity_snapshot cadence
+            },
         }
     )
 

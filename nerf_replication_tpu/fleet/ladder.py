@@ -122,8 +122,10 @@ class TieredResidencyManager(ResidencyManager):
         return "lru"
 
     def _tier_fields(self) -> dict:
-        return {"staging": len(self._staging),
-                "staging_bytes": self._staging_bytes()}
+        sb = self._staging_bytes()
+        if self.capacity is not None:
+            self.capacity.note_residency(self._resident_bytes(), sb)
+        return {"staging": len(self._staging), "staging_bytes": sb}
 
     # -- staging internals ----------------------------------------------------
 

@@ -112,10 +112,14 @@ class ResidencyManager:
     def __init__(self, registry, loader, budget_bytes: int, *,
                  prefetch: bool = True, verify_checksums: bool = True,
                  cache_entries: int = 64, pose_decimals: int = 3,
-                 validate=None, retry_kw: dict | None = None):
+                 validate=None, retry_kw: dict | None = None,
+                 capacity=None):
         self.registry = registry
         self.loader = loader
         self.budget_bytes = int(budget_bytes)
+        # optional obs.capacity.CapacityLedger: fed authoritative byte
+        # watermarks at every row-emitting tier transition
+        self.capacity = capacity
         self.prefetch_enabled = bool(prefetch)
         self.verify_checksums = bool(verify_checksums)
         self.cache_entries = int(cache_entries)
@@ -444,7 +448,11 @@ class ResidencyManager:
 
     def _tier_fields(self) -> dict:
         """Extra occupancy fields for scene_load/scene_evict rows.
-        Called under the (non-reentrant) lock — do not re-acquire."""
+        Called under the (non-reentrant) lock — do not re-acquire.
+        Also the capacity-ledger watermark hook: every row-emitting
+        transition passes through here, so the ledger sees every peak."""
+        if self.capacity is not None:
+            self.capacity.note_residency(self._resident_bytes(), 0)
         return {}
 
     # -- per-scene pose caches ------------------------------------------------

@@ -16,11 +16,29 @@ row: ``obs.hooks.CompileTracker`` counts compiles/retraces per compiled
 function, ``obs.hooks.sample_memory`` snapshots per-device
 ``memory_stats()``, and the trainer's dispatch-vs-block step-time split
 distinguishes latency-bound from compute-bound regressions.
+
+The ops-intelligence layer closes the loop from telemetry to action:
+``obs/alerts.py`` (multi-window multi-burn-rate SLO alerting),
+``obs/incidents.py`` (auto-correlated incident reports with an
+open→mitigated→resolved lifecycle), and ``obs/capacity.py`` (the
+per-scene capacity/heat ledger the placement planner reads) — all fed
+in-process from the emitter's row-tap bus (``add_row_tap``).
 """
 
-from .emit import Emitter, NullEmitter, append_jsonl, get_emitter, init_run
+from .alerts import AlertEngine, AlertOptions
+from .capacity import CapacityLedger
+from .emit import (
+    Emitter,
+    NullEmitter,
+    add_row_tap,
+    append_jsonl,
+    get_emitter,
+    init_run,
+    remove_row_tap,
+)
 from .hooks import CompileTracker, sample_memory
-from .metrics import MetricsRegistry, get_metrics, reset_metrics
+from .incidents import IncidentManager, validate_incident_dump
+from .metrics import MetricsRegistry, WindowRing, get_metrics, reset_metrics
 from .profiling import ProfileWindow, annotate
 from .schema import SCHEMA_VERSION, validate_bench_row, validate_row
 from .trace import (
@@ -38,7 +56,11 @@ from .trace import (
 __all__ = [
     "SCHEMA_VERSION",
     "TRACE_HEADER",
+    "AlertEngine",
+    "AlertOptions",
+    "CapacityLedger",
     "Emitter",
+    "IncidentManager",
     "MetricsRegistry",
     "NullEmitter",
     "CompileTracker",
@@ -46,6 +68,8 @@ __all__ = [
     "Span",
     "SpanContext",
     "Tracer",
+    "WindowRing",
+    "add_row_tap",
     "annotate",
     "append_jsonl",
     "configure_tracing",
@@ -55,9 +79,11 @@ __all__ = [
     "get_metrics",
     "get_tracer",
     "init_run",
+    "remove_row_tap",
     "reset_metrics",
     "sample_memory",
     "trace_headers",
     "validate_bench_row",
+    "validate_incident_dump",
     "validate_row",
 ]

@@ -22,17 +22,55 @@ import time
 
 from .schema import SCHEMA_VERSION
 
+# -- row taps ----------------------------------------------------------------
+# In-process consumers of the telemetry stream (the alert engine, the
+# incident correlator, the capacity ledger — obs/alerts.py etc.) subscribe
+# here and see every emitted row as a dict, the same pattern as
+# ``Tracer.add_sink``. Taps fire on BOTH emitters — a non-chief process
+# (NullEmitter) still feeds its local engines even though nothing reaches
+# disk — and a raising tap is dropped from the fan-out, never allowed to
+# break emission.
+
+_row_taps: list = []
+
+
+def add_row_tap(fn) -> None:
+    """Subscribe ``fn(row_dict)`` to every emitted telemetry row."""
+    if fn not in _row_taps:
+        _row_taps.append(fn)
+
+
+def remove_row_tap(fn) -> None:
+    try:
+        _row_taps.remove(fn)
+    except ValueError:
+        pass
+
+
+def _fire_row_taps(row: dict) -> None:
+    for fn in list(_row_taps):
+        try:
+            fn(row)
+        # graftlint: ok(swallow: a broken tap must not break telemetry emission; it is dropped from the fan-out)
+        except Exception:
+            remove_row_tap(fn)
+
 
 class NullEmitter:
     """No-op emitter: what non-chief processes (and uninitialized call
-    sites) write through, so emission is unconditional at call sites."""
+    sites) write through, so emission is unconditional at call sites.
+    Row taps still fire — in-process consumers see the stream even when
+    nothing reaches disk."""
 
     chief = False
     path = None
     run_id = ""
 
     def emit(self, kind: str, **fields) -> None:
-        pass
+        if _row_taps:
+            _fire_row_taps(
+                {"v": SCHEMA_VERSION, "kind": kind, "t": time.time(),
+                 **fields})
 
     def close(self) -> None:
         pass
@@ -66,6 +104,8 @@ class Emitter:
         self._rows_since_sync += 1
         if self._rows_since_sync >= self.FSYNC_EVERY:
             self._sync()
+        if _row_taps:
+            _fire_row_taps(row)
 
     def _sync(self) -> None:
         try:

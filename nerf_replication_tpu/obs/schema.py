@@ -265,6 +265,44 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"attainment": _OPT_NUM, "deny_rate": _NUM, "streak": _NUM,
          "replica": (str,), "evidence": (dict,)},
     ),
+    # -- ops-intelligence rows (obs/alerts.py / obs/incidents.py /
+    # obs/capacity.py, docs/observability.md) --------------------------------
+    # one per alert state TRANSITION (firing | resolved), not per
+    # evaluation: the burn-rate engine's multi-window verdict against one
+    # signal (slo | deny | breaker | orphan_spans | staging_thrash).
+    # burn_fast/burn_slow are the short/long-window burn rates at the
+    # transition (burn-rate alerts only); value is the raw signal level
+    # for direct-condition alerts. window_s names the SHORT window.
+    "alert": (
+        {"name": (str,), "state": (str,), "severity": (str,),
+         "signal": (str,)},
+        {"burn_fast": _OPT_NUM, "burn_slow": _OPT_NUM, "value": _OPT_NUM,
+         "threshold": _NUM, "window_s": _NUM, "replica": (str,),
+         "detail": (str,)},
+    ),
+    # one per incident lifecycle transition (open | mitigated | resolved):
+    # the correlator's record that a timeline dump landed at `path`.
+    # trigger: alert | flight_dump | fault. fault_points/trace_ids are
+    # what the assembled timeline named (the chaos assertion's join keys).
+    "incident": (
+        {"incident_id": (str,), "status": (str,), "trigger": (str,)},
+        {"alert": (str,), "severity": (str,), "n_events": _NUM,
+         "fault_points": (list,), "trace_ids": (list,), "path": (str,),
+         "opened_t": _NUM, "resolved_t": _OPT_NUM, "detail": (str,)},
+    ),
+    # one per capacity-ledger snapshot (obs/capacity.py): the per-scene
+    # heat/byte accounting the placement planner replays. scenes maps
+    # scene id -> {requests_per_s, rays_per_s, bytes, cold_loads,
+    # repromotions}; device_share maps executable family -> device-time
+    # share over the window; byte fields are the replica's HBM/staging
+    # watermarks (current + peak-since-last-snapshot).
+    "capacity_snapshot": (
+        {"replica": (str,), "scenes": (dict,)},
+        {"hbm_bytes": _NUM, "hbm_peak_bytes": _NUM, "staging_bytes": _NUM,
+         "staging_peak_bytes": _NUM, "window_s": _NUM,
+         "device_share": (dict,), "requests_per_s": _NUM,
+         "rays_per_s": _NUM, "cold_loads": _NUM, "repromotions": _NUM},
+    ),
     # -- static analysis (nerf_replication_tpu/analysis) ---------------------
     # one per scripts/graftlint.py run: finding counts split new-vs-baseline
     # so the report can watch the baseline shrink (and flag a lint gate
@@ -313,6 +351,20 @@ def validate_row(row) -> list[str]:
         errors += _validate_span_ctx(row)
     elif kind == "scale_decision" and isinstance(row.get("evidence"), dict):
         errors += _validate_evidence(row["evidence"])
+    elif kind == "alert":
+        if row.get("state") not in ("firing", "resolved"):
+            errors.append(
+                f"alert: state {row.get('state')!r} not in firing|resolved")
+        if row.get("severity") not in ("page", "ticket"):
+            errors.append(
+                f"alert: severity {row.get('severity')!r} not in page|ticket")
+    elif kind == "incident":
+        if row.get("status") not in ("open", "mitigated", "resolved"):
+            errors.append(f"incident: status {row.get('status')!r} not in "
+                          "open|mitigated|resolved")
+        if row.get("trigger") not in ("alert", "flight_dump", "fault"):
+            errors.append(f"incident: trigger {row.get('trigger')!r} not in "
+                          "alert|flight_dump|fault")
     return errors
 
 

@@ -96,12 +96,44 @@ class FlightRecorder:
             except OSError:
                 pass
         os.replace(tmp, path)
+        _fire_dump_listeners(str(reason), path, detail or "")
         return path
 
     def stats(self) -> dict:
         with self._lock:
             return {"spans": len(self._spans), "events": len(self._events),
                     "capacity": self.capacity, "dumps": self._dumps}
+
+
+# -- dump listeners ----------------------------------------------------------
+# In-process consumers notified after a flight dump lands on disk — the
+# incident correlator (obs/incidents.py) opens an incident from here. The
+# wiring direction matters: obs never imports resil, so the caller
+# (serve.py, chaos_run) registers ``mgr.on_flight_dump`` with us.
+
+_dump_listeners: list = []
+
+
+def add_dump_listener(fn) -> None:
+    """Subscribe ``fn(reason, path, detail)`` to every flight dump."""
+    if fn not in _dump_listeners:
+        _dump_listeners.append(fn)
+
+
+def remove_dump_listener(fn) -> None:
+    try:
+        _dump_listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+def _fire_dump_listeners(reason: str, path: str, detail: str) -> None:
+    for fn in list(_dump_listeners):
+        try:
+            fn(reason, path, detail)
+        # graftlint: ok(swallow: a broken listener must never turn a crash dump into a second crash; it is dropped)
+        except Exception:
+            remove_dump_listener(fn)
 
 
 # one recorder per process; None = flight recording disabled (the default

@@ -287,6 +287,47 @@ class FleetMetricsAggregator:
                 break
         return out
 
+    def capacity_view(self) -> dict:
+        """Fresh scrape → the fleet's capacity ledger, per replica: the
+        ``capacity_*`` gauges each replica's
+        :class:`~..obs.capacity.CapacityLedger` published, grouped by
+        the ``replica`` label the merge injected (the ``GET
+        /fleet/capacity`` payload — what the placement planner reads)."""
+        merged = merge_scrapes(self.scrape())
+        replicas: dict[str, dict] = {}
+        for line in merged.splitlines():
+            m = _SAMPLE_RE.match(line.strip())
+            if m is None:
+                continue
+            name, body, value, _ex = m.groups()
+            if not name.startswith("capacity_"):
+                continue
+            try:
+                val = float(value)
+            except ValueError:
+                continue
+            labels = parse_labels(body)
+            rep = replicas.setdefault(labels.get("replica", ""), {})
+            if name == "capacity_scene_requests_per_s":
+                rep.setdefault("scenes", {}).setdefault(
+                    labels.get("scene", ""), {})["requests_per_s"] = val
+            elif name == "capacity_scene_rays_per_s":
+                rep.setdefault("scenes", {}).setdefault(
+                    labels.get("scene", ""), {})["rays_per_s"] = val
+            elif name == "capacity_scene_cold_loads":
+                rep.setdefault("scenes", {}).setdefault(
+                    labels.get("scene", ""), {})["cold_loads"] = int(val)
+            elif name == "capacity_scene_repromotions":
+                rep.setdefault("scenes", {}).setdefault(
+                    labels.get("scene", ""), {})["repromotions"] = int(val)
+            elif name == "capacity_device_share":
+                rep.setdefault("device_share", {})[
+                    labels.get("family", "")] = val
+            else:
+                # the byte watermarks: capacity_hbm_bytes etc.
+                rep[name[len("capacity_"):]] = int(val)
+        return {"replicas": replicas, "n_replicas": len(replicas)}
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -300,8 +341,9 @@ class FleetMetricsAggregator:
 def make_fleet_server(aggregator: FleetMetricsAggregator,
                       host: str = "127.0.0.1", port: int = 0):
     """The router-side HTTP face of the aggregator: ``GET
-    /fleet/metrics`` (merged Prometheus text) and ``GET /fleet/slo``
-    (JSON). Returns the configured ``ThreadingHTTPServer`` (caller
+    /fleet/metrics`` (merged Prometheus text), ``GET /fleet/slo``
+    (JSON), and ``GET /fleet/capacity`` (the per-replica capacity
+    ledger). Returns the configured ``ThreadingHTTPServer`` (caller
     serves it; ``server.server_address[1]`` is the bound port)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -325,6 +367,9 @@ def make_fleet_server(aggregator: FleetMetricsAggregator,
                                "text/plain; version=0.0.4")
                 elif self.path == "/fleet/slo":
                     body = json.dumps(aggregator.slo_view()).encode()
+                    self._send(200, body, "application/json")
+                elif self.path == "/fleet/capacity":
+                    body = json.dumps(aggregator.capacity_view()).encode()
                     self._send(200, body, "application/json")
                 else:
                     self._send(404, b'{"error": "not found"}',

@@ -52,11 +52,14 @@ class InProcessReplica:
     """One engine + batcher wearing the replica surface."""
 
     def __init__(self, replica_id: str, engine, batcher,
-                 clock=time.monotonic):
+                 clock=time.monotonic, capacity=None):
         self.replica_id = str(replica_id)
         self.engine = engine
         self.batcher = batcher
         self.clock = clock
+        # optional obs.capacity.CapacityLedger: per-scene heat accounting
+        # on the submit path (serve_bench snapshots one per replica)
+        self.capacity = capacity
         self.state = ReplicaState.READY
         self.n_submitted = 0
         self.spawned_t = clock()
@@ -89,6 +92,8 @@ class InProcessReplica:
                 f"replica {self.replica_id} is {self.state}"
             )
         self.n_submitted += 1
+        if self.capacity is not None:
+            self.capacity.note_request(scene or "default", len(rays))
         return self.batcher.submit(rays, near, far, scene=scene,
                                    tenant=tenant, ctx=ctx)
 

@@ -57,13 +57,16 @@ class Supervisor:
 
     def __init__(self, router, spawn_fn, options: ScaleOptions | None = None,
                  clock=time.monotonic, evidence_source=None,
-                 slo_target_s: float = 0.25):
+                 slo_target_s: float = 0.25, alerts=None):
         self.router = router
         self.spawn_fn = spawn_fn
         self.options = options or ScaleOptions()
         self.clock = clock
         self.evidence_source = evidence_source
         self.slo_target_s = float(slo_target_s)
+        # optional obs.alerts.AlertEngine fed the fleet-merged window in
+        # step_from_fleet — the burn-rate alerts see what the loop sees
+        self.alerts = alerts
         self._spawn_index = 0
         self._out_streak = 0
         self._in_streak = 0
@@ -269,6 +272,14 @@ class Supervisor:
             backlog = sum(aggregator.router.load_view().values())
             if backlog > 0 or w.get("no_replica", 0) > 0:
                 attainment = 0.0
+        if self.alerts is not None:
+            # a wedged fleet completes nothing, so weight the forced-0.0
+            # attainment by at least one observation or no bad count
+            # would ever accumulate and the page would never fire
+            n = max(int(w.get("requests", 0)),
+                    1 if attainment is not None else 0)
+            self.alerts.observe_window(attainment, w["deny_rate"], n)
+            self.alerts.evaluate()
         return self.step(attainment, deny_rate=w["deny_rate"])
 
     def stats(self) -> dict:

@@ -63,9 +63,25 @@ post-mortem in the workdir's record dir whose event ring names the
 injected fault — ``check_flight`` asserts it, and a missing or
 cause-less dump fails the run.
 
+Every injected-fault scenario also self-documents as an INCIDENT
+(obs/incidents.py): the correlator opens on the first injected fault
+row, assembles the fault/retry/breaker/residency timeline around it,
+and the harness force-resolves it once the recovery checks pass.
+``check_incidents`` then fails the run unless a schema-valid RESOLVED
+``incident_*.json`` names the injected fault point — and a clean run
+(no ``--fault`` specs, no deliberate tenant flood) must leave ZERO
+incident files. ``--alerts`` adds a chaos-scaled burn-rate alert
+engine (obs/alerts.py, second-scale windows) and requires the breaker
+scenario to PAGE (``breaker_open``, severity page) on the fast window
+while the circuit is open and to clear after recovery:
+
+    python scripts/chaos_run.py serve --alerts \\
+        --fault serve.flush:io_error:2:5 --requests 40
+
 Exit code 0 = the run RECOVERED (it completed, no retry ladder was
 exhausted, and — serve — the steady-state stream triggered zero
-recompiles) AND every required flight dump exists and names its fault.
+recompiles) AND every required flight dump exists and names its fault
+AND the incident (and, with ``--alerts``, paging) contract above held.
 """
 
 from __future__ import annotations
@@ -140,6 +156,76 @@ def _scene(workdir: str) -> str:
     return root
 
 
+def _ops_attach(record_dir: str, with_alerts: bool = False):
+    """PR 16 ops wiring for one chaos run: an incident correlator that
+    opens on every injected fault row (each scenario self-documents as
+    ``incident_*.json``) and — under ``--alerts`` — a chaos-scaled
+    burn-rate AlertEngine whose breaker_open page is asserted by
+    ``check_alerts``."""
+    from nerf_replication_tpu.obs import (
+        AlertEngine,
+        AlertOptions,
+        IncidentManager,
+    )
+    from nerf_replication_tpu.resil.flight import add_dump_listener
+
+    incidents = IncidentManager(record_dir, open_on_fault=True).attach()
+    add_dump_listener(incidents.on_flight_dump)
+    alerts = None
+    if with_alerts:
+        # second-scale windows so a ~10s chaos stream spans many of
+        # them; clear_hold 0 lets the page resolve the moment the
+        # breaker re-closes; the generous latency target keeps CPU
+        # render time from paging over the breaker signal under test
+        alerts = AlertEngine(AlertOptions(
+            fast_short_s=1.0, fast_long_s=5.0,
+            slow_short_s=2.0, slow_long_s=10.0,
+            clear_hold_s=0.0,
+        ), slo_target_s=30.0).attach()
+        alerts.add_listener(incidents.on_alert)
+    return incidents, alerts
+
+
+def _ops_finish(incidents, alerts) -> dict:
+    """Final alert pass + force-resolve + detach; the outcome blocks."""
+    from nerf_replication_tpu.resil.flight import remove_dump_listener
+
+    if alerts is not None:
+        # the breaker re-closed during the stream's recovery tail; give
+        # the engine a bounded window to observe that and clear the page
+        deadline = time.monotonic() + 3.0
+        while True:
+            alerts.evaluate()
+            if "breaker_open" not in alerts.active() \
+                    or time.monotonic() >= deadline:
+                break
+            time.sleep(0.1)
+    forced = incidents.resolve_open("chaos recovery checks passed")
+    remove_dump_listener(incidents.on_flight_dump)
+    incidents.detach()
+    out: dict = {"incidents": {
+        "n_incidents": len(incidents.incidents),
+        "n_resolved": sum(1 for i in incidents.incidents
+                          if i["status"] == "resolved"),
+        "force_resolved": forced,
+        "fault_points": sorted({p for i in incidents.incidents
+                                for p in i["fault_points"]}),
+        "paths": [i["path"] for i in incidents.incidents],
+    }}
+    if alerts is not None:
+        alerts.remove_listener(incidents.on_alert)
+        alerts.detach()
+        out["alerts"] = {
+            "transitions": [{"name": t["name"], "state": t["state"],
+                             "severity": t["severity"]}
+                            for t in alerts.transitions],
+            "alert_seconds": {k: round(v, 3)
+                              for k, v in alerts.alert_seconds.items()},
+            "still_firing": alerts.active(),
+        }
+    return out
+
+
 def run_train(args, plan) -> dict:
     """fit() on the tiny scene under the plan; survives injected faults
     the library is supposed to absorb, reports the ones it isn't."""
@@ -158,6 +244,9 @@ def run_train(args, plan) -> dict:
          "log_interval", "5"],
     )
     outcome = {"mode": "train", "completed": False, "died": None}
+    # each injected fault must end the run with a resolved
+    # incident_*.json naming it — check_incidents() asserts it
+    incidents, _ = _ops_attach(os.path.join(args.workdir, "record"))
     t0 = time.perf_counter()
     with injecting(plan):
         try:
@@ -170,6 +259,7 @@ def run_train(args, plan) -> dict:
     outcome["wall_s"] = round(time.perf_counter() - t0, 2)
     outcome["telemetry"] = os.path.join(str(cfg.record_dir),
                                         "telemetry.jsonl")
+    outcome.update(_ops_finish(incidents, None))
     return outcome
 
 
@@ -250,7 +340,10 @@ def run_serve(args, plan) -> dict:
          "serve.max_batch_rays", "256",
          "serve.max_delay_ms", "5.0",
          "serve.request_timeout_s", "10.0",
-         "serve.shed_queue_depths", "[8, 16, 32, 64]"],
+         "serve.shed_queue_depths", "[8, 16, 32, 64]"]
+        # --alerts: a 1s probe delay so the breaker re-closes (and the
+        # page clears) WITHIN the chaos stream rather than after it
+        + (["resil.breaker_cooldown_s", "1.0"] if args.alerts else []),
     )
     telem = os.path.join(args.workdir, "record", "telemetry.jsonl")
     init_run(cfg, component="serve", path=telem)
@@ -267,6 +360,7 @@ def run_serve(args, plan) -> dict:
     flight_dir = os.path.join(args.workdir, "record")
     configure_tracing(enabled=True)
     install_flight_recorder(FlightRecorder(flight_dir))
+    incidents, alerts = _ops_attach(flight_dir, with_alerts=args.alerts)
     network = make_network(cfg)
     params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
     bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
@@ -359,9 +453,14 @@ def run_serve(args, plan) -> dict:
                 # the futures: RuntimeError for a crashed worker, OSError
                 # for an injected/organic I/O failure
                 failed += 1
+            if alerts is not None:
+                # per-request evaluation: the page must fire WHILE the
+                # breaker is open, not be reconstructed afterwards
+                alerts.evaluate()
     wall = time.perf_counter() - t0
     health = batcher.health()
     batcher.close(drain=False)
+    ops = _ops_finish(incidents, alerts)
     uninstall_flight_recorder()
     configure_tracing(enabled=False)
     out = {
@@ -402,6 +501,7 @@ def run_serve(args, plan) -> dict:
             "quiet_tenants": len(quiet_ids),
             "quiet_tenants_served": quiet_served,
         }
+    out.update(ops)
     out["flight_dumps"] = _scan_flight_dumps(flight_dir)
     return out
 
@@ -460,6 +560,7 @@ def run_serve_replicas(args, plan) -> dict:
     flight_dir = os.path.join(args.workdir, "record")
     configure_tracing(enabled=True)
     install_flight_recorder(FlightRecorder(flight_dir))
+    incidents, alerts = _ops_attach(flight_dir, with_alerts=args.alerts)
     network = make_network(cfg)
     params = init_params_for(cfg)(network, jax.random.PRNGKey(0))
     bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
@@ -521,11 +622,14 @@ def run_serve_replicas(args, plan) -> dict:
                 failed += 1
                 if killed is not None:
                     post_kill_failed += 1
+            if alerts is not None:
+                alerts.evaluate()
     wall = time.perf_counter() - t0_run
     drain_failures = 0
     for r in fleet:
         if r.state in (ReplicaState.STARTING, ReplicaState.READY):
             drain_failures += r.drain(timeout_s=30.0)
+    ops = _ops_finish(incidents, alerts)
     uninstall_flight_recorder()
     configure_tracing(enabled=False)
     p95_after = None
@@ -533,7 +637,7 @@ def run_serve_replicas(args, plan) -> dict:
         lat_sorted = sorted(lats_after)
         p95_after = lat_sorted[min(len(lat_sorted) - 1,
                                    int(0.95 * len(lat_sorted)))]
-    return {
+    out = {
         "mode": "serve",
         "completed": True,
         "died": None,
@@ -559,6 +663,8 @@ def run_serve_replicas(args, plan) -> dict:
         },
         "flight_dumps": _scan_flight_dumps(flight_dir),
     }
+    out.update(ops)
+    return out
 
 
 def _scan_flight_dumps(flight_dir: str) -> dict:
@@ -641,6 +747,64 @@ def check_flight(outcome: dict, summary: dict, plan) -> tuple[bool, list]:
     return (not problems, problems)
 
 
+def check_incidents(outcome: dict, plan, args) -> tuple[bool, list]:
+    """The incident acceptance: every injected-fault run must end with a
+    schema-valid RESOLVED incident whose fault_points name an injected
+    fault; a clean run (no faults, no deliberate tenant flood — whose
+    throttle dump legitimately opens one) must leave ZERO incident
+    files."""
+    from nerf_replication_tpu.obs import validate_incident_dump
+
+    problems: list = []
+    info = outcome.get("incidents") or {}
+    paths = info.get("paths") or []
+    injected = {f"{s.point}:{s.kind}" for s in plan.specs}
+    for path in paths:
+        errs = validate_incident_dump(path)
+        if errs:
+            problems.append(f"{os.path.basename(path)} invalid: {errs[:3]}")
+    if injected:
+        hit = False
+        for path in paths:
+            try:
+                with open(path) as fh:
+                    inc = json.load(fh)
+            except (OSError, ValueError):
+                continue  # already reported invalid above
+            if inc.get("status") == "resolved" and \
+                    set(inc.get("fault_points") or ()) & injected:
+                hit = True
+        if not hit:
+            problems.append(
+                "no RESOLVED incident names an injected fault (injected "
+                f"{sorted(injected)}, incidents named "
+                f"{info.get('fault_points')})")
+    elif args.tenants == 0 and paths:
+        problems.append(
+            f"clean run left {len(paths)} incident file(s): "
+            + ", ".join(os.path.basename(p) for p in paths))
+    return (not problems, problems)
+
+
+def check_alerts(outcome: dict) -> tuple[bool, list]:
+    """The --alerts acceptance: the breaker scenario must PAGE
+    (``breaker_open`` firing at severity page) on the fast window while
+    the circuit was open, and the page must clear after recovery."""
+    problems: list = []
+    trans = (outcome.get("alerts") or {}).get("transitions") or []
+    fired = [t for t in trans
+             if t["name"] == "breaker_open" and t["state"] == "firing"]
+    cleared = [t for t in trans
+               if t["name"] == "breaker_open" and t["state"] == "resolved"]
+    if not fired:
+        problems.append("breaker_open never fired")
+    elif any(t["severity"] != "page" for t in fired):
+        problems.append("breaker_open fired below page severity")
+    if fired and not cleared:
+        problems.append("breaker_open page never cleared after recovery")
+    return (not problems, problems)
+
+
 def summarize_telemetry(path: str) -> dict:
     """fault/retry/breaker row counts from one run's telemetry stream."""
     out = {
@@ -701,6 +865,11 @@ def main(argv=None) -> int:
                         "recovery requires a router failover, a 1:1 "
                         "supervisor replacement, zero post-kill "
                         "failures, and a clean drain")
+    p.add_argument("--alerts", action="store_true",
+                   help="serve mode: run the chaos-scaled burn-rate "
+                        "alert engine — the breaker scenario must PAGE "
+                        "(breaker_open) on the fast window while the "
+                        "circuit is open and clear after recovery")
     p.add_argument("--backend", default="cpu",
                    help="platform pin ('cpu', 'cpu:8'; '' = inherit)")
     p.add_argument("--workdir",
@@ -772,15 +941,28 @@ def main(argv=None) -> int:
         ))
     )
     flight_ok, flight_problems = check_flight(outcome, summary, plan)
+    incidents_ok, incident_problems = check_incidents(outcome, plan, args)
+    alerts_ok, alert_problems = ((True, []) if not args.alerts
+                                 else check_alerts(outcome))
     print(json.dumps({"outcome": outcome, "telemetry_summary": summary,
                       "recovered": recovered, "flight_ok": flight_ok,
-                      "flight_problems": flight_problems}, indent=2))
+                      "flight_problems": flight_problems,
+                      "incidents_ok": incidents_ok,
+                      "incident_problems": incident_problems,
+                      "alerts_ok": alerts_ok,
+                      "alert_problems": alert_problems}, indent=2))
     print(f"chaos: {'RECOVERED' if recovered else 'UNRECOVERED'} — "
           f"{plan.injected()} injected, "
           f"{summary['retries_exhausted']} exhausted retries")
     if not flight_ok:
         print("flight recorder FAILED: " + "; ".join(flight_problems))
-    return 0 if (recovered and flight_ok) else 1
+    if not incidents_ok:
+        print("incident correlation FAILED: "
+              + "; ".join(incident_problems))
+    if not alerts_ok:
+        print("alerting FAILED: " + "; ".join(alert_problems))
+    return 0 if (recovered and flight_ok and incidents_ok
+                 and alerts_ok) else 1
 
 
 if __name__ == "__main__":

@@ -17,10 +17,12 @@ scripts/bench_sampling.py) via the ``BENCH_*.jsonl`` pattern.
 Files named ``telemetry*.jsonl`` are checked row-by-row against the typed
 telemetry schema (``obs/schema.py:ROW_KINDS``) — including the fleet-obs
 deep checks: span rows' propagated-context fields (alnum trace/span ids;
-``remote_parent`` only ever alongside a parent id) and ``scale_decision``
+``remote_parent`` only ever alongside a parent id), ``scale_decision``
 rows' ``evidence`` block (attainment series, per-replica queue depths,
 deny rate, alnum exemplar trace ids — unknown evidence keys are
-errors). Every other JSONL is
+errors), and the ops-intelligence rows PR 16 added (``alert`` state/
+severity enums, ``incident`` lifecycle status, ``capacity_snapshot``
+per-replica ledger commits). Every other JSONL is
 checked structurally against the known bench row families — so a bench
 script that drifts shape (the pre-PR-1 failure mode: three incompatible
 row families grew across ten scripts) fails here instead of silently
@@ -31,7 +33,10 @@ hand-edited baseline that drops a required field fails here, not at the
 next lint run. Flight-recorder dumps (``flight_<reason>.json``, written
 by resil/flight.py on breaker-open / watchdog crash / SceneError /
 SIGTERM) validate against ``validate_flight_dump`` when passed
-explicitly. Exit code is nonzero on any invalid row; host-only (no JAX
+explicitly; incident dumps (``incident_<id>.json``, written by
+obs/incidents.py when an alert fires / a flight dump lands / a chaos
+fault injects) validate against ``validate_incident_dump`` the same
+way. Exit code is nonzero on any invalid row; host-only (no JAX
 import).
 """
 
@@ -76,12 +81,22 @@ def check_flight_file(path: str) -> list[str]:
     return [f"{path}: {e}" for e in validate_flight_dump(data)]
 
 
+def check_incident_file(path: str) -> list[str]:
+    """Errors for an incident dump (whole-file JSON, not JSONL)."""
+    from nerf_replication_tpu.obs.incidents import validate_incident_dump
+
+    return [f"{path}: {e}" for e in validate_incident_dump(path)]
+
+
 def check_file(path: str, max_report: int = 5) -> list[str]:
     """Errors for one file (truncated to ``max_report`` rows' worth)."""
     if os.path.basename(path).startswith("graftlint_baseline"):
         return check_baseline_file(path)
     if os.path.basename(path).startswith("flight_"):
         return check_flight_file(path)
+    if os.path.basename(path).startswith("incident_") and \
+            path.endswith(".json"):
+        return check_incident_file(path)
     telemetry = os.path.basename(path).startswith("telemetry")
     validate = validate_row if telemetry else validate_bench_row
     errors: list[str] = []

@@ -72,7 +72,15 @@ tracing-off arm of the same mode — acceptance wants ≤ 5%).
   ``warm_source == "disk"`` with zero compiles (artifact warm-start, not
   a recompile), zero drain failures, ``compiles_steady == 0`` across
   the whole cycle, and — with tracing on — every capacity action citing
-  >= 1 exemplar trace id.
+  >= 1 exemplar trace id. The run also carries the PR 16 ops loop: a
+  bench-scaled burn-rate AlertEngine rides the supervisor's merged
+  windows and must PAGE during the spike and clear by the end of the
+  sustain phase at <= 5% overhead (``alert_page_during_spike`` /
+  ``alert_cleared_at_end`` / ``alert_overhead_pct``); the page opens an
+  incident whose resolved dump links the scale decisions' exemplar
+  trace ids (``incidents_linked``, gated with tracing on); and every
+  replica's capacity ledger commits a ``capacity_snapshot`` telemetry
+  row at teardown (``capacity_snapshots``).
 
     python scripts/serve_bench.py --backend cpu
     python scripts/serve_bench.py --backend cpu --mode open --rate 200
@@ -683,12 +691,19 @@ def _build_scale_shared(args):
     return cfg, network, params, grid, bbox
 
 
-def _make_replica_factory(cfg, shared, fleet: list):
+def _make_replica_factory(cfg, shared, fleet: list, ledgers=None,
+                          heat_window_s: float = 300.0):
     """spawn_fn(i) for the supervisor: one FULL stack per replica (own
     engine, tracker, AOT registry, batcher) so a kill or drain touches
-    nothing the other replicas hold."""
+    nothing the other replicas hold. With ``ledgers`` (a dict), each
+    replica also gets its own :class:`~..obs.capacity.CapacityLedger`
+    seeded with the shared params' HBM bytes — the per-scene heat /
+    watermark accounting the end-of-run ``capacity_snapshot`` rows
+    commit."""
 
     def spawn(i: int):
+        import jax
+
         from nerf_replication_tpu.compile import AOTRegistry
         from nerf_replication_tpu.obs import CompileTracker
         from nerf_replication_tpu.obs.emit import config_hash
@@ -704,8 +719,20 @@ def _make_replica_factory(cfg, shared, fleet: list):
         engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
                               grid=grid, bbox=bbox, tracker=tracker,
                               aot=aot)
+        capacity = None
+        if ledgers is not None:
+            from nerf_replication_tpu.obs import CapacityLedger
+
+            capacity = CapacityLedger(replica=f"replica{i}",
+                                      window_s=heat_window_s)
+            # the shared params are this replica's HBM residency; the
+            # grid/bbox ride along in the same watermark
+            capacity.note_residency(
+                sum(int(leaf.nbytes) for leaf in jax.tree.leaves(params))
+                + int(grid.nbytes) + int(bbox.nbytes), 0)
+            ledgers[f"replica{i}"] = capacity
         replica = InProcessReplica(f"replica{i}", engine,
-                                   MicroBatcher(engine))
+                                   MicroBatcher(engine), capacity=capacity)
         replica.boot_s = time.perf_counter() - t0
         fleet.append(replica)
         print(f"  replica{i}: warm_source={replica.warm_source} "
@@ -798,9 +825,22 @@ def _run_scale(args) -> tuple[dict, bool]:
     attainment walks the in-streak until the supervisor drains the extra
     replica back out. The row gates on the cycle actually happening:
     >=1 scale-out, >=1 scale-in, fresh replicas warm from disk with zero
-    builds, zero drain failures, zero steady-state recompiles."""
+    builds, zero drain failures, zero steady-state recompiles — and the
+    PR 16 ops-intelligence contract: the spike PAGES a fast-window
+    burn-rate alert, the page clears by the end of the sustain phase at
+    <= 5% engine overhead, the paged incident correlates the cycle, and
+    every replica commits a ``capacity_snapshot`` row."""
     import numpy as np
 
+    from nerf_replication_tpu.obs import (
+        AlertEngine,
+        AlertOptions,
+        IncidentManager,
+    )
+    from nerf_replication_tpu.resil.flight import (
+        add_dump_listener,
+        remove_dump_listener,
+    )
     from nerf_replication_tpu.scale import (
         FleetMetricsAggregator,
         Router,
@@ -810,7 +850,10 @@ def _run_scale(args) -> tuple[dict, bool]:
 
     cfg, network, params, grid, bbox = _build_scale_shared(args)
     fleet: list = []
-    spawn = _make_replica_factory(cfg, (network, params, grid, bbox), fleet)
+    ledgers: dict = {}
+    spawn = _make_replica_factory(cfg, (network, params, grid, bbox), fleet,
+                                  ledgers=ledgers,
+                                  heat_window_s=max(4.0 * args.window_s, 5.0))
     opts = ScaleOptions(
         min_replicas=1, max_replicas=max(2, args.replicas),
         out_below=0.90, in_above=0.95, deny_above=1.0,
@@ -824,8 +867,22 @@ def _run_scale(args) -> tuple[dict, bool]:
     # shows the operator, and cites it: every out/in decision row carries
     # the aggregator's attainment window + SLO-miss exemplar trace ids
     agg = FleetMetricsAggregator(router, slo_target_s=slo_s)
+    # burn-rate alerting scaled to the bench's windows (production runs
+    # 5m/1h; here one --window-s observation IS the fast-short window).
+    # NOT row-tapped: the engine sees only the supervisor's fleet-merged
+    # observe_window feed, so attainment isn't double counted
+    alerts = AlertEngine(AlertOptions(
+        fast_short_s=args.window_s, fast_long_s=4.0 * args.window_s,
+        slow_short_s=2.0 * args.window_s, slow_long_s=8.0 * args.window_s,
+        clear_hold_s=0.0,
+    ), slo_target_s=slo_s)
+    incidents = IncidentManager(args.record_dir).attach()
+    alerts.add_listener(incidents.on_alert)
+    add_dump_listener(incidents.on_flight_dump)
+    page_names = {"slo_burn_page", "deny_burn_page", "breaker_open"}
     sup = Supervisor(router, spawn, options=opts,
-                     evidence_source=agg, slo_target_s=slo_s)
+                     evidence_source=agg, slo_target_s=slo_s,
+                     alerts=alerts)
     print(f"scale: booting replica 0 (cold — compiles + serializes to "
           f"{cfg.compile.dir})")
     sup.ensure_min()
@@ -837,6 +894,7 @@ def _run_scale(args) -> tuple[dict, bool]:
     windows: list = []
     actions: list = []
     first_out_i = None
+    spike_paged = False
     t_cycle = time.perf_counter()
     phases = [("spike", args.rate, args.spike_windows),
               ("sustain", sustain_rate, args.sustain_windows)]
@@ -848,6 +906,8 @@ def _run_scale(args) -> tuple[dict, bool]:
             actions.append(action)
             if action == "out" and first_out_i is None:
                 first_out_i = len(windows)
+            if phase == "spike" and not spike_paged:
+                spike_paged = bool(page_names & set(alerts.active()))
             w.update(phase=phase, rate=rate, action=action,
                      n_ready=router.n_ready())
             windows.append(w)
@@ -856,12 +916,27 @@ def _run_scale(args) -> tuple[dict, bool]:
                   f"attainment={'-' if att is None else f'{att:.3f}'} "
                   f"p95={w['p95_ms']:.0f}ms shed={w['shed']} "
                   f"late={w['late']} -> {action} "
-                  f"(replicas={w['n_ready']})")
+                  f"(replicas={w['n_ready']}, "
+                  f"alerts={alerts.active() or '-'})")
     wall_s = time.perf_counter() - t_cycle
     # retire whatever still serves; spawned-but-drained batchers are done
     for r in fleet:
         if r.state in ("starting", "ready"):
             r.drain(timeout_s=30.0)
+    # final ops pass: the page must have CLEARED on the fast window now
+    # that sustain-phase attainment recovered; the incident the page
+    # opened resolves with its full timeline (the out/in decisions and
+    # their exemplar trace ids land in the re-assembled dump); every
+    # replica commits its capacity_snapshot row
+    alerts.evaluate()
+    cleared_at_end = not (page_names & set(alerts.active()))
+    incidents.resolve_open("bench cycle complete; attainment recovered")
+    remove_dump_listener(incidents.on_flight_dump)
+    incidents.detach()
+    alerts.remove_listener(incidents.on_alert)
+    capacity_snaps = [lg.snapshot() for lg in ledgers.values()]
+    alert_overhead_pct = (alerts.self_s / wall_s * 100.0) if wall_s else 0.0
+    incidents_linked = sum(1 for i in incidents.incidents if i["trace_ids"])
     compiles_steady = sum(
         int(r.engine.tracker.total_compiles()) - r.warm_compiles
         for r in fleet
@@ -899,6 +974,16 @@ def _run_scale(args) -> tuple[dict, bool]:
         "rps": done_total / wall_s if wall_s else 0.0,
         "actions_with_evidence": len(with_ev),
         "actions_evidence_free": len(acted) - len(with_ev),
+        "alerts_fired": sum(1 for t in alerts.transitions
+                            if t["state"] == "firing"),
+        "alerts_cleared": sum(1 for t in alerts.transitions
+                              if t["state"] == "resolved"),
+        "alert_page_during_spike": int(spike_paged),
+        "alert_cleared_at_end": int(cleared_at_end),
+        "alert_overhead_pct": round(alert_overhead_pct, 3),
+        "n_incidents": len(incidents.incidents),
+        "incidents_linked": incidents_linked,
+        "capacity_snapshots": len(capacity_snaps),
         "fleet_scrape_rounds": agg.stats()["n_scrape_rounds"],
         "slo_ms": args.slo_ms,
         "window_s": args.window_s,
@@ -934,6 +1019,21 @@ def _run_scale(args) -> tuple[dict, bool]:
             and row["attainment_recovered"] is not None
             and row["attainment_recovered"] <= row["attainment_low"]):
         print("WARNING: attainment never recovered after scale-out")
+        failed = True
+    if not spike_paged:
+        print("WARNING: the spike never paged a fast-window burn alert")
+        failed = True
+    if not cleared_at_end:
+        print("WARNING: a page-severity alert was still firing after the "
+              "sustain phase")
+        failed = True
+    if alert_overhead_pct > 5.0:
+        print(f"WARNING: alert-engine overhead {alert_overhead_pct:.2f}% "
+              "exceeds the 5% budget")
+        failed = True
+    if len(capacity_snaps) != len(fleet):
+        print(f"WARNING: {len(capacity_snaps)} capacity snapshots for "
+              f"{len(fleet)} replicas")
         failed = True
     return row, failed
 
@@ -1055,6 +1155,11 @@ def main(argv=None) -> int:
                               "capacity action(s) carried no exemplar "
                               "evidence with tracing on")
                         failed = True
+                    if row["n_incidents"] and not row["incidents_linked"]:
+                        print("WARNING: no incident links a "
+                              "scale_decision exemplar trace id with "
+                              "tracing on")
+                        failed = True
                 else:
                     rps_off = row["rps"]
                 append_jsonl(args.out_scale, row)
@@ -1075,7 +1180,14 @@ def main(argv=None) -> int:
                     f"evidence={row['actions_with_evidence']}/"
                     f"{row['actions_with_evidence'] + row['actions_evidence_free']}, "
                     f"drain_failures={row['drain_failures']}, "
-                    f"recompiles_steady={row['compiles_steady']}" + extra
+                    f"recompiles_steady={row['compiles_steady']}, "
+                    f"alerts={row['alerts_fired']}/{row['alerts_cleared']} "
+                    f"(page_in_spike={row['alert_page_during_spike']}, "
+                    f"overhead={row['alert_overhead_pct']}%), "
+                    f"incidents={row['n_incidents']} "
+                    f"(linked={row['incidents_linked']}), "
+                    f"capacity_snapshots={row['capacity_snapshots']}"
+                    + extra
                 )
         finally:
             configure_tracing(enabled=False)

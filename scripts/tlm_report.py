@@ -524,6 +524,71 @@ def summarize(rows: list[dict]) -> dict:
         summary["scale_actions_with_evidence"] = len(with_ev)
         summary["scale_actions_evidence_free"] = len(acted) - len(with_ev)
 
+    # ops-intelligence rows (obs/alerts.py / incidents.py / capacity.py):
+    # alert transitions + firing minutes per alert, the incident
+    # lifecycle ledger (unresolved count is a --diff gate), and the last
+    # capacity_snapshot per replica. Keys present only when the run
+    # alerted (serve.py with obs.alerts.enabled / serve_bench / chaos).
+    alert_rows = [r for r in rows if r.get("kind") == "alert"]
+    if alert_rows:
+        fired: dict = {}
+        fire_t: dict = {}
+        seconds: dict = {}
+        pages = 0
+        for r in alert_rows:
+            name = r.get("name", "?")
+            if r.get("state") == "firing":
+                fired[name] = fired.get(name, 0) + 1
+                fire_t[name] = float(r.get("t", 0.0))
+                if r.get("severity") == "page":
+                    pages += 1
+            elif r.get("state") == "resolved" and name in fire_t:
+                seconds[name] = seconds.get(name, 0.0) + (
+                    float(r.get("t", 0.0)) - fire_t.pop(name))
+        summary["alerts_fired"] = sum(fired.values())
+        summary["alert_pages"] = pages
+        summary["alerts_by_name"] = {k: fired[k] for k in sorted(fired)}
+        summary["alerts_unresolved"] = sorted(fire_t)
+        summary["alert_minutes"] = round(
+            sum(seconds.values()) / 60.0, 3)
+    incident_rows = [r for r in rows if r.get("kind") == "incident"]
+    if incident_rows:
+        last_status: dict = {}
+        triggers: dict = {}
+        fault_points: set = set()
+        for r in incident_rows:
+            last_status[r.get("incident_id", "?")] = r.get("status", "?")
+            triggers[r.get("trigger", "?")] = (
+                triggers.get(r.get("trigger", "?"), 0) + 1)
+            for p in r.get("fault_points") or []:
+                fault_points.add(str(p))
+        by_status: dict = {}
+        for s in last_status.values():
+            by_status[s] = by_status.get(s, 0) + 1
+        summary["incidents"] = len(last_status)
+        summary["incidents_by_status"] = by_status
+        summary["incidents_unresolved"] = sum(
+            n for s, n in by_status.items() if s != "resolved")
+        summary["incident_triggers"] = triggers
+        summary["incident_fault_points"] = sorted(fault_points)
+    cap_rows = [r for r in rows if r.get("kind") == "capacity_snapshot"]
+    if cap_rows:
+        last_by_rep: dict = {}
+        for r in cap_rows:
+            last_by_rep[r.get("replica", "")] = r
+        summary["capacity_snapshots"] = len(cap_rows)
+        summary["capacity_replicas"] = {
+            rep: {
+                "hbm_peak_bytes": r.get("hbm_peak_bytes"),
+                "staging_peak_bytes": r.get("staging_peak_bytes"),
+                "requests_per_s": r.get("requests_per_s"),
+                "rays_per_s": r.get("rays_per_s"),
+                "cold_loads": r.get("cold_loads"),
+                "repromotions": r.get("repromotions"),
+            }
+            for rep, r in sorted(last_by_rep.items())
+        }
+
     # static-analysis rows (scripts/graftlint.py): the latest run's
     # new-vs-baselined split and rule mix — keys present only when the
     # stream carries lint_run rows (logs/graftlint/telemetry.jsonl)
@@ -725,6 +790,38 @@ def print_summary(summary: dict, label: str = "") -> None:
               f"failover(s), {summary.get('router_dead_marked', 0)} dead, "
               f"{summary.get('drain_failed_requests', 0)} drain-failed "
               f"request(s)")
+    if summary.get("alerts_fired") is not None:
+        mix = " ".join(
+            f"{k}:{v}"
+            for k, v in (summary.get("alerts_by_name") or {}).items()
+        )
+        unres = summary.get("alerts_unresolved") or []
+        print(f"  alerts:        {summary['alerts_fired']} fired "
+              f"({summary.get('alert_pages', 0)} page(s))"
+              + (f"  {mix}" if mix else "")
+              + f"  firing-minutes: {summary.get('alert_minutes', 0)}"
+              + (f"  STILL FIRING: {','.join(unres)}" if unres else ""))
+    if summary.get("incidents") is not None:
+        st_mix = " ".join(
+            f"{k}:{v}"
+            for k, v in sorted(summary["incidents_by_status"].items())
+        )
+        pts = summary.get("incident_fault_points") or []
+        print(f"  incidents:     {summary['incidents']} ({st_mix})  "
+              f"unresolved: {summary['incidents_unresolved']}"
+              + (f"  fault points: {' '.join(pts)}" if pts else ""))
+    if summary.get("capacity_snapshots"):
+        print(f"  capacity:      {summary['capacity_snapshots']} "
+              f"snapshot(s) over "
+              f"{len(summary.get('capacity_replicas') or {})} replica(s)")
+        for rep, v in (summary.get("capacity_replicas") or {}).items():
+            rps = v.get("requests_per_s")
+            print(f"    {rep or '(local)':<12} "
+                  f"hbm peak {_fmt_bytes(v.get('hbm_peak_bytes'))}  "
+                  f"staging peak {_fmt_bytes(v.get('staging_peak_bytes'))}  "
+                  + (f"{rps:.2f} req/s" if rps is not None else "n/a req/s")
+                  + f"  cold {v.get('cold_loads', 0)}"
+                  f"/repromote {v.get('repromotions', 0)}")
     if summary.get("lint_runs"):
         rule_mix = " ".join(
             f"{k}:{v}"
@@ -888,6 +985,24 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     b = cand.get("sampling_fine_evals_per_ray")
     if a and b is not None and b > a:
         flags.append(f"fine-MLP evals/ray grew {a:g} -> {b:g}")
+    # an incident that never reached ``resolved`` is an open question the
+    # run shipped with — any growth over baseline means the candidate's
+    # alerts cleared without their incidents closing (or never cleared)
+    a = base.get("incidents_unresolved") or 0
+    b = cand.get("incidents_unresolved")
+    if b is not None and b > a:
+        flags.append(f"unresolved incidents grew {a} -> {b} "
+                     f"(incident lifecycle left open)")
+    # alert firing-minutes growing past the gate means the candidate
+    # burned its error budget for longer than the baseline did — a
+    # reliability regression even when throughput numbers look flat
+    a = base.get("alert_minutes")
+    b = cand.get("alert_minutes")
+    if (b is not None and b > (a or 0.0) + 0.5
+            and (not a or pct(a, b) > gate_pct)):
+        flags.append(
+            f"alert firing-minutes grew {a or 0.0:g} -> {b:g} "
+            f"(longer error-budget burn)")
     return flags
 
 
@@ -939,11 +1054,16 @@ def main(argv=None) -> int:
                    help="regression threshold %%; exit 1 on a flagged "
                         "regression (default report-only at 10%%)")
     p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--format", choices=("text", "json"), default=None,
+                   help="output format (json = machine-readable summary; "
+                        "with --diff the payload carries the gate flags "
+                        "under 'flags' — what CI consumes)")
     p.add_argument("--all-runs", action="store_true",
                    help="summarize every appended run, not just the last")
     args = p.parse_args(argv)
+    as_json = args.as_json or args.format == "json"
     return report(args.run, diff_run=args.diff, gate=args.gate,
-                  as_json=args.as_json, all_runs=args.all_runs)
+                  as_json=as_json, all_runs=args.all_runs)
 
 
 if __name__ == "__main__":

@@ -123,8 +123,15 @@ def render_pose(engine, batcher, body: dict) -> dict:
 
 def make_server(engine, batcher, host: str = "127.0.0.1",
                 port: int = 8008,
-                slo_target_ms: float = 100.0) -> ThreadingHTTPServer:
-    """A ready-to-serve ThreadingHTTPServer (port 0 = ephemeral, tests)."""
+                slo_target_ms: float = 100.0,
+                alerts=None,
+                slo_window_s: float | None = None) -> ThreadingHTTPServer:
+    """A ready-to-serve ThreadingHTTPServer (port 0 = ephemeral, tests).
+
+    ``alerts`` (an ``obs.alerts.AlertEngine``) adds ``GET /alerts`` and
+    an ``alerts`` block to /healthz; ``slo_window_s`` switches the
+    /healthz SLO view from whole-lifetime rates to a sliding window (so
+    a long-idle replica can't mask a fresh regression)."""
     from nerf_replication_tpu.fleet import (
         ResidencyOverloadError,
         SceneError,
@@ -156,7 +163,10 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
         def do_GET(self):
             if self.path == "/healthz":
                 health = batcher.health() if batcher is not None else {"ok": True}
-                health["slo"] = get_metrics().slo_view(slo_target_s)
+                health["slo"] = get_metrics().slo_view(
+                    slo_target_s, window_s=slo_window_s)
+                if alerts is not None:
+                    health["alerts"] = alerts.healthz_block()
                 # replica block: what scale/replica.py's ProcessReplica
                 # heartbeat reads (id from the supervisor's spawn env,
                 # warm-start provenance, resident scenes for affinity)
@@ -186,6 +196,11 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                 if batcher is not None:
                     stats["batcher"] = batcher.stats()
                 return self._reply(200, stats)
+            if self.path == "/alerts":
+                if alerts is None:
+                    return self._reply(
+                        200, {"enabled": False, "firing": [], "alerts": []})
+                return self._reply(200, alerts.status())
             if self.path == "/metrics":
                 data = get_metrics().render_prometheus().encode()
                 self.send_response(200)
@@ -326,9 +341,36 @@ def main(argv=None) -> int:
     # of several replicas' telemetry joins on globally-unique ids
     import os
 
-    configure_tracing(enabled=trace_on,
-                      id_prefix=os.environ.get("SCALE_REPLICA_ID", ""))
+    replica_id = os.environ.get("SCALE_REPLICA_ID", "")
+    configure_tracing(enabled=trace_on, id_prefix=replica_id)
     install_flight_recorder(FlightRecorder(flight_dir, capacity=trace_ring))
+
+    # ops intelligence (cfg.obs.alerts): the burn-rate alert engine, the
+    # incident correlator (alert fires / flight dumps open incidents next
+    # to the telemetry), and the capacity/heat ledger — all fed from the
+    # emitter's row-tap bus, evaluated in the poll loop below
+    alerts = incidents = capacity = None
+    slo_window_s = None
+    capacity_every_s = 30.0
+    if bool(cfg.obs.alerts.enabled):
+        from nerf_replication_tpu.obs import (
+            AlertEngine,
+            AlertOptions,
+            CapacityLedger,
+            IncidentManager,
+        )
+        from nerf_replication_tpu.resil.flight import add_dump_listener
+
+        slo_window_s = float(cfg.obs.alerts.view_window_s)
+        capacity_every_s = float(cfg.obs.alerts.capacity_every_s)
+        alerts = AlertEngine(AlertOptions.from_cfg(cfg),
+                             slo_target_s=slo_target_ms / 1e3,
+                             replica=replica_id).attach()
+        incidents = IncidentManager(flight_dir, replica=replica_id).attach()
+        alerts.add_listener(incidents.on_alert)
+        add_dump_listener(incidents.on_flight_dump)
+        capacity = CapacityLedger(replica=replica_id,
+                                  window_s=slo_window_s).attach()
     # SIGTERM: the guard's handler dumps the flight ring, then the poll
     # loop below drains and exits cleanly (a preempted replica leaves a
     # post-mortem AND closes its telemetry)
@@ -340,7 +382,8 @@ def main(argv=None) -> int:
     batcher = MicroBatcher(engine, breaker=CircuitBreaker.from_cfg(cfg),
                            qos=QosController.from_cfg(cfg))
     server = make_server(engine, batcher, host=args.host, port=args.port,
-                         slo_target_ms=slo_target_ms)
+                         slo_target_ms=slo_target_ms, alerts=alerts,
+                         slo_window_s=slo_window_s)
     print(
         f"serving on http://{args.host}:{server.server_address[1]} "
         f"(buckets {list(engine.buckets)}, "
@@ -349,19 +392,37 @@ def main(argv=None) -> int:
         f"tracing {'on' if trace_on else 'off'})"
     )
     try:
-        if guard is None:
+        if guard is None and alerts is None:
             server.serve_forever()
         else:
             t = threading.Thread(target=server.serve_forever, daemon=True)
             t.start()
-            while t.is_alive() and not guard.triggered:
+            last_cap = time.monotonic()
+            while t.is_alive() and not (guard is not None and guard.triggered):
                 t.join(timeout=0.5)
+                # the alerting tick: transitions emit alert rows, open/
+                # mitigate incidents, and refresh the /alerts view; a
+                # capacity_snapshot row commits on its own cadence
+                if alerts is not None:
+                    alerts.evaluate()
+                    incidents.sweep()
+                    if time.monotonic() - last_cap >= capacity_every_s:
+                        capacity.snapshot()
+                        last_cap = time.monotonic()
             server.shutdown()
     except KeyboardInterrupt:
         pass
     finally:
         server.server_close()
         batcher.close()
+        if capacity is not None:
+            capacity.snapshot()  # final ledger state is on the record
+        if alerts is not None:
+            alerts.evaluate()
+            incidents.sweep()
+            alerts.detach()
+            incidents.detach()
+            capacity.detach()
         snap = get_metrics().snapshot()
         snap["slo"] = get_metrics().slo_view(slo_target_ms / 1e3)
         emitter.emit("metrics_snapshot", **snap)

@@ -1,0 +1,577 @@
+"""Ops intelligence (nerf_replication_tpu/obs: alerts, incidents,
+capacity): multi-window burn-rate math is deterministic under a fake
+clock, alerts hold through hysteresis instead of flapping, the incident
+correlator assembles causal timelines (with exemplar-trace joins) from
+synthetic telemetry, the capacity ledger's watermarks/rates match
+hand-computed values, windowed SLO reads don't let lifetime history
+dilute a fresh regression, and a live serve run with the full ops loop
+attached stays at zero steady-state recompiles."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.obs import (
+    AlertEngine,
+    AlertOptions,
+    CapacityLedger,
+    IncidentManager,
+    MetricsRegistry,
+    get_metrics,
+    init_run,
+    reset_metrics,
+    validate_incident_dump,
+    validate_row,
+)
+from nerf_replication_tpu.obs.emit import add_row_tap, remove_row_tap
+from nerf_replication_tpu.serve import MicroBatcher, RenderEngine
+
+NEAR, FAR = 2.0, 6.0
+
+
+class FakeClock:
+    """Injectable clock shared by engine/manager/ledger under test."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _rays(n: int, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.concatenate(
+        [
+            np.tile([0.0, 0.0, 4.0], (n, 1)),
+            np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3)),
+        ],
+        -1,
+    ).astype(np.float32)
+
+
+def _opts(**kw) -> AlertOptions:
+    """Second-scale windows so one test drives whole alert lifetimes."""
+    base = dict(fast_short_s=60.0, fast_long_s=600.0, slow_short_s=120.0,
+                slow_long_s=1200.0, clear_hold_s=120.0)
+    base.update(kw)
+    return AlertOptions(**base)
+
+
+# -- burn-rate math ----------------------------------------------------------
+
+
+def test_burn_page_fires_on_both_windows_and_clears_after_hold():
+    """20% error rate against a 99% objective burns at 20x: over the page
+    threshold in BOTH fast windows -> page fires. Once traffic ages out
+    of the windows the alert clears only after clear_hold_s of the
+    condition staying false — and exactly once (no flap)."""
+    clock = FakeClock(10_000.0)
+    eng = AlertEngine(_opts(), clock=clock)
+    eng.observe_window(attainment=0.8, deny_rate=0.0, n=100)
+    view = eng.evaluate()
+    assert "slo_burn_page" in view["firing"]
+    assert "slo_burn_ticket" in view["firing"]  # 20x >= the 6x ticket bar
+    assert "deny_burn_page" not in view["firing"]
+    fire = next(t for t in eng.transitions
+                if t["name"] == "slo_burn_page" and t["state"] == "firing")
+    assert fire["severity"] == "page"
+    assert fire["burn_fast"] == pytest.approx(20.0)
+    assert fire["burn_slow"] == pytest.approx(20.0)
+    assert fire["value"] == pytest.approx(0.2)
+
+    # age everything out of the fast windows: condition false, but the
+    # alert must HOLD for clear_hold_s before resolving
+    clock.advance(700.0)
+    eng.evaluate()
+    assert "slo_burn_page" in eng.active()
+    clock.advance(60.0)  # 60 < 120 hold
+    eng.evaluate()
+    assert "slo_burn_page" in eng.active()
+    clock.advance(61.0)  # 121 >= 120 hold
+    eng.evaluate()
+    assert "slo_burn_page" not in eng.active()
+    page_ts = [t["state"] for t in eng.transitions
+               if t["name"] == "slo_burn_page"]
+    assert page_ts == ["firing", "resolved"]
+    # alert_seconds spans first-fire -> resolve (10000 -> 10821)
+    assert eng.alert_seconds["slo_burn_page"] == pytest.approx(821.0)
+
+
+def test_long_window_guards_against_a_short_blip():
+    """A burst that saturates the short window but is diluted by healthy
+    history in the long window must NOT page — the multi-window contract
+    (one bad GC pause is not an incident)."""
+    clock = FakeClock(50_000.0)
+    eng = AlertEngine(_opts(), clock=clock)
+    eng.observe_window(attainment=1.0, deny_rate=0.0, n=1000)
+    clock.advance(550.0)  # healthy history leaves the 60s short window
+    eng.observe_window(attainment=0.0, deny_rate=0.0, n=50)
+    view = eng.evaluate()
+    # short window burns at 100x, long at 50/1050/0.01 ~ 4.8x < 14.4x
+    cond = next(a for a in view["alerts"] if a["name"] == "slo_burn_page")
+    assert cond["burn_fast"] == pytest.approx(100.0)
+    assert cond["burn_slow"] < 14.4
+    assert "slo_burn_page" not in view["firing"]
+    assert "slo_burn_ticket" not in view["firing"]
+
+
+def test_deny_burn_is_a_separate_signal():
+    clock = FakeClock(1_000.0)
+    eng = AlertEngine(_opts(), clock=clock)
+    eng.observe_window(attainment=1.0, deny_rate=0.5, n=100)
+    view = eng.evaluate()
+    assert "deny_burn_page" in view["firing"]
+    assert "slo_burn_page" not in view["firing"]
+
+
+def test_no_traffic_no_alert():
+    """min_count: empty windows never fire (0/0 is not an outage)."""
+    eng = AlertEngine(_opts(), clock=FakeClock(1_000.0))
+    assert eng.evaluate()["firing"] == []
+
+
+# -- direct conditions -------------------------------------------------------
+
+
+def test_breaker_row_pages_and_clears_with_listener_and_rows():
+    """A breaker-open telemetry row pages immediately (naming the point),
+    a closed row clears it; both transitions emit schema-valid alert
+    rows and reach listeners."""
+    clock = FakeClock(2_000.0)
+    eng = AlertEngine(_opts(clear_hold_s=0.0), clock=clock)
+    events, rows = [], []
+    eng.add_listener(events.append)
+    add_row_tap(rows.append)
+    try:
+        eng._on_row({"kind": "breaker", "point": "serve.dispatch",
+                     "state": "open", "failures": 5})
+        view = eng.evaluate()
+        assert "breaker_open" in view["firing"]
+        fire = next(t for t in eng.transitions
+                    if t["name"] == "breaker_open")
+        assert fire["severity"] == "page"
+        assert fire["detail"] == "serve.dispatch"
+        assert eng.healthz_block()["n_firing"] == 1
+        eng._on_row({"kind": "breaker", "point": "serve.dispatch",
+                     "state": "closed", "failures": 0})
+        eng.evaluate()
+        assert "breaker_open" not in eng.active()
+    finally:
+        remove_row_tap(rows.append)
+        eng.remove_listener(events.append)
+    alert_rows = [r for r in rows if r.get("kind") == "alert"]
+    assert [r["state"] for r in alert_rows] == ["firing", "resolved"]
+    for r in alert_rows:
+        assert validate_row(r) == [], r
+    assert [e["state"] for e in events] == ["firing", "resolved"]
+
+
+def test_orphan_span_rate_tickets_after_grace():
+    """A child whose parent never arrives is judged an orphan only after
+    the grace period; a parent that does arrive never counts."""
+    clock = FakeClock(3_000.0)
+    eng = AlertEngine(_opts(orphan_grace_s=10.0, clear_hold_s=0.0),
+                      clock=clock)
+    eng._on_row({"kind": "span", "span_id": "c1", "parent_id": "gone1"})
+    eng._on_row({"kind": "span", "span_id": "c2", "parent_id": "p1"})
+    eng._on_row({"kind": "span", "span_id": "p1"})  # parent arrives
+    view = eng.evaluate()
+    assert "orphan_spans" not in view["firing"]  # still inside grace
+    clock.advance(10.0)
+    view = eng.evaluate()
+    assert "orphan_spans" in view["firing"]
+    cond = next(a for a in view["alerts"] if a["name"] == "orphan_spans")
+    assert cond["value"] == pytest.approx(0.5)  # 1 orphan of 2 judged
+    clock.advance(70.0)  # judged counts age out of the 60s window
+    eng.evaluate()
+    assert "orphan_spans" not in eng.active()
+
+
+def test_staging_thrash_tickets_on_demote_repromote_churn():
+    clock = FakeClock(4_000.0)
+    eng = AlertEngine(_opts(thrash_per_min_max=6.0, clear_hold_s=0.0),
+                      clock=clock)
+    for _ in range(7):
+        eng._on_row({"kind": "scene_evict", "scene": "lego",
+                     "reason": "demoted"})
+        eng._on_row({"kind": "scene_load", "scene": "lego",
+                     "source": "staging"})
+    view = eng.evaluate()
+    assert "staging_thrash" in view["firing"]  # min(7,7)/1min = 7 >= 6
+    clock.advance(120.0)
+    eng.evaluate()
+    assert "staging_thrash" not in eng.active()
+
+
+def test_alert_options_from_cfg_roundtrip():
+    class _NS:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    cfg = _NS(obs=_NS(alerts=_NS(
+        slo_objective=0.95, deny_objective=0.9, fast_burn=10.0,
+        slow_burn=5.0, fast_short_s=10.0, fast_long_s=20.0,
+        slow_short_s=30.0, slow_long_s=40.0, clear_hold_s=7.0,
+        orphan_grace_s=3.0, orphan_rate_max=0.1, thrash_per_min_max=2.0)))
+    opt = AlertOptions.from_cfg(cfg)
+    assert opt.slo_objective == 0.95
+    assert opt.fast_burn == 10.0
+    assert opt.clear_hold_s == 7.0
+    assert opt.thrash_per_min_max == 2.0
+
+
+# -- incident correlation ----------------------------------------------------
+
+
+def _feed_timeline(mgr: IncidentManager, t0: float) -> None:
+    """Synthetic telemetry: a fault, its retry/breaker fallout, an
+    evidence-linked scale decision, residency moves, a tenant denial —
+    plus spans for the exemplar trace and for an unrelated one."""
+    mgr._on_row({"kind": "fault", "point": "serve.flush",
+                 "fault": "io_error", "mode": "count", "t": t0})
+    mgr._on_row({"kind": "retry", "point": "serve.flush", "attempt": 1,
+                 "outcome": "success", "t": t0 + 1})
+    mgr._on_row({"kind": "breaker", "point": "serve.dispatch",
+                 "state": "open", "failures": 5, "t": t0 + 2})
+    mgr._on_row({"kind": "span", "trace_id": "abc123", "span_id": "s1",
+                 "name": "render", "dur_s": 0.31, "status": "ok",
+                 "t": t0 + 3})
+    mgr._on_row({"kind": "span", "trace_id": "zzz999", "span_id": "s2",
+                 "name": "render", "dur_s": 0.02, "status": "ok",
+                 "t": t0 + 3})
+    mgr._on_row({"kind": "scale_decision", "action": "scale_out",
+                 "reason": "attainment", "n_replicas": 2,
+                 "attainment": 0.5,
+                 "evidence": {"exemplar_trace_ids": ["abc123"]},
+                 "t": t0 + 4})
+    mgr._on_row({"kind": "scene_load", "scene": "lego", "source": "disk",
+                 "load_s": 0.2, "t": t0 + 5})
+    mgr._on_row({"kind": "tenant_admit", "tenant": "bronze",
+                 "decision": "deny", "reason": "rate", "t": t0 + 6})
+
+
+def test_incident_assembles_timeline_faults_and_exemplar_traces(tmp_path):
+    clock = FakeClock(5_000.0)
+    mgr = IncidentManager(str(tmp_path), clock=clock, lookback_s=300.0,
+                          coalesce_s=30.0, quiet_s=60.0)
+    _feed_timeline(mgr, clock.t)
+    clock.advance(10.0)
+    mgr.on_alert({"name": "slo_burn_page", "state": "firing",
+                  "severity": "page", "value": 0.2, "threshold": 14.4})
+    assert len(mgr.incidents) == 1
+    inc = mgr.incidents[0]
+    assert inc["status"] == "open"
+    assert inc["trigger"] == "alert"
+    assert inc["alerts"] == ["slo_burn_page"]
+    assert inc["fault_points"] == ["serve.flush:io_error"]
+    assert inc["trace_ids"] == ["abc123"]
+    kinds = {e["kind"] for e in inc["timeline"]}
+    assert {"fault", "retry", "breaker", "scale_decision", "scene_load",
+            "tenant_admit", "span"} <= kinds
+    # only the exemplar trace's spans make the timeline
+    span_evs = [e for e in inc["timeline"] if e["kind"] == "span"]
+    assert [e["trace_id"] for e in span_evs] == ["abc123"]
+    ts = [e["t"] for e in inc["timeline"]]
+    assert ts == sorted(ts)
+    # atomic dumps: schema-valid json + human-readable markdown
+    assert validate_incident_dump(inc["path"]) == []
+    md = inc["path"][:-len(".json")] + ".md"
+    assert "serve.flush:io_error" in open(md).read()
+
+
+def test_incident_lifecycle_coalesce_mitigate_resolve(tmp_path):
+    """Two alerts inside coalesce_s are ONE incident; the last alert
+    clearing mitigates it; a quiet period resolves it. Every transition
+    emits a schema-valid incident row."""
+    clock = FakeClock(5_000.0)
+    rows = []
+    add_row_tap(rows.append)
+    try:
+        mgr = IncidentManager(str(tmp_path), clock=clock, lookback_s=300.0,
+                              coalesce_s=30.0, quiet_s=60.0)
+        mgr.on_alert({"name": "slo_burn_page", "state": "firing",
+                      "severity": "page", "value": 0.2, "threshold": 14.4})
+        clock.advance(20.0)
+        mgr.on_alert({"name": "deny_burn_page", "state": "firing",
+                      "severity": "page", "value": 0.5, "threshold": 14.4})
+        assert len(mgr.incidents) == 1  # coalesced, not a second incident
+        inc = mgr.incidents[0]
+        assert inc["alerts"] == ["slo_burn_page", "deny_burn_page"]
+        clock.advance(10.0)
+        mgr.on_alert({"name": "slo_burn_page", "state": "resolved"})
+        assert inc["status"] == "open"  # deny still firing
+        mgr.on_alert({"name": "deny_burn_page", "state": "resolved"})
+        assert inc["status"] == "mitigated"
+        assert inc["mitigated_t"] == pytest.approx(clock.t)
+        clock.advance(60.0)
+        mgr.sweep()
+        assert inc["status"] == "resolved"
+        assert inc["resolved_t"] == pytest.approx(clock.t)
+        assert validate_incident_dump(inc["path"]) == []
+    finally:
+        remove_row_tap(rows.append)
+    inc_rows = [r for r in rows if r.get("kind") == "incident"]
+    assert [r["status"] for r in inc_rows] == ["open", "mitigated",
+                                               "resolved"]
+    for r in inc_rows:
+        assert validate_row(r) == [], r
+
+
+def test_open_on_fault_and_force_resolve(tmp_path):
+    """The chaos harness contract: injected fault rows open incidents
+    themselves (coalescing a storm into one), and resolve_open closes
+    whatever recovery checks left behind."""
+    clock = FakeClock(7_000.0)
+    mgr = IncidentManager(str(tmp_path), clock=clock, coalesce_s=30.0,
+                          quiet_s=60.0, open_on_fault=True)
+    mgr._on_row({"kind": "fault", "point": "serve.flush",
+                 "fault": "io_error", "t": clock.t})
+    clock.advance(5.0)
+    mgr._on_row({"kind": "fault", "point": "data.load",
+                 "fault": "io_error", "t": clock.t})
+    assert len(mgr.incidents) == 1  # storm coalesced
+    inc = mgr.incidents[0]
+    assert inc["trigger"] == "fault"
+    assert set(inc["fault_points"]) == {"serve.flush:io_error",
+                                        "data.load:io_error"}
+    assert mgr.resolve_open("recovery checks passed") == 1
+    assert inc["status"] == "resolved"
+    assert "recovery checks passed" in inc["detail"]
+    assert validate_incident_dump(inc["path"]) == []
+    assert mgr.resolve_open() == 0  # idempotent
+
+
+def test_quiet_sweep_automation(tmp_path):
+    """An alertless open incident mitigates after quiet_s and resolves
+    after another — no operator in the loop."""
+    clock = FakeClock(8_000.0)
+    mgr = IncidentManager(str(tmp_path), clock=clock, coalesce_s=5.0,
+                          quiet_s=60.0, open_on_fault=True)
+    mgr._on_row({"kind": "fault", "point": "p", "fault": "f",
+                 "t": clock.t})
+    inc = mgr.incidents[0]
+    clock.advance(59.0)
+    mgr.sweep()
+    assert inc["status"] == "open"
+    clock.advance(1.0)
+    mgr.sweep()
+    assert inc["status"] == "mitigated"
+    clock.advance(60.0)
+    mgr.sweep()
+    assert inc["status"] == "resolved"
+
+
+def test_lookback_bounds_the_timeline(tmp_path):
+    clock = FakeClock(9_000.0)
+    mgr = IncidentManager(str(tmp_path), clock=clock, lookback_s=50.0)
+    mgr._on_row({"kind": "fault", "point": "old", "fault": "f",
+                 "t": clock.t})
+    clock.advance(100.0)
+    mgr._on_row({"kind": "fault", "point": "new", "fault": "f",
+                 "t": clock.t})
+    mgr.on_alert({"name": "a", "state": "firing", "severity": "page"})
+    inc = mgr.incidents[0]
+    assert inc["fault_points"] == ["new:f"]  # the stale fault aged out
+    assert all(e["t"] >= clock.t - 50.0 for e in inc["timeline"])
+
+
+# -- capacity ledger ---------------------------------------------------------
+
+
+def test_capacity_ledger_matches_hand_computed_accounting():
+    clock = FakeClock(1_000.0)
+    lg = CapacityLedger(replica="r0", window_s=100.0, clock=clock)
+    # watermarks: peaks latch, currents track the last report
+    lg.note_residency(100, 200)
+    lg.note_residency(50, 75)
+    # heat: 4 lego requests x 512 rays, 2 ship x 256
+    for _ in range(4):
+        lg.note_request("lego", 512)
+    for _ in range(2):
+        lg.note_request("ship", 256)
+    # churn + row-carried residency fallback
+    lg._on_row({"kind": "scene_load", "scene": "ship", "source": "staging"})
+    lg._on_row({"kind": "scene_load", "scene": "fern", "source": "disk",
+                "resident_bytes": 80, "staging_bytes": 75})
+    lg._on_row({"kind": "span", "stage": "device", "family": "nerf",
+                "dur_s": 3.0})
+    lg._on_row({"kind": "span", "stage": "device", "name": "prop",
+                "dur_s": 1.0})
+    lg._on_row({"kind": "span", "stage": "host", "name": "ignored",
+                "dur_s": 99.0})
+    v = lg.view()
+    assert v["hbm_bytes"] == 80 and v["hbm_peak_bytes"] == 100
+    assert v["staging_bytes"] == 75 and v["staging_peak_bytes"] == 200
+    assert v["scenes"]["lego"]["requests_per_s"] == pytest.approx(0.04)
+    assert v["scenes"]["lego"]["rays_per_s"] == pytest.approx(20.5)
+    assert v["scenes"]["ship"]["requests_per_s"] == pytest.approx(0.02)
+    assert v["scenes"]["ship"]["rays_per_s"] == pytest.approx(5.1)
+    assert v["scenes"]["ship"]["repromotions"] == 1
+    assert v["scenes"]["fern"]["cold_loads"] == 1
+    assert v["requests_per_s"] == pytest.approx(0.06)
+    assert v["rays_per_s"] == pytest.approx(25.6)
+    assert v["device_share"] == {"nerf": 0.75, "prop": 0.25}
+    # rates age out of the window; counters and peaks persist
+    clock.advance(150.0)
+    v2 = lg.view()
+    assert v2["requests_per_s"] == 0.0
+    assert v2["scenes"]["fern"]["cold_loads"] == 1
+    assert v2["hbm_peak_bytes"] == 100
+
+
+def test_capacity_snapshot_row_and_gauges(tmp_path):
+    reset_metrics()
+    clock = FakeClock(2_000.0)
+    lg = CapacityLedger(replica="r7", window_s=100.0, clock=clock)
+    lg.note_residency(1234, 0)
+    lg.note_request("lego", 128)
+    rows = []
+    add_row_tap(rows.append)
+    try:
+        lg.snapshot()
+    finally:
+        remove_row_tap(rows.append)
+    snap = next(r for r in rows if r.get("kind") == "capacity_snapshot")
+    assert validate_row(snap) == [], snap
+    assert snap["replica"] == "r7"
+    assert snap["hbm_peak_bytes"] == 1234
+    assert "lego" in snap["scenes"]
+    text = get_metrics().render_prometheus()
+    assert 'capacity_scene_requests_per_s{scene="lego"}' in text
+    assert "capacity_hbm_peak_bytes 1234" in text
+    # no local replica label — the fleet merge injects it
+    assert 'replica="' not in text
+    assert lg.n_snapshots == 1
+
+
+# -- windowed SLO reads (the dilution fix) -----------------------------------
+
+
+def test_windowed_slo_view_does_not_dilute_a_fresh_regression():
+    clock = FakeClock(0.0)
+    reg = MetricsRegistry(clock=clock)
+    clock.t = 1_000.0
+    for _ in range(200):
+        reg.observe("serve_request_latency_seconds", 0.05)
+    reg.counter("serve_requests_total", 200.0, status="ok")
+    reg.counter("serve_breaker_transitions_total", 1.0, state="open")
+    clock.t = 1_400.0
+    for _ in range(50):
+        reg.observe("serve_request_latency_seconds", 0.9)
+    reg.counter("serve_requests_total", 50.0, status="ok")
+    reg.counter("serve_requests_total", 10.0, status="timeout")
+
+    life = reg.slo_view(0.25)
+    win = reg.slo_view(0.25, window_s=60.0)
+    # lifetime read: 200 healthy obs dilute the regression to 0.8
+    assert life["attainment"] == pytest.approx(0.8)
+    # windowed read sees only the last minute: total outage, no dilution
+    assert win["attainment"] == pytest.approx(0.0)
+    assert win["window_s"] == 60.0
+    assert win["requests"] == 60
+    assert win["timeout_rate"] == pytest.approx(round(10 / 60, 4))
+    # the hour-old breaker open is lifetime history, not current state
+    assert life["breaker_opens"] == 1
+    assert win["breaker_opens"] == 0
+    # windowed counter primitive agrees
+    assert reg.window_counter("serve_requests_total", 60.0,
+                              status="ok") == 50.0
+    assert reg.window_counter("serve_requests_total", 10_000.0) == 260.0
+
+
+# -- live serve run with the full ops loop -----------------------------------
+
+
+@pytest.fixture(scope="module")
+def ops_setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_alerts"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4, n_test=1)
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "64",
+         "serve.buckets", "[128]",
+         "serve.max_batch_rays", "128",
+         "serve.max_delay_ms", "5.0",
+         "serve.request_timeout_s", "5.0"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox)
+    return cfg, engine
+
+
+def test_serve_with_ops_loop_zero_steady_recompiles(ops_setup, tmp_path):
+    """The ops loop (alert engine + incident correlator + capacity
+    ledger, all row-tapped) rides a live serve stream without triggering
+    a single steady-state recompile; a clean run fires no alerts and
+    opens no incidents, and every emitted row validates."""
+    cfg, engine = ops_setup
+    assert AlertOptions.from_cfg(cfg).fast_burn == 14.4  # cfg block wired
+    engine.render_request(_rays(64), NEAR, FAR, emit=False)  # warm
+    path = str(tmp_path / "telemetry.jsonl")
+    emitter = init_run(cfg, component="serve_ops_test", path=path)
+    alerts = AlertEngine(_opts(clear_hold_s=0.0), slo_target_s=30.0,
+                         replica="r0").attach()
+    incidents = IncidentManager(str(tmp_path)).attach()
+    alerts.add_listener(incidents.on_alert)
+    capacity = CapacityLedger(replica="r0", window_s=60.0).attach()
+    before = engine.tracker.total_compiles()
+    try:
+        clock = FakeClock()
+        batcher = MicroBatcher(engine, clock=clock, start=False)
+        futures = [batcher.submit(_rays(30 + 7 * i), NEAR, FAR)
+                   for i in range(5)]
+        while batcher.queue_depth():
+            clock.advance(1.0)
+            batcher.pump()
+            alerts.evaluate()
+        for f in futures:
+            f.result(timeout=5.0)
+        capacity.snapshot()
+        alerts.evaluate()
+    finally:
+        capacity.detach()
+        alerts.remove_listener(incidents.on_alert)
+        incidents.detach()
+        alerts.detach()
+        emitter.close()
+        init_run(cfg, component="noop", path=str(tmp_path / "t2.jsonl")).close()
+    assert engine.tracker.total_compiles() == before
+    # clean run: nothing fired, nothing opened, overhead accounted
+    assert alerts.active() == []
+    assert alerts.transitions == []
+    assert incidents.incidents == []
+    assert alerts.self_s < 1.0
+    # the ledger saw the request stream through its row tap
+    v = capacity.view(now=time.monotonic())
+    assert v["requests_per_s"] > 0.0
+    assert v["rays_per_s"] > 0.0
+    rows = [json.loads(line) for line in open(path)]
+    kinds = {r["kind"] for r in rows}
+    assert "serve_request" in kinds
+    assert "capacity_snapshot" in kinds
+    for r in rows:
+        assert validate_row(r) == [], r

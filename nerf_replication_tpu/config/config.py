@@ -305,6 +305,20 @@ def default_cfg() -> ConfigNode:
             # when >1 device; "force" = mesh even on one device (the
             # CPU parity-test configuration)
             "mesh": "off",
+            # scene placement planner (scale/placement.py): which replica
+            # holds which scene. Disabled -> the router's passive
+            # affinity/least-loaded dispatch is bitwise unchanged.
+            "placement": {
+                "enabled": False,
+                "hot_width": 2,            # replicas per hot scene (R)
+                "max_width": 4,            # replication-width ceiling
+                "hot_rps": 0.5,            # requests/s at/above -> hot
+                "width_rps": 2.0,          # extra replica per this much heat
+                "hbm_budget_bytes": 0,     # 0 -> per-replica ladder budget
+                "staging_budget_bytes": 0,  # 0 -> ladder staging budget
+                "replan_every_s": 10.0,    # supervisor replan cadence
+                "max_moves_per_step": 4,   # move-execution rate limit
+            },
         }
     )
 

@@ -265,6 +265,35 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"attainment": _OPT_NUM, "deny_rate": _NUM, "streak": _NUM,
          "replica": (str,), "evidence": (dict,)},
     ),
+    # one per placement replan (scale/placement.py): the versioned
+    # scene->replicas plan the router consults before passive affinity.
+    # version bumps only when the assignment changes (identical inputs
+    # => identical plan); moves_by_kind counts the ordered rebalance
+    # deltas (publish | prefetch | demote); converged means the move
+    # list is empty and convergence_s (present only on the plan that
+    # closed it) is the wall time from first unconverged plan to here.
+    # evidence carries the scene-heat snapshot the plan acted on
+    # (deep-checked by validate_row).
+    "placement_plan": (
+        {"version": _NUM, "reason": (str,), "n_scenes": _NUM,
+         "n_replicas": _NUM, "n_moves": _NUM, "moves_by_kind": (dict,),
+         "converged": (bool,)},
+        {"convergence_s": _NUM, "evidence": (dict,),
+         # the router's cumulative planned/unplanned dispatch counters
+         # at replan time — the unplanned share tlm_report gates on
+         "planned_hits": _NUM, "unplanned": _NUM},
+    ),
+    # one per APPLIED placement move (the executor's write-back; the
+    # move kind lives in "move" — "kind" is the row kind): prefetch/
+    # demote ride the fleet ladder's tier transitions, publish rides
+    # the scene publisher — never a raw evict of a pinned lease (a
+    # pinned refusal lands here as ok=false, detail=pinned, and the
+    # tlm_report --diff gate counts it).
+    "placement_move": (
+        {"version": _NUM, "move": (str,), "scene": (str,),
+         "replica": (str,), "ok": (bool,)},
+        {"detail": (str,)},
+    ),
     # -- ops-intelligence rows (obs/alerts.py / obs/incidents.py /
     # obs/capacity.py, docs/observability.md) --------------------------------
     # one per alert state TRANSITION (firing | resolved), not per
@@ -365,6 +394,12 @@ def validate_row(row) -> list[str]:
         if row.get("trigger") not in ("alert", "flight_dump", "fault"):
             errors.append(f"incident: trigger {row.get('trigger')!r} not in "
                           "alert|flight_dump|fault")
+    elif kind == "placement_move":
+        if row.get("move") not in ("publish", "prefetch", "demote"):
+            errors.append(f"placement_move: move {row.get('move')!r} not "
+                          "in publish|prefetch|demote")
+    elif kind == "placement_plan" and isinstance(row.get("evidence"), dict):
+        errors += _validate_placement_evidence(row["evidence"])
     return errors
 
 
@@ -413,6 +448,24 @@ def _validate_evidence(ev: dict) -> list[str]:
         if field not in known:
             errors.append(
                 f"scale_decision: unknown evidence field {field!r}")
+    return errors
+
+
+def _validate_placement_evidence(ev: dict) -> list[str]:
+    """Deep checks for a placement_plan evidence block: the scene-heat
+    snapshot the plan acted on (scene id -> windowed rates)."""
+    errors = []
+    heat = ev.get("scene_heat")
+    if not isinstance(heat, dict) or not all(
+            isinstance(k, str) and isinstance(v, dict)
+            and all(isinstance(x, _NUM) for x in v.values())
+            for k, v in (heat or {}).items()):
+        errors.append("placement_plan: evidence.scene_heat must map "
+                      "scene id -> {rate: number}")
+    for field in ev:
+        if field != "scene_heat":
+            errors.append(
+                f"placement_plan: unknown evidence field {field!r}")
     return errors
 
 
@@ -489,6 +542,19 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # and the scale-specific field names.
     "scale_mode": ("replicas_peak", "attainment_low",
                    "attainment_recovered", "scale_outs", "scale_ins"),
+    # scripts/serve_bench.py --replicas --placement rows
+    # (BENCH_SCALE.jsonl): one row per placement-planned fleet run —
+    # plan convergence (final version, move mix, convergence wall
+    # time), the hot scene's achieved replication width vs target, the
+    # budget check (replicas over their HBM+staging budget must be 0),
+    # the unplanned-dispatch share, and the kill-repair outcome (failed
+    # in-flight requests and steady-state recompiles, both held at 0).
+    # NOTE: must not carry any earlier discriminator key (bench_family
+    # is first-match), hence placement_mode and the placement-specific
+    # field names.
+    "placement_mode": ("plan_version", "hot_width_target",
+                       "hot_width_achieved", "over_budget_replicas",
+                       "unplanned_share", "kill_repair_failed"),
 }
 
 

@@ -26,6 +26,15 @@ heavy-traffic shape the ROADMAP north star asks for:
   scrapes merged into one Prometheus body with a ``replica`` label
   (``GET /fleet/metrics``), plus the fleet SLO view the supervisor
   reads — one signal for the loop and the operator both.
+* **Placement** (:mod:`.placement`) — the scene placement planner:
+  versioned plans (hot scenes replicated by measured heat, cold scenes
+  bin-packed under byte budgets) the router consults before its
+  passive affinity and the supervisor executes as ordered
+  prefetch/demote/publish moves.
+* **Launcher** (:mod:`.launcher`) — real ``serve.py`` child processes
+  behind the ProcessReplica surface: port allocation, spawn against
+  the shared ``.aot`` warm-start dir, ready-wait, drain-before-retire,
+  kill + 1:1 replace.
 """
 
 from .fleet_metrics import (
@@ -33,34 +42,52 @@ from .fleet_metrics import (
     make_fleet_server,
     merge_scrapes,
 )
+from .launcher import LaunchError, ProcessLauncher, allocate_port
 from .mesh_dispatch import (
     MeshDispatchError,
     mesh_from_scale_cfg,
     mesh_jit,
     validate_mesh_buckets,
 )
-from .options import ScaleOptions
+from .options import PlacementOptions, ScaleOptions
+from .placement import (
+    PlacementExecutor,
+    PlacementMove,
+    PlacementPlan,
+    PlacementPlanner,
+    merge_heat,
+)
 from .replica import (
     InProcessReplica,
     ProcessReplica,
     ReplicaState,
     ReplicaUnavailableError,
 )
-from .router import NoReplicaAvailableError, Router
+from .router import NoCapableReplicaError, NoReplicaAvailableError, Router
 from .supervisor import Supervisor
 
 __all__ = [
     "FleetMetricsAggregator",
     "InProcessReplica",
+    "LaunchError",
     "MeshDispatchError",
+    "NoCapableReplicaError",
     "NoReplicaAvailableError",
+    "PlacementExecutor",
+    "PlacementMove",
+    "PlacementOptions",
+    "PlacementPlan",
+    "PlacementPlanner",
+    "ProcessLauncher",
     "ProcessReplica",
     "ReplicaState",
     "ReplicaUnavailableError",
     "Router",
     "ScaleOptions",
     "Supervisor",
+    "allocate_port",
     "make_fleet_server",
+    "merge_heat",
     "merge_scrapes",
     "mesh_from_scale_cfg",
     "mesh_jit",

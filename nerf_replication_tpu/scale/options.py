@@ -3,7 +3,43 @@ the defaults; docs/scaleout.md is the operator reference)."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class PlacementOptions:
+    """The ``scale.placement:`` sub-block: the planner's policy knobs.
+
+    ``enabled: false`` keeps the router's passive affinity/least-loaded
+    dispatch bitwise unchanged (tier-1 asserts the parity). Heat is the
+    capacity ledger's windowed requests/s per scene: a scene at/above
+    ``hot_rps`` is hot and gets ``hot_width`` replicas, plus one more per
+    ``width_rps`` of additional heat, capped at ``max_width``. Byte
+    budgets of 0 defer to each replica's own ladder budgets."""
+
+    enabled: bool = False
+    hot_width: int = 2
+    max_width: int = 4
+    hot_rps: float = 0.5
+    width_rps: float = 2.0
+    hbm_budget_bytes: int = 0
+    staging_budget_bytes: int = 0
+    replan_every_s: float = 10.0
+    max_moves_per_step: int = 4
+
+    @classmethod
+    def from_cfg_block(cls, p) -> "PlacementOptions":
+        return cls(
+            enabled=bool(p.get("enabled", False)),
+            hot_width=max(1, int(p.get("hot_width", 2))),
+            max_width=max(1, int(p.get("max_width", 4))),
+            hot_rps=float(p.get("hot_rps", 0.5)),
+            width_rps=max(1e-9, float(p.get("width_rps", 2.0))),
+            hbm_budget_bytes=int(p.get("hbm_budget_bytes", 0)),
+            staging_budget_bytes=int(p.get("staging_budget_bytes", 0)),
+            replan_every_s=float(p.get("replan_every_s", 10.0)),
+            max_moves_per_step=max(1, int(p.get("max_moves_per_step", 4))),
+        )
 
 
 @dataclass(frozen=True)
@@ -41,6 +77,8 @@ class ScaleOptions:
     # "force" builds the mesh path even on one device (the parity/test
     # configuration); "off" keeps plain jax.jit.
     mesh: str = "off"
+    # scene placement planner (scale/placement.py)
+    placement: PlacementOptions = field(default_factory=PlacementOptions)
 
     @classmethod
     def from_cfg(cls, cfg) -> "ScaleOptions":
@@ -60,4 +98,6 @@ class ScaleOptions:
             heartbeat_timeout_s=float(s.get("heartbeat_timeout_s", 10.0)),
             drain_timeout_s=float(s.get("drain_timeout_s", 60.0)),
             mesh=str(s.get("mesh", "off")),
+            placement=PlacementOptions.from_cfg_block(
+                s.get("placement", {})),
         )

@@ -83,6 +83,11 @@ class InProcessReplica:
     # an argument, not a header) — see Router.submit
     accepts_ctx = True
 
+    # request shapes this replica serves: the router filters candidates
+    # on this (a capability mismatch is not a failover — the replica is
+    # healthy, it just doesn't speak that protocol)
+    capabilities = ("rays",)
+
     def submit(self, rays, near, far, scene=None, tenant=None, ctx=None):
         """Enqueue on this replica's batcher (router-facing). Raises
         :class:`ReplicaUnavailableError` when not accepting, so the
@@ -114,7 +119,7 @@ class InProcessReplica:
                 f"replica {self.replica_id} is dead"
             )
         health = self.batcher.health()
-        return {
+        beat = {
             "replica": self.replica_id,
             "state": self.state,
             "ok": bool(health.get("ok")),
@@ -123,6 +128,19 @@ class InProcessReplica:
             "warm_source": self.warm_source,
             "total_compiles": int(self.engine.tracker.total_compiles()),
         }
+        # full residency state for the placement planner: staging-tier
+        # ids plus byte watermarks/budgets straight off the ladder
+        fleet = getattr(self.engine, "fleet", None)
+        if fleet is not None:
+            fs = fleet.stats()
+            beat.update(
+                staging=list(fs.get("staging", [])),
+                hbm_bytes=int(fs.get("resident_bytes", 0)),
+                staging_bytes=int(fs.get("staging_bytes", 0)),
+                hbm_budget_bytes=int(fs.get("budget_bytes", 0)),
+                staging_budget_bytes=int(fs.get("staging_budget_bytes", 0)),
+            )
+        return beat
 
     def drain(self, timeout_s: float = 60.0) -> int:
         """Render everything queued, then retire. Returns the number of
@@ -194,15 +212,25 @@ class ProcessReplica:
     routes pose requests. Used by operators/scripts, not tier-1 (no
     subprocess spawns in the test budget)."""
 
+    # pose-only over HTTP: ray-level submit is the in-process surface
+    capabilities = ("pose",)
+
     def __init__(self, replica_id: str, cfg_file: str, host: str,
                  port: int, python: str = "python",
-                 clock=time.monotonic):
+                 clock=time.monotonic, healthz_ttl_s: float = 0.5):
         self.replica_id = str(replica_id)
         self.cfg_file = cfg_file
         self.host = host
         self.port = int(port)
         self.python = python
         self.clock = clock
+        # one /healthz snapshot serves every probe inside the TTL: the
+        # router calls load() AND resident_scenes() per candidate per
+        # dispatch, and two HTTP round trips per routing decision is
+        # the probe tax this cache removes
+        self.healthz_ttl_s = float(healthz_ttl_s)
+        self._beat_t = -float("inf")
+        self._beat_health: dict | None = None
         self.state = ReplicaState.STARTING
         self.proc = None
         self.n_submitted = 0
@@ -211,7 +239,7 @@ class ProcessReplica:
         return [self.python, "serve.py", "--cfg_file", self.cfg_file,
                 "--host", self.host, "--port", str(self.port)]
 
-    def spawn(self, env=None) -> None:
+    def spawn(self, env=None, cwd=None) -> None:
         import os
         import subprocess
 
@@ -219,6 +247,7 @@ class ProcessReplica:
         self.proc = subprocess.Popen(
             self.argv(), env={**os.environ, **(env or {}),
                               "SCALE_REPLICA_ID": self.replica_id},
+            cwd=cwd,
         )
 
     def _get(self, path: str, timeout: float = 2.0) -> dict:
@@ -237,16 +266,29 @@ class ProcessReplica:
     def accepting(self) -> bool:
         return self.state == ReplicaState.READY
 
+    def _healthz(self, force: bool = False) -> dict:
+        """The shared heartbeat snapshot. A fetch inside the TTL is
+        free (cache hit); failures are never cached, so the
+        unreachable→sentinel behavior of the probes is unchanged."""
+        now = self.clock()
+        if (not force and self._beat_health is not None
+                and now - self._beat_t < self.healthz_ttl_s):
+            return self._beat_health
+        health = self._get("/healthz")
+        self._beat_health = health
+        self._beat_t = now
+        return health
+
     def load(self) -> int:
         try:
-            return int(self._get("/healthz").get("queue_depth", 0))
+            return int(self._healthz().get("queue_depth", 0))
         # graftlint: ok(swallow: routing probe; unreachable -> sentinel load, sweep owns the dead-marking)
         except Exception:
             return 1 << 30  # unreachable sorts last for routing
 
     def resident_scenes(self) -> list[str]:
         try:
-            return list(self._get("/healthz")
+            return list(self._healthz()
                         .get("replica", {}).get("scenes", []))
         # graftlint: ok(swallow: affinity hint only; empty set just loses the routing preference)
         except Exception:
@@ -255,12 +297,13 @@ class ProcessReplica:
     def heartbeat(self) -> dict:
         if self.proc is not None and self.proc.poll() is not None:
             self.state = ReplicaState.DEAD
+            self._beat_health = None  # a dead child has no fresh beat
             raise ReplicaUnavailableError(
                 f"replica {self.replica_id} exited "
                 f"(code {self.proc.returncode})"
             )
         try:
-            health = self._get("/healthz")
+            health = self._healthz()
         except Exception as exc:
             raise ReplicaUnavailableError(
                 f"replica {self.replica_id} unreachable: {exc}"
@@ -277,6 +320,13 @@ class ProcessReplica:
             "scenes": list(rep.get("scenes", [])),
             "warm_source": rep.get("warm_source"),
             "total_compiles": int(rep.get("total_compiles", 0)),
+            # full residency state for the placement planner (serve.py
+            # /healthz carries the child's ladder tiers + watermarks)
+            "staging": list(rep.get("staging", [])),
+            "hbm_bytes": int(rep.get("hbm_bytes", 0)),
+            "staging_bytes": int(rep.get("staging_bytes", 0)),
+            "hbm_budget_bytes": int(rep.get("hbm_budget_bytes", 0)),
+            "staging_budget_bytes": int(rep.get("staging_budget_bytes", 0)),
             # tracing health rides the heartbeat for free (spans emitted,
             # sink drops, remote-parented count) — serve.py /healthz
             "trace": dict(rep.get("trace", {})),

@@ -14,6 +14,22 @@ id-ordered for determinism. Failover is synchronous: a replica that
 refuses or dies mid-submit is excluded and the next candidate tried, so
 the caller sees one submit, not the failure.
 
+With a :class:`~.placement.PlacementPlanner` attached
+(:meth:`Router.set_planner`), the current plan is consulted BEFORE the
+passive ordering: candidates the plan assigned the scene to are
+stably promoted to the front (within the planned and unplanned groups
+the affinity/load/id order is untouched), so a plan hit routes to the
+planned replica and a plan miss — or an empty/disabled plan — is
+bitwise today's behavior. Dispatches against an active plan are
+counted planned/unplanned; the unplanned share is tlm_report's
+is-the-plan-working signal.
+
+Candidates are also filtered on the replica ``capabilities`` flag
+(ray-level ``submit`` vs whole-pose ``render``): a capability mismatch
+is a FILTER, not a failover — the replica is healthy, it just doesn't
+speak that protocol — and when no capable replica exists the typed
+:class:`NoCapableReplicaError` says so instead of a generic no-replica.
+
 Retirement is drain-before-retire: the replica leaves the candidate set
 FIRST (no new admissions), renders everything already queued, and only
 then stops — zero in-flight requests fail (tests/test_scale.py holds
@@ -35,6 +51,11 @@ class NoReplicaAvailableError(RuntimeError):
     """Every registered replica is draining, retired, or dead."""
 
 
+class NoCapableReplicaError(NoReplicaAvailableError):
+    """Accepting replicas exist, but none serves this request shape
+    (e.g. a ray-level submit against a pose-only HTTP fleet)."""
+
+
 class _Entry:
     def __init__(self, replica, now: float):
         self.replica = replica
@@ -49,10 +70,19 @@ class Router:
         self.clock = clock
         self._lock = threading.Lock()
         self._entries: dict[str, _Entry] = {}
+        self.planner = None  # optional scale/placement.PlacementPlanner
         self.n_dispatches = 0
         self.n_affinity_hits = 0
+        self.n_planned_hits = 0
+        self.n_unplanned = 0
         self.n_failovers = 0
         self.n_dead_marked = 0
+
+    def set_planner(self, planner) -> None:
+        """Attach a placement planner; its current plan is consulted on
+        every dispatch (None or an empty plan leaves dispatch exactly
+        as before)."""
+        self.planner = planner
 
     # -- registry -------------------------------------------------------------
 
@@ -111,13 +141,43 @@ class Router:
 
     # -- dispatch -------------------------------------------------------------
 
-    def _candidates(self, scene) -> list[tuple[bool, int, str, object]]:
+    def _planned_set(self, scene) -> frozenset:
+        """Replica ids the current placement plan wants ``scene`` on
+        (empty without a planner / active plan / plan entry — every one
+        of those leaves dispatch bitwise pre-placement)."""
+        if self.planner is None or scene is None:
+            return frozenset()
+        try:
+            return frozenset(self.planner.planned_replicas(scene))
+        # graftlint: ok(swallow: the plan is advisory; a planner error must degrade to passive dispatch, not fail the request)
+        except Exception:
+            return frozenset()
+
+    def _count_plan_hit(self, replica_id: str, planned: frozenset) -> None:
+        if self.planner is None or not getattr(
+                self.planner, "active", lambda: False)():
+            return
+        if replica_id in planned:
+            self.n_planned_hits += 1
+        else:
+            self.n_unplanned += 1
+            get_metrics().counter("scale_router_events_total",
+                                  event="unplanned_dispatch")
+
+    def _candidates(self, scene, need=None) -> list:
         """Accepting replicas as (no_affinity, load, id, replica), sorted
-        so ``[0]`` is the pick: affinity beats load beats id."""
+        so ``[0]`` is the pick: affinity beats load beats id. ``need``
+        filters on the replica ``capabilities`` flag (replicas without
+        one are assumed universal — test doubles predate the flag). A
+        planned scene stably promotes its planned replicas to the
+        front; an empty plan changes nothing."""
         out = []
         for entry in self._entries.values():
             r = entry.replica
             if not r.accepting():
+                continue
+            caps = getattr(r, "capabilities", None)
+            if need is not None and caps is not None and need not in caps:
                 continue
             affinity = (
                 scene is not None
@@ -130,6 +190,10 @@ class Router:
                 load = 1 << 30
             out.append((not affinity, load, r.replica_id, r))
         out.sort(key=lambda c: c[:3])
+        planned = self._planned_set(scene)
+        if planned:
+            # stable: planned candidates first, passive order within
+            out.sort(key=lambda c: c[2] not in planned)
         return out
 
     def pick(self, scene=None):
@@ -141,7 +205,22 @@ class Router:
             )
         return cands[0][3]
 
-    def _no_replica(self, scene) -> NoReplicaAvailableError:
+    def _no_replica(self, scene, need=None) -> NoReplicaAvailableError:
+        n_accepting = sum(1 for e in self._entries.values()
+                          if e.replica.accepting())
+        if need is not None and n_accepting:
+            # accepting replicas exist but every one was capability-
+            # filtered: a protocol mismatch, not an availability outage
+            get_emitter().emit("router", event="no_capable",
+                               need=str(need),
+                               **({} if scene is None
+                                  else {"scene": str(scene)}))
+            get_metrics().counter("scale_router_events_total",
+                                  event="no_capable")
+            return NoCapableReplicaError(
+                f"{n_accepting} accepting replicas, none capable of "
+                f"{need!r} requests"
+            )
         get_emitter().emit("router", event="no_replica",
                            **({} if scene is None
                               else {"scene": str(scene)}))
@@ -182,9 +261,10 @@ class Router:
         with trs.span("route.submit", stage="route",
                       **({} if scene is None
                          else {"scene": str(scene)})) as sp:
-            cands = self._candidates(scene)
+            cands = self._candidates(scene, need="rays")
             if not cands:
-                raise self._no_replica(scene)
+                raise self._no_replica(scene, need="rays")
+            planned = self._planned_set(scene)
             last_exc: Exception | None = None
             for i, (no_aff, load, _rid, replica) in enumerate(cands):
                 t_try = trs.now()
@@ -208,6 +288,7 @@ class Router:
                 self.n_dispatches += 1
                 if not no_aff:
                     self.n_affinity_hits += 1
+                self._count_plan_hit(replica.replica_id, planned)
                 sp.set(replica=replica.replica_id)
                 get_metrics().counter("scale_router_dispatch_total",
                                       replica=replica.replica_id)
@@ -230,10 +311,11 @@ class Router:
         with trs.span("route.submit",
                       **({} if scene is None
                          else {"scene": str(scene)})) as root:
-            cands = [c for c in self._candidates(scene)
+            cands = [c for c in self._candidates(scene, need="pose")
                      if hasattr(c[3], "render")]
             if not cands:
-                raise self._no_replica(scene)
+                raise self._no_replica(scene, need="pose")
+            planned = self._planned_set(scene)
             last_exc: Exception | None = None
             for i, (no_aff, _load, _rid, replica) in enumerate(cands):
                 t_try = trs.now()
@@ -260,6 +342,7 @@ class Router:
                 self.n_dispatches += 1
                 if not no_aff:
                     self.n_affinity_hits += 1
+                self._count_plan_hit(replica.replica_id, planned)
                 root.set(replica=replica.replica_id)
                 get_metrics().counter("scale_router_dispatch_total",
                                       replica=replica.replica_id)
@@ -290,6 +373,28 @@ class Router:
         get_metrics().counter("scale_router_events_total", event="drain")
         return failed
 
+    def residency_view(self) -> dict[str, dict]:
+        """Per-replica residency state off the last heartbeat round —
+        the placement planner's fleet-side input (scene sets, byte
+        watermarks, ladder budgets; zeros for replicas whose beats
+        predate the planner fields)."""
+        out: dict[str, dict] = {}
+        for entry in self._entries.values():
+            r = entry.replica
+            if not r.accepting():
+                continue
+            b = entry.beat
+            out[r.replica_id] = {
+                "scenes": list(b.get("scenes", ())),
+                "staging": list(b.get("staging", ())),
+                "hbm_bytes": int(b.get("hbm_bytes", 0)),
+                "staging_bytes": int(b.get("staging_bytes", 0)),
+                "hbm_budget_bytes": int(b.get("hbm_budget_bytes", 0)),
+                "staging_budget_bytes": int(
+                    b.get("staging_budget_bytes", 0)),
+            }
+        return out
+
     def load_view(self) -> dict[str, int]:
         """Per-replica queue depth from the last heartbeat round — the
         ``queue_depths`` half of a scale decision's evidence block."""
@@ -313,6 +418,8 @@ class Router:
             "n_ready": self.n_ready(),
             "n_dispatches": self.n_dispatches,
             "n_affinity_hits": self.n_affinity_hits,
+            "n_planned_hits": self.n_planned_hits,
+            "n_unplanned": self.n_unplanned,
             "n_failovers": self.n_failovers,
             "n_dead_marked": self.n_dead_marked,
             "replicas": per,

@@ -57,7 +57,8 @@ class Supervisor:
 
     def __init__(self, router, spawn_fn, options: ScaleOptions | None = None,
                  clock=time.monotonic, evidence_source=None,
-                 slo_target_s: float = 0.25, alerts=None):
+                 slo_target_s: float = 0.25, alerts=None,
+                 planner=None, placement_executor=None):
         self.router = router
         self.spawn_fn = spawn_fn
         self.options = options or ScaleOptions()
@@ -67,6 +68,14 @@ class Supervisor:
         # optional obs.alerts.AlertEngine fed the fleet-merged window in
         # step_from_fleet — the burn-rate alerts see what the loop sees
         self.alerts = alerts
+        # optional scale/placement wiring: the supervisor owns the plan
+        # lifecycle — replan on scale-out/in, replica death, and scene
+        # publish, plus a periodic cadence; execute pending moves (rate-
+        # limited) on every step
+        self.planner = planner
+        self.placement_executor = placement_executor
+        self._last_plan_t = -float("inf")
+        self._publish_pending = False
         self._spawn_index = 0
         self._out_streak = 0
         self._in_streak = 0
@@ -121,7 +130,41 @@ class Supervisor:
                 self._decide("replace", f"dead:{r.replica_id}",
                              replica=fresh.replica_id)
         self.n_replaced += replaced
+        if replaced:
+            # capacity repair invalidates the plan: the dead replica's
+            # assignments must land somewhere that exists
+            self._placement_tick("replace")
         return replaced
+
+    # -- placement ------------------------------------------------------------
+
+    def note_publish(self, scene_id: str) -> None:
+        """A scene version went out (fleet/publish.py): replan at the
+        next step so publish moves push it to every assigned replica."""
+        if self.planner is not None:
+            self.planner.note_publish(scene_id)
+            self._publish_pending = True
+
+    def _placement_tick(self, action: str) -> None:
+        """One plan-lifecycle beat: replan when triggered (scale/death/
+        publish) or the cadence is due, then apply up to
+        ``max_moves_per_step`` pending moves."""
+        if self.planner is None:
+            return
+        popt = self.options.placement
+        now = self.clock()
+        trigger = action in ("out", "in", "replace")
+        if self._publish_pending:
+            trigger, action = True, "publish"
+            self._publish_pending = False
+        if trigger or now - self._last_plan_t >= popt.replan_every_s:
+            self._last_plan_t = now
+            self.planner.replan_from_router(
+                self.router,
+                reason=action if trigger else "periodic")
+        if self.placement_executor is not None and self.planner.pending:
+            self.placement_executor.execute(
+                self.planner, limit=popt.max_moves_per_step)
 
     def _retire_pick(self):
         """Least-loaded ready replica (fastest drain, least disruption)."""
@@ -200,7 +243,17 @@ class Supervisor:
         """Evaluate one observation window; returns the action taken
         (``out`` / ``in`` / ``replace`` / ``hold``). ``attainment`` is
         the window's SLO attainment in [0, 1] (None = no traffic, which
-        counts toward scale-IN: an idle fleet should shrink)."""
+        counts toward scale-IN: an idle fleet should shrink). With a
+        planner attached, every step also beats the plan lifecycle
+        (replan on scale actions / publish / cadence, then apply a
+        bounded batch of pending moves)."""
+        action = self._step_window(attainment, deny_rate)
+        if action != "replace":  # replace_dead already ticked the plan
+            self._placement_tick(action)
+        return action
+
+    def _step_window(self, attainment: float | None,
+                     deny_rate: float = 0.0) -> str:
         opt = self.options
         now = self.clock()
         self._attainment_history.append(
@@ -292,4 +345,6 @@ class Supervisor:
             "churn": self.n_spawned + self.n_retired,
             "n_decisions": len(self.decisions),
             "router": self.router.stats(),
+            "placement": (None if self.planner is None
+                          else self.planner.stats()),
         }

@@ -21,6 +21,7 @@ command line, not a war story.
     python scripts/chaos_run.py serve --scenes 3 --tenants 3 \
         --fault fleet.load:truncate:3:1
     python scripts/chaos_run.py serve --replicas 3 --requests 48
+    python scripts/chaos_run.py serve --replicas 2 --processes
 
 ``--replicas N`` serves the stream through the scale-out front door
 (nerf_replication_tpu/scale): N in-process replicas behind the router,
@@ -33,6 +34,15 @@ the crash), the supervisor's next pass to replace the dead replica
 teardown to fail zero in-flight requests, and the whole episode to
 trigger zero recompiles (the replacement warms from the shared
 engine).
+
+``--replicas N --processes`` runs the same kill against the REAL
+multi-process shape (scale/launcher.py + scale/placement.py): the
+launcher spawns N ``serve.py`` children warm from one shared artifact
+dir, routed traffic heats a scene until the placement plan replicates
+it ``hot_width``-wide, then a hot-scene child is SIGKILLed at the OS
+level with its registry entry still saying ready. Recovery then ALSO
+requires the launcher's 1:1 respawn, the replan restoring the hot
+width, and zero compiles across every child (all-disk warm starts).
 
 ``--scenes N`` puts the serve mode behind a multi-scene fleet
 (nerf_replication_tpu/fleet) with an HBM budget of about half the
@@ -667,6 +677,246 @@ def run_serve_replicas(args, plan) -> dict:
     return out
 
 
+def run_serve_processes(args, plan) -> dict:
+    """Crashed-PROCESS chaos behind the placement-planned fleet.
+
+    The launcher spawns ``--replicas`` REAL serve.py children against
+    one shared artifact dir; routed traffic heats one scene until the
+    placement plan replicates it ``hot_width``-wide; then a hot-scene
+    child is SIGKILLed at the OS level WITHOUT its registry entry
+    knowing (state still says ready — the liar). Recovery requires the
+    router to fail the next render over to the surviving planned holder
+    (zero post-kill failures), the supervisor's pass to bury the corpse
+    and 1:1-respawn through the launcher, the replan to restore the hot
+    width, drain-before-retire to fail nothing, and the children to
+    report zero steady-state compiles (all-disk warm starts)."""
+    import numpy as np
+
+    from nerf_replication_tpu.config import make_cfg
+    from nerf_replication_tpu.fleet import SceneStore
+    from nerf_replication_tpu.obs import (
+        CapacityLedger,
+        configure_tracing,
+        init_run,
+    )
+    from nerf_replication_tpu.resil import (
+        FlightRecorder,
+        injecting,
+        install_flight_recorder,
+        uninstall_flight_recorder,
+    )
+    from nerf_replication_tpu.scale import (
+        PlacementExecutor,
+        PlacementOptions,
+        PlacementPlanner,
+        ProcessLauncher,
+        ReplicaState,
+        Router,
+        ScaleOptions,
+        Supervisor,
+    )
+    from nerf_replication_tpu.serve import engine_from_cfg
+
+    # the bench owns the fleet asset builders (child YAML + sharded
+    # scene store); chaos reuses them rather than growing a drifting copy
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench as sb
+
+    scene_root = _scene(args.workdir)
+    workroot = os.path.join(args.workdir, "fleet")
+    os.makedirs(workroot, exist_ok=True)
+    store_dir = os.path.join(workroot, "scenes")
+    shim = argparse.Namespace(buckets=[256], chunk=64, max_batch_rays=512,
+                              max_delay_ms=5.0, shed_depths=[8, 32, 96])
+    cfg_path = sb._write_placement_cfg(shim, workroot, scene_root, store_dir)
+    cfg = make_cfg(cfg_path, default_task="run")
+    telem = os.path.join(args.workdir, "record", "telemetry.jsonl")
+    init_run(cfg, component="serve", path=telem)
+    flight_dir = os.path.join(args.workdir, "record")
+    configure_tracing(enabled=True)
+    install_flight_recorder(FlightRecorder(flight_dir))
+    incidents, alerts = _ops_attach(flight_dir, with_alerts=args.alerts)
+    scene_ids, scene_bytes = sb._build_placement_store(cfg, store_dir, 2)
+    hot = scene_ids[0]
+    print("chaos: pre-booting parent engine (serializes the shared "
+          "artifacts the children warm from)")
+    engine_from_cfg(cfg, cfg_file=cfg_path)
+
+    n = max(2, args.replicas)
+    ledger = CapacityLedger(replica="router", window_s=600.0)
+
+    def heat_view() -> dict:
+        # normalize to the peak scene so the 4:1 hot/cold request ratio —
+        # not this host's absolute req/s — decides the hot/cold split
+        scenes = ledger.view().get("scenes", {})
+        peak = max((s.get("requests_per_s", 0.0) for s in scenes.values()),
+                   default=0.0)
+        if peak <= 0.0:
+            return {"scenes": {}}
+        return {"scenes": {
+            sid: {"requests_per_s": s.get("requests_per_s", 0.0) / peak}
+            for sid, s in scenes.items()}}
+
+    popt = PlacementOptions(enabled=True, hot_width=min(2, n), max_width=n,
+                            hot_rps=0.5, width_rps=1e9,
+                            replan_every_s=0.0, max_moves_per_step=8)
+    planner = PlacementPlanner(SceneStore(store_dir), options=popt,
+                               heat_fn=heat_view,
+                               scene_bytes_fn=lambda sid: scene_bytes)
+    router = Router(heartbeat_timeout_s=5.0)
+    router.set_planner(planner)
+    launcher = ProcessLauncher(
+        cfg_path,
+        env={"JAX_PLATFORMS": args.backend.split(":")[0]}
+        if args.backend else {},
+        ready_timeout_s=600.0, healthz_ttl_s=0.2,
+    )
+    sup = Supervisor(router, launcher, options=ScaleOptions(
+        min_replicas=n, max_replicas=n, cooldown_out_s=1e9,
+        cooldown_in_s=1e9, placement=popt),
+        planner=planner, placement_executor=PlacementExecutor())
+    sup.ensure_min()
+
+    rng = np.random.default_rng(args.seed)
+    ok = failed = post_kill_failed = 0
+
+    def one(sid: str, replica=None) -> bool:
+        nonlocal ok, failed
+        body = {"scene": sid, "theta": float(rng.uniform(0.0, 360.0)),
+                "phi": -30.0, "radius": 4.0}
+        try:
+            if replica is None:
+                router.render(body, timeout_s=30.0)
+                ledger.note_request(sid, 16 * 16)
+            else:
+                replica.render(body, timeout_s=30.0)
+            ok += 1
+            return True
+        # graftlint: ok(swallow: counted failure; the recovered gate reads post_kill_failed/n_failed)
+        except Exception as exc:
+            failed += 1
+            print(f"chaos: request for {sid} failed: "
+                  f"{type(exc).__name__}: {exc}")
+            return False
+
+    def holders() -> list:
+        router.sweep()
+        view = router.residency_view()
+        return [rid for rid in sorted(view)
+                if hot in view[rid]["scenes"] or hot in view[rid]["staging"]]
+
+    def tick() -> None:
+        """One supervisor pass + realize the plan's lazy prefetches by
+        aiming a request at every planned-but-not-resident pair."""
+        router.sweep()
+        sup.step(1.0, 0.0)
+        by_id = {r.replica_id: r for r in router.replicas()}
+        view = router.residency_view()
+        assignments = (planner.current.assignments
+                       if planner.current is not None else {})
+        for sid, rids in sorted(assignments.items()):
+            for rid in rids:
+                st = view.get(rid)
+                if st is None or rid not in by_id:
+                    continue
+                if sid not in st["scenes"] and sid not in st["staging"]:
+                    one(sid, replica=by_id[rid])
+
+    killed = None
+    t0_run = time.perf_counter()
+    with injecting(plan):
+        for _ in range(3):  # heat: plan + realize the hot width
+            for _ in range(4):
+                one(hot)
+            one(scene_ids[1])
+            tick()
+            time.sleep(0.5)
+        width_before = len(holders())
+        by_id = {r.replica_id: r for r in router.replicas()}
+        victim = next((by_id[rid] for rid in holders()
+                       if rid in by_id
+                       and by_id[rid].state == ReplicaState.READY), None)
+        if victim is not None:
+            # SIGKILL the OS process; the registry entry still says
+            # READY — the liar. The ROUTER must discover the corpse.
+            victim.proc.kill()
+            victim.proc.wait(timeout=10.0)
+            killed = victim.replica_id
+            print(f"chaos: SIGKILLed child {killed} (holds {hot})")
+            for _ in range(4):
+                if not one(hot):
+                    post_kill_failed += 1
+            sup.replace_dead()  # bury + 1:1 respawn through the launcher
+            for _ in range(3):  # replan + realize until width restores
+                for _ in range(4):
+                    one(hot)
+                tick()
+                if len(holders()) >= popt.hot_width:
+                    break
+                time.sleep(0.5)
+        if alerts is not None:
+            alerts.evaluate()
+    wall = time.perf_counter() - t0_run
+
+    child_compiles = 0
+    for r in router.replicas():
+        if r.accepting():
+            try:
+                child_compiles += int(
+                    r.heartbeat().get("total_compiles", 0))
+            # graftlint: ok(swallow: teardown snapshot; a dead child already failed the width gate)
+            except Exception:
+                pass
+    final_width = len(holders())
+    drain_failures = 0
+    for r in list(router.replicas()):
+        if r.accepting():
+            drain_failures += int(router.drain(r.replica_id, timeout_s=30.0))
+    launcher.shutdown()
+    ops = _ops_finish(incidents, alerts)
+    uninstall_flight_recorder()
+    configure_tracing(enabled=False)
+    pstats = planner.stats()
+    out = {
+        "mode": "serve",
+        "completed": True,
+        "died": None,
+        "wall_s": round(wall, 2),
+        "n_ok": ok,
+        "n_rejected_503": 0,
+        "n_failed": failed,
+        "worker_restarts": 0,
+        "breaker": {"state": "closed"},
+        # children warm from the shared artifact dir: every build any of
+        # them did IS a steady-state recompile for the recovered gate
+        "recompiles_steady": child_compiles,
+        "telemetry": telem,
+        "scale": {
+            "n_replicas": n,
+            "killed": killed,
+            "n_failovers": router.n_failovers,
+            "n_dead_marked": router.n_dead_marked,
+            "n_replaced": sup.n_replaced,
+            "post_kill_failed": post_kill_failed,
+            "post_kill_p95_ms": None,
+            "drain_failures": drain_failures,
+            "router": router.stats(),
+        },
+        "placement": {
+            "plan_version": pstats["version"],
+            "hot_scene": hot,
+            "hot_width_target": popt.hot_width,
+            "hot_width_before_kill": width_before,
+            "hot_width_achieved": final_width,
+            "moves_failed": pstats["n_failed_moves"],
+            "children_spawned": launcher.n_spawned,
+        },
+        "flight_dumps": _scan_flight_dumps(flight_dir),
+    }
+    out.update(ops)
+    return out
+
+
 def _scan_flight_dumps(flight_dir: str) -> dict:
     """Validate every flight_<reason>.json the run left and extract which
     injected faults its event ring names (the post-mortem must point at
@@ -865,6 +1115,13 @@ def main(argv=None) -> int:
                         "recovery requires a router failover, a 1:1 "
                         "supervisor replacement, zero post-kill "
                         "failures, and a clean drain")
+    p.add_argument("--processes", action="store_true",
+                   help="serve mode, with --replicas: the fleet is REAL "
+                        "serve.py child processes (scale/launcher.py) "
+                        "behind the placement planner; the kill is a "
+                        "SIGKILL of a hot-scene child and recovery "
+                        "requires the launcher respawn + plan-restored "
+                        "width on top of the --replicas contract")
     p.add_argument("--alerts", action="store_true",
                    help="serve mode: run the chaos-scaled burn-rate "
                         "alert engine — the breaker scenario must PAGE "
@@ -902,6 +1159,8 @@ def main(argv=None) -> int:
 
     if args.mode == "train":
         runner = run_train
+    elif args.replicas > 0 and args.processes:
+        runner = run_serve_processes
     elif args.replicas > 0:
         runner = run_serve_replicas
     else:
@@ -938,6 +1197,13 @@ def main(argv=None) -> int:
             and scale_out.get("n_replaced", 0) == 1
             and scale_out.get("post_kill_failed", 1) == 0
             and scale_out.get("drain_failures", 1) == 0
+        ))
+        # process mode: the placement plan must have put the hot scene
+        # back at full width after the respawn, with zero failed moves
+        and (outcome.get("placement") is None or (
+            outcome["placement"]["hot_width_achieved"]
+            >= outcome["placement"]["hot_width_target"]
+            and outcome["placement"]["moves_failed"] == 0
         ))
     )
     flight_ok, flight_problems = check_flight(outcome, summary, plan)

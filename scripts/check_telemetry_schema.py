@@ -9,8 +9,10 @@ the serving load generator's ``BENCH_SERVE.jsonl`` (family ``serve_mode``)
 and its multi-scene fleet trail ``BENCH_FLEET.jsonl`` (family
 ``fleet_mode``), its multi-tenant QoS trail ``BENCH_QOS.jsonl`` (family
 ``qos_mode``), its replica scale-out trail ``BENCH_SCALE.jsonl``
-(family ``scale_mode``, one full scale-out/scale-in cycle per row; all
-four written by scripts/serve_bench.py), and the learned sampler's
+(families ``scale_mode`` — one full scale-out/scale-in cycle per row —
+and ``placement_mode`` — one placement-planned fleet run per row, plan
+version / hot-width attainment / budget compliance / unplanned-dispatch
+share; all written by scripts/serve_bench.py), and the learned sampler's
 ``BENCH_SAMPLING.jsonl`` (family ``sampling_mode``, written by
 scripts/bench_sampling.py) via the ``BENCH_*.jsonl`` pattern.
 
@@ -22,7 +24,9 @@ rows' ``evidence`` block (attainment series, per-replica queue depths,
 deny rate, alnum exemplar trace ids — unknown evidence keys are
 errors), and the ops-intelligence rows PR 16 added (``alert`` state/
 severity enums, ``incident`` lifecycle status, ``capacity_snapshot``
-per-replica ledger commits). Every other JSONL is
+per-replica ledger commits, and the placement rows this PR added —
+``placement_plan`` rows' ``evidence.scene_heat`` block and
+``placement_move`` rows' move-kind enum). Every other JSONL is
 checked structurally against the known bench row families — so a bench
 script that drifts shape (the pre-PR-1 failure mode: three incompatible
 row families grew across ten scripts) fails here instead of silently

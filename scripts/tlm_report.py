@@ -18,7 +18,10 @@ admit/deny/shed mix, tier occupancy (HBM vs host-RAM staging), the
 demote-vs-cold reload split, and publish outcomes. Runs behind the
 scale-out front door (serve_bench/chaos_run ``--replicas``) add a
 scale section — replica lifecycle mix, supervisor decision mix with
-SLO-miss window count, and the router's failover/drain counters.
+SLO-miss window count, and the router's failover/drain counters; runs
+with a placement planner attached add a placement block — replan count,
+final plan version, move mix (publish/prefetch/demote) with failures,
+convergence p95, and the unplanned-dispatch share.
 ``--diff`` compares run A (baseline) against run B
 (candidate) and flags regressions past ``--gate`` percent (step-time
 p50, peak memory, queue-wait p95 share, tenant deny rate, staging
@@ -26,7 +29,8 @@ re-promotion share) or any compile-count increase / PSNR drop > 0.1 dB
 / growth in unrecovered faults (exhausted retry ladders), breaker
 opens, cold scene loads, failed publishes, fine-MLP evals/ray (the
 learned-sampling budget), SLO-miss windows, replica churn, drain-failed
-requests, orphan-span rate, or evidence-free scale actions; with
+requests, orphan-span rate, evidence-free scale actions, failed
+placement moves, or unplanned-dispatch share; with
 ``--gate`` the exit code is nonzero when
 a regression is flagged, so a bench battery can use it as its gate
 against a saved baseline run (e.g. the run behind ``BASELINE.json``).
@@ -524,6 +528,44 @@ def summarize(rows: list[dict]) -> dict:
         summary["scale_actions_with_evidence"] = len(with_ev)
         summary["scale_actions_evidence_free"] = len(acted) - len(with_ev)
 
+    # placement rows (scale/placement.py): the plan lifecycle — replan
+    # count and final version, applied-move mix, convergence wall-time
+    # p95, failed moves (a pinned-evict refusal or a failed publish),
+    # and the unplanned-dispatch share off the last plan row's router
+    # counters. ``placement_failed_moves`` and
+    # ``placement_unplanned_share`` are the two numbers the --diff gate
+    # holds. Keys present only when the stream carries placement rows
+    # (serve_bench --replicas with placement enabled).
+    plan_rows = [r for r in rows if r.get("kind") == "placement_plan"]
+    move_rows = [r for r in rows if r.get("kind") == "placement_move"]
+    if plan_rows or move_rows:
+        summary["placement_plans"] = len(plan_rows)
+        versions = [int(r.get("version", 0)) for r in plan_rows]
+        summary["placement_plan_version"] = max(versions) if versions else 0
+        move_mix: dict = {}
+        failed = 0
+        for r in move_rows:
+            k = r.get("move", "?")
+            move_mix[k] = move_mix.get(k, 0) + 1
+            if not r.get("ok"):
+                failed += 1
+        summary["placement_move_mix"] = move_mix
+        summary["placement_failed_moves"] = failed
+        conv = [float(r["convergence_s"]) for r in plan_rows
+                if r.get("convergence_s") is not None]
+        summary["placement_convergences"] = len(conv)
+        summary["placement_convergence_p95_s"] = (
+            _percentile(conv, 95) if conv else None)
+        counted = [r for r in plan_rows if r.get("planned_hits") is not None
+                   or r.get("unplanned") is not None]
+        if counted:
+            last = counted[-1]
+            hits = int(last.get("planned_hits") or 0)
+            unplanned = int(last.get("unplanned") or 0)
+            total = hits + unplanned
+            summary["placement_unplanned_share"] = (
+                round(unplanned / total, 4) if total else 0.0)
+
     # ops-intelligence rows (obs/alerts.py / incidents.py / capacity.py):
     # alert transitions + firing minutes per alert, the incident
     # lifecycle ledger (unresolved count is a --diff gate), and the last
@@ -790,6 +832,23 @@ def print_summary(summary: dict, label: str = "") -> None:
               f"failover(s), {summary.get('router_dead_marked', 0)} dead, "
               f"{summary.get('drain_failed_requests', 0)} drain-failed "
               f"request(s)")
+    if summary.get("placement_plans") is not None:
+        mix = " ".join(
+            f"{k}:{v}"
+            for k, v in sorted((summary.get("placement_move_mix")
+                                or {}).items())
+        )
+        conv = summary.get("placement_convergence_p95_s")
+        print(f"  placement:     {summary['placement_plans']} replan(s), "
+              f"plan v{summary.get('placement_plan_version', 0)}"
+              + (f"  moves {mix}" if mix else "  no moves")
+              + f"  failed: {summary.get('placement_failed_moves', 0)}")
+        share = summary.get("placement_unplanned_share")
+        print(f"    convergence: "
+              f"{summary.get('placement_convergences', 0)} time(s)"
+              + (f", p95 {conv:.3f}s" if conv is not None else "")
+              + (f"  unplanned-dispatch share: {share:.2%}"
+                 if share is not None else ""))
     if summary.get("alerts_fired") is not None:
         mix = " ".join(
             f"{k}:{v}"
@@ -993,6 +1052,26 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     if b is not None and b > a:
         flags.append(f"unresolved incidents grew {a} -> {b} "
                      f"(incident lifecycle left open)")
+    # a failed placement move is a plan the executor could not realize
+    # (a pinned lease blocking a demote, a publish that raised) — any
+    # growth means plans and fleet state are drifting apart
+    a = base.get("placement_failed_moves") or 0
+    b = cand.get("placement_failed_moves")
+    if b is not None and b > a:
+        flags.append(f"failed placement moves grew {a} -> {b} "
+                     f"(plans the fleet could not realize)")
+    # unplanned-dispatch share growing means the router is routing around
+    # the plan — placement lagging the heat it is supposed to track. The
+    # 0.02 absolute floor keeps near-zero baselines from flagging on a
+    # handful of requests landing mid-replan.
+    a = base.get("placement_unplanned_share")
+    b = cand.get("placement_unplanned_share")
+    if (b is not None and (b - (a or 0.0)) > 0.02
+            and (not a or pct(a, b) > gate_pct)):
+        flags.append(
+            f"unplanned-dispatch share grew {(a or 0.0) * 100:.1f}% -> "
+            f"{b * 100:.1f}% (router routing around the plan)"
+        )
     # alert firing-minutes growing past the gate means the candidate
     # burned its error budget for longer than the baseline did — a
     # reliability regression even when throughput numbers look flat

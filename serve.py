@@ -174,12 +174,25 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
 
                 stats = engine.stats() if hasattr(engine, "stats") else {}
                 trs = get_tracer().stats()
+                fleet = getattr(engine, "fleet", None)
+                fs = fleet.stats() if fleet is not None else {}
                 health["replica"] = {
                     "id": os.environ.get("SCALE_REPLICA_ID", ""),
                     "warm_source": stats.get("warm_source"),
                     "total_compiles": stats.get("total_compiles", 0),
                     "scenes": (engine.resident_scenes()
                                if hasattr(engine, "resident_scenes") else []),
+                    # full residency state for the placement planner
+                    # (scale/placement.py): staging-tier scene ids plus
+                    # HBM/staging byte watermarks and ladder budgets —
+                    # a remote process exposes what an in-process
+                    # replica's heartbeat reads off its ladder directly
+                    "staging": list(fs.get("staging", [])),
+                    "hbm_bytes": int(fs.get("resident_bytes", 0)),
+                    "staging_bytes": int(fs.get("staging_bytes", 0)),
+                    "hbm_budget_bytes": int(fs.get("budget_bytes", 0)),
+                    "staging_budget_bytes": int(
+                        fs.get("staging_budget_bytes", 0)),
                     # tracing health, surfaced to the router's heartbeat:
                     # spans emitted, sink drops, and how many spans
                     # parented under a propagated (router) ctx

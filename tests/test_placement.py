@@ -1,0 +1,513 @@
+"""Placement subsystem (scale/placement.py + scale/launcher.py): the
+planner's determinism / budget packing / heat-driven width / move
+ordering, the router's plan-beats-affinity promotion and its bitwise
+empty-plan fallback, the capability filter's typed no-capable error,
+the supervisor's replan-on-death, and — marked ``slow`` — one REAL
+two-process ``serve.py`` fleet through the ProcessLauncher. Everything
+tier-1 runs on fake clocks/replicas; no processes, no chips."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nerf_replication_tpu.obs import validate_row
+from nerf_replication_tpu.scale import (
+    NoCapableReplicaError,
+    PlacementExecutor,
+    PlacementOptions,
+    PlacementPlan,
+    PlacementPlanner,
+    ReplicaState,
+    Router,
+    ScaleOptions,
+    Supervisor,
+    merge_heat,
+)
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeCatalog:
+    """The SceneStore surface the planner reads (ids + record get)."""
+
+    def __init__(self, *ids):
+        self._ids = list(ids)
+
+    def ids(self):
+        return list(self._ids)
+
+    def get(self, sid):
+        return {"scene_id": sid}
+
+
+class FakeReplica:
+    """The replica surface with scripted load/scenes/liveness."""
+
+    def __init__(self, rid, load=0, scenes=()):
+        self.replica_id = str(rid)
+        self.state = ReplicaState.READY
+        self._load = load
+        self.scenes = list(scenes)
+        self.beat_ok = True
+        self.submits = []
+
+    def accepting(self):
+        return self.state == ReplicaState.READY
+
+    def load(self):
+        return self._load
+
+    def heartbeat(self):
+        if not self.beat_ok:
+            raise RuntimeError("beat down")
+        return {"replica": self.replica_id, "state": self.state, "ok": True,
+                "load": self._load, "scenes": self.scenes,
+                "warm_source": "disk", "total_compiles": 0}
+
+    def submit(self, rays, near, far, scene=None, tenant=None):
+        self.submits.append(scene)
+        return f"future:{self.replica_id}"
+
+    def drain(self, timeout_s=60.0):
+        self.state = ReplicaState.RETIRED
+        return 0
+
+    def kill(self):
+        self.state = ReplicaState.DEAD
+
+
+class PoseOnlyReplica(FakeReplica):
+    """An HTTP-shaped replica: whole poses only, no ray-level submit."""
+
+    capabilities = ("pose",)
+
+
+def _state(scenes=(), staging=(), hbm_budget=0, staging_budget=0,
+           hbm_bytes=0, staging_bytes=0):
+    return {"scenes": list(scenes), "staging": list(staging),
+            "hbm_bytes": hbm_bytes, "staging_bytes": staging_bytes,
+            "hbm_budget_bytes": hbm_budget,
+            "staging_budget_bytes": staging_budget}
+
+
+def _heat(**rps):
+    return {s: {"requests_per_s": float(r), "rays_per_s": 0.0}
+            for s, r in rps.items()}
+
+
+def _router(clock, *replicas, timeout_s=10.0):
+    r = Router(heartbeat_timeout_s=timeout_s, clock=clock)
+    for rep in replicas:
+        r.register(rep)
+    r.sweep()  # populate beats (affinity reads the last beat)
+    return r
+
+
+def _planner(catalog, scene_bytes_fn=None, **opts):
+    merged = dict(enabled=True, hot_width=2, max_width=4,
+                  hot_rps=1.0, width_rps=2.0)
+    merged.update(opts)
+    return PlacementPlanner(catalog, options=PlacementOptions(**merged),
+                            scene_bytes_fn=scene_bytes_fn,
+                            clock=FakeClock())
+
+
+# -- planner: determinism ----------------------------------------------------
+
+
+def test_plan_is_deterministic_and_version_stable():
+    planner = _planner(FakeCatalog("a", "b"))
+    states = {"r0": _state(scenes=["a"]), "r1": _state()}
+    heat = _heat(a=3.0, b=0.1)
+    p1 = planner.plan(dict(states), dict(heat))
+    p2 = planner.plan(dict(states), dict(heat))
+    # identical inputs: identical assignment AND no version bump
+    assert p2.assignments == p1.assignments
+    assert p2.moves == p1.moves
+    assert p2.version == p1.version
+    assert planner.n_version_bumps == 1
+    # a changed input (the hot scene cools) changes the assignment and
+    # bumps exactly once
+    p3 = planner.plan(states, _heat(a=0.0, b=0.1))
+    assert p3.assignments != p1.assignments
+    assert p3.version == p1.version + 1
+
+
+def test_only_catalog_scenes_are_planned():
+    planner = _planner(FakeCatalog("a"))
+    plan = planner.plan({"r0": _state(scenes=["a", "ghost"])},
+                        _heat(a=0.2, phantom=9.0))
+    # "ghost" (resident but uncataloged) and "phantom" (heat with no
+    # record) have nothing to prefetch from: unplannable
+    assert set(plan.assignments) == {"a"}
+
+
+# -- planner: budget packing -------------------------------------------------
+
+
+def test_budget_packing_never_overfills_a_replica():
+    planner = _planner(FakeCatalog("a", "b", "c", "d", "e"),
+                       scene_bytes_fn=lambda sid: 100)
+    states = {"r0": _state(hbm_budget=250), "r1": _state(hbm_budget=250)}
+    plan = planner.plan(states, _heat(a=0.1, b=0.1, c=0.1, d=0.1, e=0.1))
+    packed = {"r0": 0, "r1": 0}
+    for sid, rids in plan.assignments.items():
+        for r in rids:
+            packed[r] += 100
+    assert all(v <= 250 for v in packed.values())
+    # two fit per replica; the fifth scene fits nowhere and stays
+    # unassigned — the router falls back to passive dispatch for it
+    assert len(plan.assignments) == 4
+    assert planner.planned_replicas("e") == ()
+
+
+def test_ladder_tiers_pool_into_one_planning_budget():
+    planner = _planner(FakeCatalog("a", "b"),
+                       scene_bytes_fn=lambda sid: 100)
+    # 60 HBM + 60 staging = 120 pooled: one scene fits, two don't
+    plan = planner.plan({"r0": _state(hbm_budget=60, staging_budget=60)},
+                        _heat(a=0.2, b=0.1))
+    assert plan.assignments == {"a": ("r0",)}
+
+
+# -- planner: heat-driven width ----------------------------------------------
+
+
+def test_heat_drives_replication_width():
+    states = {f"r{i}": _state() for i in range(4)}
+    cold = _planner(FakeCatalog("a"), max_width=3).plan(
+        dict(states), _heat(a=0.5))
+    assert len(cold.assignments["a"]) == 1  # below hot_rps: single holder
+    hot = _planner(FakeCatalog("a"), max_width=3).plan(
+        dict(states), _heat(a=1.0))
+    assert len(hot.assignments["a"]) == 2  # at hot_rps: hot_width
+    hotter = _planner(FakeCatalog("a"), max_width=3).plan(
+        dict(states), _heat(a=3.0))
+    assert len(hotter.assignments["a"]) == 3  # +1 per width_rps of heat
+    capped = _planner(FakeCatalog("a"), max_width=3).plan(
+        dict(states), _heat(a=99.0))
+    assert len(capped.assignments["a"]) == 3  # max_width caps the fan-out
+
+
+def test_width_never_exceeds_the_fleet():
+    plan = _planner(FakeCatalog("a"), max_width=8).plan(
+        {"r0": _state(), "r1": _state()}, _heat(a=50.0))
+    assert len(plan.assignments["a"]) == 2
+
+
+# -- planner: move ordering --------------------------------------------------
+
+
+def test_moves_order_publish_then_prefetch_then_demote():
+    planner = _planner(FakeCatalog("a"))
+    planner.note_publish("a")
+    plan = planner.plan({"r0": _state(scenes=["a", "stale"]),
+                         "r1": _state()}, _heat(a=2.0))
+    kinds = [m.kind for m in plan.moves]
+    # hot "a" widens onto r1; uncataloged "stale" leaves r0; the publish
+    # lands first and every new copy lands before any old one is demoted
+    assert kinds == sorted(kinds, key=("publish", "prefetch",
+                                       "demote").index)
+    assert ("prefetch", "a", "r1") in [(m.kind, m.scene, m.replica)
+                                       for m in plan.moves]
+    demotes = [m for m in plan.moves if m.kind == "demote"]
+    assert [(m.scene, m.replica) for m in demotes] == [("stale", "r0")]
+    assert max(i for i, m in enumerate(plan.moves)
+               if m.kind == "prefetch") < plan.moves.index(demotes[0])
+
+
+def test_prefetch_moves_are_hottest_first():
+    plan = _planner(FakeCatalog("a", "b")).plan(
+        {"r0": _state()}, _heat(a=0.2, b=0.9))
+    prefetches = [m.scene for m in plan.moves if m.kind == "prefetch"]
+    assert prefetches == ["b", "a"]  # b is hotter: its copy lands first
+
+
+def test_executor_lazy_skips_remote_replicas_and_converges():
+    clock = FakeClock()
+    planner = _planner(FakeCatalog("a"))
+    planner.clock = clock
+    plan = planner.plan({"r0": _state()}, _heat(a=2.0))
+    assert not plan.converged
+    clock.advance(3.0)
+    out = PlacementExecutor().execute(planner)  # no residency_of: remote
+    assert out == {"applied": 0, "failed": 0,
+                   "skipped": len(plan.moves), "remaining": 0}
+    assert planner.stats()["n_convergences"] == 1
+    assert planner.stats()["convergence_s_last"] == pytest.approx(3.0)
+
+
+def test_executor_counts_pinned_demote_as_failed_move():
+    class PinnedLadder:
+        def prefetch(self, sid):
+            return True
+
+        def evict(self, sid):
+            return False  # the lease is pinned: evict() REFUSES
+
+    planner = _planner(FakeCatalog("a"))
+    planner.plan({"r0": _state(scenes=["stale", "a"])}, _heat(a=0.2))
+    executor = PlacementExecutor(residency_of=lambda rid: PinnedLadder())
+    out = executor.execute(planner)
+    assert out["failed"] == 1  # the refused demote, counted not forced
+    assert planner.stats()["n_failed_moves"] == 1
+
+
+# -- router: plan consult ----------------------------------------------------
+
+
+def test_router_plan_beats_passive_affinity():
+    clock = FakeClock()
+    holder = FakeReplica("r0", load=0, scenes=["lego"])
+    other = FakeReplica("r1", load=5)
+    router = _router(clock, holder, other)
+    assert router.pick("lego") is holder  # passive: affinity wins
+    planner = _planner(FakeCatalog("lego"), scene_bytes_fn=lambda sid: 100)
+    router.set_planner(planner)
+    # the plan moves lego off the over-budget holder; the router follows
+    planner.plan({"r0": _state(scenes=["lego"], hbm_budget=1),
+                  "r1": _state(hbm_budget=1000)}, _heat(lego=0.2))
+    assert planner.current.assignments == {"lego": ("r1",)}
+    assert router.pick("lego") is other
+    router.submit(None, 2.0, 6.0, scene="lego")
+    router.submit(None, 2.0, 6.0, scene="ship")  # no plan entry: passive
+    assert router.n_planned_hits == 1
+    assert router.n_unplanned == 1
+
+
+def test_empty_or_disabled_plan_is_bitwise_passive_dispatch():
+    def fleet():
+        return (FakeReplica("r0", load=2, scenes=["lego"]),
+                FakeReplica("r1", load=0),
+                FakeReplica("r2", load=0, scenes=["ship"]))
+
+    def order(router, scene):
+        return [c[:3] for c in router._candidates(scene)]
+
+    clock = FakeClock()
+    bare = _router(clock, *fleet())
+    no_plan = _router(clock, *fleet())
+    no_plan.set_planner(_planner(FakeCatalog("lego", "ship")))
+    disabled = _router(clock, *fleet())
+    off = PlacementPlanner(FakeCatalog("lego", "ship"),
+                           options=PlacementOptions(enabled=False))
+    off.plan({"r0": _state(scenes=["lego"])}, _heat(lego=9.0))
+    disabled.set_planner(off)
+    empty = _router(clock, *fleet())
+    hollow = _planner(FakeCatalog())
+    hollow.plan({"r0": _state()}, {})  # plans, but assigns nothing
+    empty.set_planner(hollow)
+    for scene in (None, "lego", "ship", "unknown"):
+        want = order(bare, scene)
+        assert order(no_plan, scene) == want
+        assert order(disabled, scene) == want
+        assert order(empty, scene) == want
+
+
+def test_planned_candidates_keep_passive_order_within_group():
+    clock = FakeClock()
+    a = FakeReplica("a", load=9, scenes=["lego"])
+    b = FakeReplica("b", load=0)
+    c = FakeReplica("c", load=0)
+    router = _router(clock, a, b, c)
+    planner = _planner(FakeCatalog("lego"))
+    planner.current = PlacementPlan(version=1,
+                                    assignments={"lego": ("a", "c")})
+    router.set_planner(planner)
+    # planned group first (affinity beats load within it: a before c),
+    # unplanned group keeps its passive order behind them
+    assert [r.replica_id for *_, r in router._candidates("lego")] \
+        == ["a", "c", "b"]
+
+
+# -- router: capability filter -----------------------------------------------
+
+
+def test_capability_mismatch_is_a_filter_not_a_failover():
+    clock = FakeClock()
+    pose_only = PoseOnlyReplica("http0")
+    router = _router(clock, pose_only)
+    with pytest.raises(NoCapableReplicaError):
+        router.submit(None, 2.0, 6.0)  # ray submit vs a pose-only fleet
+    assert pose_only.state == ReplicaState.READY  # healthy, just filtered
+    assert router.n_failovers == 0
+
+
+def test_capable_replica_is_picked_over_filtered_one():
+    clock = FakeClock()
+    pose_only = PoseOnlyReplica("http0", load=0)
+    universal = FakeReplica("ray0", load=9)
+    router = _router(clock, pose_only, universal)
+    router.submit(None, 2.0, 6.0)
+    assert universal.submits == [None]  # the idle pose replica never saw it
+
+
+# -- supervisor: plan lifecycle ----------------------------------------------
+
+
+def _placement_supervisor(clock, heat):
+    popt = PlacementOptions(enabled=True, hot_width=2, max_width=4,
+                            hot_rps=0.5, width_rps=2.0,
+                            replan_every_s=1e9, max_moves_per_step=8)
+    planner = PlacementPlanner(FakeCatalog("lego"), options=popt,
+                               heat_fn=lambda: {"scenes": heat},
+                               clock=clock)
+    router = Router(heartbeat_timeout_s=10.0, clock=clock)
+    spawned = []
+
+    def spawn_fn(i):
+        r = FakeReplica(f"s{i}", scenes=["lego"] if i == 0 else [])
+        spawned.append(r)
+        return r
+
+    sup = Supervisor(router, spawn_fn,
+                     options=ScaleOptions(min_replicas=2, max_replicas=3,
+                                          placement=popt),
+                     clock=clock, planner=planner,
+                     placement_executor=PlacementExecutor())
+    sup.ensure_min()
+    router.sweep()
+    return sup, router, planner, spawned
+
+
+def test_supervisor_replans_on_replica_death():
+    clock = FakeClock()
+    heat = _heat(lego=5.0)
+    sup, router, planner, spawned = _placement_supervisor(clock, heat)
+    sup.step(1.0, 0.0)  # first healthy step: the boot plan
+    assert planner.current.assignments["lego"] == ("s0", "s1")
+    v_before = planner.current.version
+    spawned[0].kill()
+    assert sup.replace_dead() == 1
+    plan = planner.current
+    # the death triggered an immediate replan (no cadence wait): the
+    # corpse is out of every assignment, the replacement is in
+    assert plan.reason == "replace"
+    assert plan.version == v_before + 1
+    assert all("s0" not in rids for rids in plan.assignments.values())
+    assert plan.assignments["lego"] == ("s1", "s2")
+    assert sup.n_replaced == 1
+
+
+def test_supervisor_replans_on_pending_publish():
+    clock = FakeClock()
+    sup, router, planner, _ = _placement_supervisor(clock, _heat(lego=5.0))
+    sup.step(1.0, 0.0)
+    sup.note_publish("lego")
+    sup.step(1.0, 0.0)  # cadence is 1e9 s away: only the publish triggers
+    assert planner.current.reason == "publish"
+    assert [m.kind for m in planner.current.moves][:2] \
+        == ["publish", "publish"]  # one per assigned replica, first
+
+
+def test_placement_rows_validate_against_the_schema():
+    rows = []
+    clock = FakeClock()
+    planner = _planner(FakeCatalog("a"))
+    planner.clock = clock
+    plan = planner.plan({"r0": _state()}, _heat(a=2.0),
+                        dispatch_counters={"planned_hits": 3,
+                                           "unplanned": 1})
+    for move in plan.moves:
+        planner.note_move(move, True, "", skipped=True)
+    base = {"v": 1, "t": 0.0}
+    rows.append({**base, "kind": "placement_plan", "version": plan.version,
+                 "reason": plan.reason, "n_scenes": len(plan.assignments),
+                 "n_replicas": 1, "n_moves": len(plan.moves),
+                 "moves_by_kind": plan.moves_by_kind(), "converged": False,
+                 "planned_hits": 3, "unplanned": 1,
+                 "evidence": {"scene_heat": plan.scene_heat}})
+    for m in plan.moves:
+        rows.append({**base, "kind": "placement_move",
+                     "version": plan.version, "move": m.kind,
+                     "scene": m.scene, "replica": m.replica, "ok": True})
+    for row in rows:
+        assert validate_row(row) == [], row
+
+
+def test_merge_heat_sums_rates_across_ledger_views():
+    merged = merge_heat(
+        {"scenes": {"a": {"requests_per_s": 1.0, "rays_per_s": 10.0}}},
+        {"a": {"requests_per_s": 2.0}, "b": {"requests_per_s": 0.5}},
+        None,
+    )
+    assert merged["a"]["requests_per_s"] == pytest.approx(3.0)
+    assert merged["a"]["rays_per_s"] == pytest.approx(10.0)
+    assert merged["b"]["requests_per_s"] == pytest.approx(0.5)
+
+
+# -- the real thing: two serve.py children ------------------------------------
+
+
+@pytest.mark.slow
+def test_launcher_spawns_two_real_children_ready_drain_exit(tmp_path):
+    """spawn -> ready -> render -> drain -> exit on REAL serve.py
+    children (the ProcessLauncher contract end-to-end). Slow: two child
+    engine boots; excluded from tier-1."""
+    import yaml
+
+    from nerf_replication_tpu.datasets.procedural import generate_scene
+    from nerf_replication_tpu.scale import ProcessLauncher
+
+    scene_root = str(tmp_path / "scene")
+    generate_scene(scene_root, scene="procedural", H=16, W=16,
+                   n_train=2, n_test=1)
+    doc = {
+        "parent_cfg": os.path.join(_REPO, "configs", "nerf", "lego.yaml"),
+        "task": "run",
+        "scene": "procedural",
+        "exp_name": "launcher_test",
+        "train_dataset": {"data_root": scene_root, "H": 16, "W": 16},
+        "test_dataset": {"data_root": scene_root, "H": 16, "W": 16},
+        "task_arg": {"N_samples": 24, "N_importance": 24,
+                     "render_step_size": 0.25, "max_march_samples": 16,
+                     "march_chunk_size": 64},
+        "network": {"nerf": {"W": 64, "D": 3, "skips": [1]},
+                    "xyz_encoder": {"freq": 6},
+                    "dir_encoder": {"freq": 2}},
+        "serve": {"buckets": [256], "max_batch_rays": 256,
+                  "max_delay_ms": 5.0, "request_timeout_s": 30.0},
+        "record_dir": str(tmp_path / "record"),
+        # one shared artifact dir: child 0 pays the compile, child 1
+        # must warm from its serialized executables
+        "compile": {"aot": True, "artifacts": True,
+                    "dir": str(tmp_path / "aot")},
+        "obs": {"trace": False, "alerts": {"enabled": False}},
+    }
+    cfg_path = str(tmp_path / "serve_cfg.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(doc, f, sort_keys=True)
+    launcher = ProcessLauncher(cfg_path, env={"JAX_PLATFORMS": "cpu"},
+                               ready_timeout_s=600.0)
+    try:
+        r0 = launcher(0)
+        r1 = launcher(1)
+        assert r0.port != r1.port  # two live sockets, two processes
+        b0, b1 = r0.heartbeat(), r1.heartbeat()
+        assert b0["ok"] and b1["ok"]
+        assert r0.state == ReplicaState.READY
+        assert b1["warm_source"] == "disk"  # second child: zero builds
+        assert b1["total_compiles"] == 0
+        out = r0.render({"theta": 30.0, "phi": -30.0, "radius": 4.0},
+                        timeout_s=120.0)
+        assert out["h"] > 0 and out["rgb_b64"]
+        assert launcher.retire(r1, timeout_s=60.0) == 0  # clean drain
+        assert r1.state == ReplicaState.RETIRED
+        assert r1.proc.poll() is not None  # the child actually exited
+        assert launcher.stats()["n_spawned"] == 2
+    finally:
+        launcher.shutdown()

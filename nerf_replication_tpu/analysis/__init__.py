@@ -6,6 +6,11 @@ at runtime; this package catches them at review time. An AST engine
 donate, rng, side-effect, config-key, aot) over the package and entrypoints,
 gated through a committed baseline of accepted legacy findings
 (``baseline``, ``graftlint_baseline.json``) so only NEW hazards fail.
+PR 18 extends the engine interprocedurally: ``concurrency`` builds a
+module-spanning lock model + call graph and runs four more rules
+(lock-order, unguarded-shared, blocking-under-lock, thread-hygiene),
+paired with a runtime :class:`LockOrderRecorder` whose per-thread
+acquisition DAG is the dynamic witness for what R10 claims statically.
 ``scripts/graftlint.py`` is the CLI; tier-1 runs it via
 tests/test_analysis.py. The engine is jax-free by design — only the
 runtime ``sanitizer`` imports jax, lazily.
@@ -24,6 +29,7 @@ from .baseline import (
     validate_baseline_data,
 )
 from .core import (
+    CONCURRENCY_RULE_IDS,
     DEFAULT_SCAN,
     RULE_IDS,
     RULES,
@@ -32,12 +38,21 @@ from .core import (
     lint_source,
 )
 from .reporters import render_json, render_text, rule_counts
-from .sanitizer import SanitizerError, SanitizerProbe, sanitizer
+from .sanitizer import (
+    LockOrderError,
+    LockOrderRecorder,
+    SanitizerError,
+    SanitizerProbe,
+    sanitizer,
+)
 
 __all__ = [
     "BASELINE_FILENAME",
+    "CONCURRENCY_RULE_IDS",
     "DEFAULT_SCAN",
     "Finding",
+    "LockOrderError",
+    "LockOrderRecorder",
     "RULES",
     "RULE_IDS",
     "SanitizerError",

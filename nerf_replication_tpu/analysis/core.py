@@ -40,18 +40,25 @@ from dataclasses import dataclass, field
 # rule id -> one-line description (registry filled by rules.py import)
 RULES: dict[str, "Rule"] = {}
 
-# R1..R8 short names used in findings, suppressions, and the baseline
+# R1..R13 short names used in findings, suppressions, and the baseline
 RULE_IDS = (
-    "host-sync",    # R1
-    "retrace",      # R2
-    "donate",       # R3
-    "rng",          # R4
-    "side-effect",  # R5
-    "config-key",   # R6
-    "aot",          # R7
-    "swallow",      # R8
-    "emit-hot",     # R9
+    "host-sync",           # R1
+    "retrace",             # R2
+    "donate",              # R3
+    "rng",                 # R4
+    "side-effect",         # R5
+    "config-key",          # R6
+    "aot",                 # R7
+    "swallow",             # R8
+    "emit-hot",            # R9
+    "lock-order",          # R10
+    "unguarded-shared",    # R11
+    "blocking-under-lock", # R12
+    "thread-hygiene",      # R13
 )
+
+# the interprocedural concurrency pass (R10-R13, concurrency.py)
+CONCURRENCY_RULE_IDS = RULE_IDS[9:]
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ok(?:\(([^)]*)\))?")
 _HOT_RE = re.compile(r"#\s*graftlint:\s*hot\b")
@@ -462,10 +469,13 @@ def lint_paths(
     repo_root: str | None = None,
     rules: tuple[str, ...] | None = None,
     config_keys: set[tuple[str, ...]] | None = None,
+    timings: dict | None = None,
 ) -> tuple[list[Finding], list[str]]:
     """Lint files/dirs. Returns ``(findings, errors)`` — errors are files
     that failed to parse (reported, not fatal: a lint gate must not die on
-    one syntax error in an unrelated script)."""
+    one syntax error in an unrelated script). ``timings``, when passed,
+    is filled with per-rule wall seconds (the CLI's --format json and
+    lint_run telemetry surface)."""
     modules: list[ModuleContext] = []
     errors: list[str] = []
     for f in iter_py_files(paths):
@@ -481,27 +491,42 @@ def lint_paths(
 
         config_keys = collect_config_keys(repo_root)
     project = ProjectContext(modules, repo_root, config_keys=config_keys)
-    return _run_rules(project, rules), errors
+    return _run_rules(project, rules, timings=timings), errors
 
 
 def _run_rules(
-    project: ProjectContext, rules: tuple[str, ...] | None
+    project: ProjectContext, rules: tuple[str, ...] | None,
+    timings: dict | None = None,
 ) -> list[Finding]:
+    import time
+
+    from . import concurrency as _conc  # noqa: F401  (populates RULES)
     from . import rules as _rules  # noqa: F401  (populates RULES)
 
     active = [
         r for rid, r in RULES.items() if rules is None or rid in rules
     ]
+    if timings is None:
+        timings = {}
     findings: list[Finding] = []
     for module in project.modules:
         if module.skip_file:
             continue
         for rule in active:
             if not rule.project_wide:
+                t0 = time.perf_counter()
                 findings.extend(rule.check(module))
+                timings[rule.rule_id] = (
+                    timings.get(rule.rule_id, 0.0)
+                    + time.perf_counter() - t0
+                )
     for rule in active:
         if rule.project_wide:
+            t0 = time.perf_counter()
             findings.extend(rule.check_project(project))
+            timings[rule.rule_id] = (
+                timings.get(rule.rule_id, 0.0) + time.perf_counter() - t0
+            )
     # nested loops / overlapping walks can surface the same hazard twice
     findings = list(dict.fromkeys(findings))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -47,6 +47,7 @@ def render_json(
     n_fixed: int = 0,
     errors: list[str] | None = None,
     duration_s: float | None = None,
+    rule_times_s: dict | None = None,
 ) -> str:
     def row(f: Finding) -> dict:
         return {
@@ -72,6 +73,9 @@ def render_json(
             "new_rule_counts": rule_counts(new),
             "errors": list(errors or ()),
             "duration_s": duration_s,
+            "rule_times_s": {
+                r: round(t, 4) for r, t in (rule_times_s or {}).items()
+            },
         },
         indent=1,
         sort_keys=True,

@@ -38,7 +38,6 @@ from __future__ import annotations
 import time
 from collections import OrderedDict
 
-from ..obs import get_emitter
 from .residency import ResidencyManager, SceneData
 
 
@@ -80,10 +79,10 @@ class TieredResidencyManager(ResidencyManager):
         with self._cond:
             self._sweep_staging_locked(time.monotonic())
             staged = self._staging.get(scene_id)
-            if staged is None:
-                return None
-            self._staging.move_to_end(scene_id)
-            return staged.data
+            if staged is not None:
+                self._staging.move_to_end(scene_id)
+        self._flush_rows()  # TTL sweep may have queued evict rows
+        return staged.data if staged is not None else None
 
     def _note_load(self, source: str) -> None:
         # commit-time accounting (base hook, under the lock): lookups
@@ -139,7 +138,7 @@ class TieredResidencyManager(ResidencyManager):
             self.ttl_evictions += 1
         elif reason == "manual":
             self.manual_evictions += 1
-        get_emitter().emit(
+        self._queue_row(
             "scene_evict", scene=scene_id, bytes=staged.nbytes,
             reason=reason, tier="staging",
             resident=len(self._resident),
@@ -176,7 +175,7 @@ class TieredResidencyManager(ResidencyManager):
                     self.evictions += 1
                     self.ttl_evictions += 1
                     self.bytes_evicted += victim.data.nbytes
-                    get_emitter().emit(
+                    self._queue_row(
                         "scene_evict", scene=sid, bytes=victim.data.nbytes,
                         reason="ttl", tier="hbm",
                         resident=len(self._resident),
@@ -192,6 +191,7 @@ class TieredResidencyManager(ResidencyManager):
                     self._evict_staged_locked(sid, "ttl")
             out["staging"] = self.staging_evictions - before
             self._cond.notify_all()
+        self._flush_rows()
         return out
 
     def evict(self, scene_id: str, *, drop_staged: bool = False) -> bool:
@@ -207,7 +207,7 @@ class TieredResidencyManager(ResidencyManager):
                 self.evictions += 1
                 self.manual_evictions += 1
                 self.bytes_evicted += resident.data.nbytes
-                get_emitter().emit(
+                self._queue_row(
                     "scene_evict", scene=scene_id,
                     bytes=resident.data.nbytes, reason="manual", tier="hbm",
                     resident=len(self._resident),
@@ -217,7 +217,8 @@ class TieredResidencyManager(ResidencyManager):
             if drop_staged and scene_id in self._staging:
                 self._evict_staged_locked(scene_id, "manual")
             self._cond.notify_all()
-            return True
+        self._flush_rows()
+        return True
 
     # -- introspection --------------------------------------------------------
 

@@ -173,6 +173,8 @@ class ScenePublisher:
             finally:
                 res._publishing.discard(sid)
                 res._cond.notify_all()
+        # the staging write-through queues its evict rows under the lock
+        res._flush_rows()
 
         with self._lock:
             self._versions[sid] = to_version

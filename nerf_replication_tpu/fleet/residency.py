@@ -135,6 +135,10 @@ class ResidencyManager:
         # refcount drain barrier cannot be starved by fresh pins
         self._publishing: set[str] = set()
         self._pose_caches: dict[str, PoseCache] = {}
+        # telemetry rows queued under the lock, emitted after release —
+        # the emitter writes a file, and every waiter on the condition
+        # would pay that write (graftlint R12 blocking-under-lock)
+        self._pending_rows: list[tuple[str, dict]] = []
         # counters (read via stats(); mutated under the lock)
         self.loads = 0
         self.cold_loads = 0
@@ -337,6 +341,8 @@ class ResidencyManager:
             self._stage_host(scene_id, host, nbytes)
             n_res, res_bytes = len(self._resident), self._resident_bytes()
             tier_fields = self._tier_fields()
+        # staging write-through may have queued evict rows under the lock
+        self._flush_rows()
         get_emitter().emit(
             "scene_load", scene=scene_id, bytes=nbytes, source=source,
             load_s=round(time.perf_counter() - t0, 4),
@@ -379,46 +385,68 @@ class ResidencyManager:
     def _resident_bytes(self) -> int:
         return sum(r.data.nbytes for r in self._resident.values())
 
+    # -- deferred telemetry ----------------------------------------------------
+
+    def _queue_row(self, kind: str, **fields) -> None:
+        """Queue a telemetry row from inside a critical section; the
+        emit (a file write) happens at the next ``_flush_rows()``."""
+        self._pending_rows.append((kind, fields))
+
+    def _flush_rows(self) -> None:
+        """Emit everything queued. Call with the lock NOT held."""
+        with self._cond:
+            pending, self._pending_rows = self._pending_rows, []
+        emitter = get_emitter()
+        for kind, fields in pending:
+            emitter.emit(kind, **fields)
+
     def _admit(self, scene_id: str, nbytes: int) -> None:
         """Reserve ``nbytes`` of budget, evicting cold LRU scenes first.
 
         Eviction happens BEFORE the h2d transfer so the budget is never
         transiently over-committed; pinned scenes are skipped, and if
         nothing evictable remains the admission fails."""
-        with self._cond:
-            while (self._resident_bytes() + self._reserved + nbytes
-                   > self.budget_bytes):
-                victim_id = next(
-                    (sid for sid, r in self._resident.items()
-                     if r.refcount == 0),
-                    None,
-                )
-                if victim_id is None:
-                    if self._reserved > 0:
-                        # a concurrent load holds the missing bytes; once
-                        # it commits (or fails) its scene is evictable
-                        # (or its reservation returns) — wait, don't fail
-                        self._cond.wait(timeout=0.1)
-                        continue
-                    self.overloads += 1
-                    raise ResidencyOverloadError(
-                        scene_id,
-                        f"cannot admit scene {scene_id!r} ({nbytes} bytes): "
-                        f"all {len(self._resident)} resident scenes are "
-                        "pinned by in-flight batches",
+        try:
+            with self._cond:
+                while (self._resident_bytes() + self._reserved + nbytes
+                       > self.budget_bytes):
+                    victim_id = next(
+                        (sid for sid, r in self._resident.items()
+                         if r.refcount == 0),
+                        None,
                     )
-                victim = self._resident.pop(victim_id)
-                reason = self._retire(victim_id, victim)
-                self.evictions += 1
-                self.bytes_evicted += victim.data.nbytes
-                n_res, res_bytes = len(self._resident), self._resident_bytes()
-                get_emitter().emit(
-                    "scene_evict", scene=victim_id,
-                    bytes=victim.data.nbytes, reason=reason,
-                    resident=n_res, resident_bytes=res_bytes,
-                    **self._tier_fields(),
-                )
-            self._reserved += nbytes
+                    if victim_id is None:
+                        if self._reserved > 0:
+                            # a concurrent load holds the missing bytes;
+                            # once it commits (or fails) its scene is
+                            # evictable (or its reservation returns) —
+                            # wait, don't fail
+                            self._cond.wait(timeout=0.1)
+                            continue
+                        self.overloads += 1
+                        raise ResidencyOverloadError(
+                            scene_id,
+                            f"cannot admit scene {scene_id!r} "
+                            f"({nbytes} bytes): all "
+                            f"{len(self._resident)} resident scenes are "
+                            "pinned by in-flight batches",
+                        )
+                    victim = self._resident.pop(victim_id)
+                    reason = self._retire(victim_id, victim)
+                    self.evictions += 1
+                    self.bytes_evicted += victim.data.nbytes
+                    n_res = len(self._resident)
+                    self._queue_row(
+                        "scene_evict", scene=victim_id,
+                        bytes=victim.data.nbytes, reason=reason,
+                        resident=n_res,
+                        resident_bytes=self._resident_bytes(),
+                        **self._tier_fields(),
+                    )
+                self._reserved += nbytes
+        finally:
+            # queued evict rows land even when admission fails
+            self._flush_rows()
 
     # -- residency-tier hooks (overridden by fleet/ladder.py) -----------------
 

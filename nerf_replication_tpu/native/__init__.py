@@ -48,6 +48,7 @@ def _compile() -> str | None:
         _SRC, "-o", tmp,
     ]
     try:
+        # graftlint: ok(blocking-under-lock: the module lock exists to make g++ invocations process-wide single-flight; first-touch only, cached .so afterwards)
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
             return None

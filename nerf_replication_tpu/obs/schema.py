@@ -340,7 +340,17 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"n_findings": _NUM, "n_new": _NUM, "n_baselined": _NUM,
          "duration_s": _NUM},
         {"rule_counts": (dict,), "n_files": _NUM, "exit_code": _NUM,
-         "baseline_path": (str,)},
+         "baseline_path": (str,), "rule_times_s": (dict,),
+         "new_rule_counts": (dict,)},
+    ),
+    # one per runtime lock-order sanitizer teardown (analysis/sanitizer.py
+    # LockOrderRecorder.emit): the observed per-thread acquisition DAG over
+    # the instrumented fleet locks — acyclic=False carries the cycle the
+    # static R10 rule would have had to prove
+    "lock_order": (
+        {"n_locks": _NUM, "n_edges": _NUM, "acyclic": (bool,)},
+        {"n_threads": _NUM, "cycle": (list,), "locks": (list,),
+         "source": (str,)},
     ),
 }
 
@@ -400,6 +410,13 @@ def validate_row(row) -> list[str]:
                           "in publish|prefetch|demote")
     elif kind == "placement_plan" and isinstance(row.get("evidence"), dict):
         errors += _validate_placement_evidence(row["evidence"])
+    elif kind == "lock_order":
+        if row.get("acyclic") is False and not row.get("cycle"):
+            errors.append(
+                "lock_order: acyclic=false must name the observed cycle")
+        if row.get("acyclic") is True and row.get("cycle"):
+            errors.append(
+                "lock_order: acyclic=true contradicts a non-empty cycle")
     return errors
 
 

@@ -50,6 +50,11 @@ class CircuitBreaker:
         self._consecutive = 0
         self._failures = 0
         self._opens = 0
+        # transition side effects (telemetry row + flight dump) queued
+        # under the lock, performed after it is released — the emitter
+        # writes a file and dump_flight walks the whole recorder ring;
+        # neither belongs inside the breaker's critical section
+        self._pending: list[tuple[str, int, int, float]] = []
 
     @classmethod
     def from_cfg(cls, cfg, clock=time.monotonic,
@@ -74,39 +79,54 @@ class CircuitBreaker:
         return self._state
 
     def _transition(self, state: str) -> None:
+        """Mutate state and queue the side effects; callers hold the lock
+        and ``_flush()`` after releasing it."""
         self._state = state
-        get_emitter().emit(
-            "breaker", state=state, point=self.point,
-            failures=self._failures, consecutive=self._consecutive,
-            retry_after_s=self.retry_after_s(locked=True),
-        )
-        get_metrics().counter("serve_breaker_transitions_total", state=state)
-        if state == "open":
-            # post-mortem snapshot at the moment the dispatch path was
-            # declared dead; the recorder has its own lock, never this one
-            dump_flight(
-                "breaker_open",
-                detail=f"point={self.point} failures={self._failures} "
-                       f"consecutive={self._consecutive}",
+        self._pending.append((state, self._failures, self._consecutive,
+                              self._retry_after_locked()))
+
+    def _flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for state, failures, consecutive, retry_after in pending:
+            get_emitter().emit(
+                "breaker", state=state, point=self.point,
+                failures=failures, consecutive=consecutive,
+                retry_after_s=retry_after,
             )
+            get_metrics().counter(
+                "serve_breaker_transitions_total", state=state)
+            if state == "open":
+                # post-mortem snapshot at the moment the dispatch path was
+                # declared dead; the recorder has its own lock, never ours
+                dump_flight(
+                    "breaker_open",
+                    detail=f"point={self.point} failures={failures} "
+                           f"consecutive={consecutive}",
+                )
 
     @property
     def state(self) -> str:
         with self._lock:
-            return self._tick()
+            state = self._tick()
+        self._flush()
+        return state
 
     def allow(self) -> bool:
         """May a new request enter? half_open allows (the probe)."""
         with self._lock:
-            return self._tick() != "open"
+            allowed = self._tick() != "open"
+        self._flush()
+        return allowed
 
-    def retry_after_s(self, locked: bool = False) -> float:
-        if not locked:
-            with self._lock:
-                return self.retry_after_s(locked=True)
+    def _retry_after_locked(self) -> float:
         if self._state != "open" or self._opened_at is None:
             return 0.0
         return max(0.0, self.cooldown_s - (self.clock() - self._opened_at))
+
+    def retry_after_s(self) -> float:
+        with self._lock:
+            return self._retry_after_locked()
 
     # -- outcomes ------------------------------------------------------------
 
@@ -121,6 +141,7 @@ class CircuitBreaker:
                 self._opened_at = self.clock()
                 self._opens += 1
                 self._transition("open")
+        self._flush()
 
     def record_success(self) -> None:
         with self._lock:
@@ -128,6 +149,7 @@ class CircuitBreaker:
             if self._tick() != "closed":
                 self._opened_at = None
                 self._transition("closed")
+        self._flush()
 
     # -- degradation coupling ------------------------------------------------
 
@@ -139,10 +161,12 @@ class CircuitBreaker:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            snap = {
                 "state": self._tick(),
                 "failures": self._failures,
                 "consecutive": self._consecutive,
                 "opens": self._opens,
-                "retry_after_s": round(self.retry_after_s(locked=True), 3),
+                "retry_after_s": round(self._retry_after_locked(), 3),
             }
+        self._flush()
+        return snap

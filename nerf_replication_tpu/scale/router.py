@@ -100,6 +100,14 @@ class Router:
         with self._lock:
             return [e.replica for e in self._entries.values()]
 
+    def _snapshot(self) -> list:
+        """Entries at a point in time: readers (sweep, candidate scans,
+        views) iterate the snapshot so a concurrent register/deregister
+        never mutates the dict under them — and slow replica probes
+        (heartbeat, load) run with the router lock NOT held."""
+        with self._lock:
+            return list(self._entries.values())
+
     def n_ready(self) -> int:
         return sum(
             1 for r in self.replicas()
@@ -112,7 +120,7 @@ class Router:
         queued work is already failing — the supervisor replaces it)."""
         now = self.clock()
         dead: list[str] = []
-        for entry in list(self._entries.values()):
+        for entry in self._snapshot():
             r = entry.replica
             if r.state in (ReplicaState.RETIRED, ReplicaState.DEAD):
                 continue
@@ -172,7 +180,7 @@ class Router:
         planned scene stably promotes its planned replicas to the
         front; an empty plan changes nothing."""
         out = []
-        for entry in self._entries.values():
+        for entry in self._snapshot():
             r = entry.replica
             if not r.accepting():
                 continue
@@ -201,12 +209,14 @@ class Router:
         cands = self._candidates(scene)
         if not cands:
             raise NoReplicaAvailableError(
-                f"no accepting replica among {len(self._entries)} registered"
+                f"no accepting replica among {len(self.replicas())} "
+                "registered"
             )
         return cands[0][3]
 
     def _no_replica(self, scene, need=None) -> NoReplicaAvailableError:
-        n_accepting = sum(1 for e in self._entries.values()
+        entries = self._snapshot()
+        n_accepting = sum(1 for e in entries
                           if e.replica.accepting())
         if need is not None and n_accepting:
             # accepting replicas exist but every one was capability-
@@ -227,7 +237,7 @@ class Router:
         get_metrics().counter("scale_router_events_total",
                               event="no_replica")
         return NoReplicaAvailableError(
-            f"no accepting replica among {len(self._entries)} registered"
+            f"no accepting replica among {len(entries)} registered"
         )
 
     def _record_failover(self, trs, replica, exc, n_left, scene,
@@ -358,7 +368,8 @@ class Router:
         failure count (the contract wants 0). The replica leaves the
         candidate set at the state flip inside ``drain`` — before any
         queued render — so no new work can race in."""
-        entry = self._entries.get(str(replica_id))
+        with self._lock:
+            entry = self._entries.get(str(replica_id))
         if entry is None:
             return 0
         load_before = 0
@@ -379,7 +390,7 @@ class Router:
         watermarks, ladder budgets; zeros for replicas whose beats
         predate the planner fields)."""
         out: dict[str, dict] = {}
-        for entry in self._entries.values():
+        for entry in self._snapshot():
             r = entry.replica
             if not r.accepting():
                 continue
@@ -399,7 +410,7 @@ class Router:
         """Per-replica queue depth from the last heartbeat round — the
         ``queue_depths`` half of a scale decision's evidence block."""
         out: dict[str, int] = {}
-        for entry in self._entries.values():
+        for entry in self._snapshot():
             load = entry.beat.get("load")
             if load is not None:
                 out[entry.replica.replica_id] = int(load)
@@ -407,14 +418,15 @@ class Router:
 
     def stats(self) -> dict:
         per = {}
-        for entry in self._entries.values():
+        entries = self._snapshot()
+        for entry in entries:
             per[entry.replica.replica_id] = {
                 "state": entry.replica.state,
                 "load": entry.beat.get("load"),
                 "warm_source": entry.beat.get("warm_source"),
             }
         return {
-            "n_registered": len(self._entries),
+            "n_registered": len(entries),
             "n_ready": self.n_ready(),
             "n_dispatches": self.n_dispatches,
             "n_affinity_hits": self.n_affinity_hits,

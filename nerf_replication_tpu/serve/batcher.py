@@ -124,6 +124,10 @@ class MicroBatcher:
         # order is start-time fair regardless of arrival order
         self._vtime: dict[str, float] = {}
         self._queue: deque[_Pending] = deque()
+        # the condition guards the queue/stop handshake ONLY; the
+        # counters below are worker-thread owned after start (read-only
+        # elsewhere) and deliberately not part of the critical section
+        # graftlint: guards(_queue, _stop, _vtime, _inflight)
         self._cond = threading.Condition()
         self._stop = False
         # counters (worker-thread owned after start; read-only elsewhere)
@@ -397,8 +401,10 @@ class MicroBatcher:
             with self._cond:
                 if self._stop and not self._queue:
                     return
-            if self.pump() == 0 and self._stop:
-                return
+            drained = self.pump() == 0
+            with self._cond:
+                if drained and self._stop:
+                    return
 
     # graftlint: hot
     def _render_batch(self, batch: list[_Pending], queue_depth: int) -> int:

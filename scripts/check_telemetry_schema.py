@@ -26,7 +26,10 @@ errors), and the ops-intelligence rows PR 16 added (``alert`` state/
 severity enums, ``incident`` lifecycle status, ``capacity_snapshot``
 per-replica ledger commits, and the placement rows this PR added —
 ``placement_plan`` rows' ``evidence.scene_heat`` block and
-``placement_move`` rows' move-kind enum). Every other JSONL is
+``placement_move`` rows' move-kind enum, plus the concurrency-analysis
+rows PR 18 added — ``lint_run`` rule-timing/new-count maps and
+``lock_order`` rows, whose ``acyclic`` flag must agree with the
+presence of a named ``cycle``). Every other JSONL is
 checked structurally against the known bench row families — so a bench
 script that drifts shape (the pre-PR-1 failure mode: three incompatible
 row families grew across ten scripts) fails here instead of silently

@@ -2,9 +2,17 @@
 
     python scripts/graftlint.py                      # full default scan
     python scripts/graftlint.py nerf_replication_tpu/serve
-    python scripts/graftlint.py --format json
+    python scripts/graftlint.py --format json        # incl. per-rule times
+    python scripts/graftlint.py --changed main       # only files in the diff
     python scripts/graftlint.py --write-baseline     # regenerate baseline
     python scripts/graftlint.py --no-baseline        # raw findings, no gate
+
+``--changed [BASE]`` lints only the ``.py`` files ``git diff --name-only
+BASE`` reports (default base ``HEAD``) — the inner-loop mode that keeps
+the interprocedural concurrency pass (rules R10-R13, which build a
+project-wide call graph) off the critical path of a one-file edit. The
+project-wide rules still see only the changed files in this mode, so the
+full scan (CI / tier-1) remains the authority on cross-module findings.
 
 Exit code is nonzero exactly when there are NEW findings — ones absent
 from the committed ``graftlint_baseline.json`` — so CI (tier-1's
@@ -46,7 +54,9 @@ DEFAULT_TELEMETRY = os.path.join("logs", "graftlint", "telemetry.jsonl")
 
 def emit_lint_run(path: str, *, n_findings: int, n_new: int, n_baselined: int,
                   duration_s: float, counts: dict, n_files: int,
-                  exit_code: int, baseline_path: str) -> None:
+                  exit_code: int, baseline_path: str,
+                  rule_times_s: dict | None = None,
+                  new_rule_counts: dict | None = None) -> None:
     from nerf_replication_tpu.obs.emit import Emitter
 
     emitter = Emitter(path, chief=True)
@@ -61,9 +71,41 @@ def emit_lint_run(path: str, *, n_findings: int, n_new: int, n_baselined: int,
             n_files=n_files,
             exit_code=exit_code,
             baseline_path=baseline_path,
+            rule_times_s={r: round(t, 4)
+                          for r, t in (rule_times_s or {}).items()},
+            new_rule_counts=dict(new_rule_counts or {}),
         )
     finally:
         emitter.close()
+
+
+def changed_paths(base: str, repo_root: str) -> list[str]:
+    """Absolute paths of changed ``.py`` files inside the default scan
+    set, per ``git diff --name-only --diff-filter=d BASE``."""
+    import subprocess
+
+    proc = subprocess.run(
+        ["git", "diff", "--name-only", "--diff-filter=d", base],
+        capture_output=True, text=True, cwd=repo_root, timeout=30,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {base} failed: {proc.stderr.strip()}"
+        )
+    scan_roots = tuple(
+        p if p.endswith(".py") else p + "/" for p in DEFAULT_SCAN
+    )
+    out = []
+    for rel in proc.stdout.splitlines():
+        rel = rel.strip()
+        if not rel.endswith(".py"):
+            continue
+        if not any(rel == r or rel.startswith(r) for r in scan_roots):
+            continue
+        path = os.path.join(repo_root, rel)
+        if os.path.exists(path):
+            out.append(path)
+    return out
 
 
 def main(argv=None) -> int:
@@ -97,15 +139,32 @@ def main(argv=None) -> int:
         help=f"lint_run telemetry sink (default: <repo>/{DEFAULT_TELEMETRY})",
     )
     p.add_argument("--no-telemetry", action="store_true")
+    p.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="BASE",
+        help="lint only changed .py files (git diff --name-only BASE; "
+             "BASE defaults to HEAD)",
+    )
     args = p.parse_args(argv)
 
+    if args.changed is not None and args.write_baseline:
+        p.error("--write-baseline needs a full scan; a --changed baseline "
+                "would silently drop every finding outside the diff")
+
     t0 = time.perf_counter()
-    scan = args.paths or [
-        os.path.join(_REPO, p) for p in DEFAULT_SCAN
-    ]
+    if args.changed is not None:
+        scan = changed_paths(args.changed, _REPO)
+        if not scan:
+            print(f"graftlint: no changed .py files vs {args.changed}")
+            return 0
+    else:
+        scan = args.paths or [
+            os.path.join(_REPO, p) for p in DEFAULT_SCAN
+        ]
     rules = tuple(r.strip() for r in args.rules.split(",")) if args.rules \
         else None
-    findings, errors = lint_paths(scan, repo_root=_REPO, rules=rules)
+    timings: dict[str, float] = {}
+    findings, errors = lint_paths(scan, repo_root=_REPO, rules=rules,
+                                  timings=timings)
 
     baseline_path = args.baseline or os.path.join(_REPO, BASELINE_FILENAME)
     if args.write_baseline:
@@ -119,12 +178,14 @@ def main(argv=None) -> int:
     if not args.no_baseline and os.path.exists(baseline_path):
         baseline = load_baseline(baseline_path)
     new, accepted, n_fixed = diff_baseline(findings, baseline)
+    if args.changed is not None:
+        n_fixed = 0  # a partial scan not observing an entry proves nothing
     duration = time.perf_counter() - t0
     exit_code = 1 if (new or errors) else 0
 
     if args.format == "json":
         print(render_json(new, accepted, n_fixed, errors=errors,
-                          duration_s=duration))
+                          duration_s=duration, rule_times_s=timings))
     else:
         print(render_text(new, accepted, n_fixed, errors=errors))
 
@@ -141,6 +202,8 @@ def main(argv=None) -> int:
                 n_files=len({f.path for f in findings}) if findings else 0,
                 exit_code=exit_code,
                 baseline_path=os.path.relpath(baseline_path, _REPO),
+                rule_times_s=timings,
+                new_rule_counts=rule_counts(new),
             )
         except OSError as e:  # telemetry must never break the gate
             print(f"warning: lint_run telemetry not written: {e}",

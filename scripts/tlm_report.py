@@ -642,6 +642,28 @@ def summarize(rows: list[dict]) -> dict:
         summary["lint_baselined"] = last.get("n_baselined")
         summary["lint_rule_counts"] = last.get("rule_counts") or {}
         summary["lint_duration_s"] = last.get("duration_s")
+        summary["lint_new_rule_counts"] = last.get("new_rule_counts") or {}
+        summary["lint_rule_times_s"] = last.get("rule_times_s") or {}
+        # the interprocedural concurrency rules (R10-R13) reported apart
+        # from the per-function tracing rules: a new lock-order or
+        # unguarded-shared finding is a deadlock/data-race candidate,
+        # not a style regression, and --diff gates on exactly those
+        conc = {"lock-order", "unguarded-shared", "blocking-under-lock",
+                "thread-hygiene"}
+        summary["lint_concurrency_new"] = {
+            r: n for r, n in summary["lint_new_rule_counts"].items()
+            if r in conc
+        }
+
+    # runtime lock-order sanitizer rows (analysis/sanitizer.py)
+    lock_rows = [r for r in rows if r.get("kind") == "lock_order"]
+    if lock_rows:
+        last = lock_rows[-1]
+        summary["lock_order_runs"] = len(lock_rows)
+        summary["lock_order_acyclic"] = bool(last.get("acyclic"))
+        summary["lock_order_edges"] = last.get("n_edges")
+        summary["lock_order_cycles"] = sum(
+            1 for r in lock_rows if r.get("acyclic") is False)
     return summary
 
 
@@ -882,9 +904,16 @@ def print_summary(summary: dict, label: str = "") -> None:
                   + f"  cold {v.get('cold_loads', 0)}"
                   f"/repromote {v.get('repromotions', 0)}")
     if summary.get("lint_runs"):
+        conc_rules = ("lock-order", "unguarded-shared",
+                      "blocking-under-lock", "thread-hygiene")
+        counts = summary["lint_rule_counts"] or {}
         rule_mix = " ".join(
-            f"{k}:{v}"
-            for k, v in sorted((summary["lint_rule_counts"] or {}).items())
+            f"{k}:{v}" for k, v in sorted(counts.items())
+            if k not in conc_rules
+        )
+        conc_mix = " ".join(
+            f"{k}:{v}" for k, v in sorted(counts.items())
+            if k in conc_rules
         )
         dur = summary.get("lint_duration_s")
         print(f"  graftlint:     {summary['lint_new']} new / "
@@ -892,6 +921,22 @@ def print_summary(summary: dict, label: str = "") -> None:
               f"({summary['lint_runs']} run(s)"
               + (f", last {dur:.2f}s" if dur is not None else "")
               + (f"; {rule_mix}" if rule_mix else "") + ")")
+        times = summary.get("lint_rule_times_s") or {}
+        conc_time = sum(times.get(r, 0.0) for r in conc_rules)
+        conc_new = summary.get("lint_concurrency_new") or {}
+        if conc_new:  # new hazards outrank the (baselined) mix
+            conc_mix = "NEW " + " ".join(
+                f"{k}:{v}" for k, v in sorted(conc_new.items()))
+        if conc_mix or conc_time:
+            print("  graftlint-conc:"
+                  + (f" {conc_mix}" if conc_mix else " clean")
+                  + (f" ({conc_time:.2f}s rule time)" if conc_time else ""))
+    if summary.get("lock_order_runs"):
+        print(f"  lock-order:    "
+              f"{'acyclic' if summary['lock_order_acyclic'] else 'CYCLE'} "
+              f"({summary.get('lock_order_edges', 0)} edge(s), "
+              f"{summary['lock_order_runs']} run(s), "
+              f"{summary.get('lock_order_cycles', 0)} with cycles)")
 
 
 def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
@@ -922,6 +967,20 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
     a, b = base.get("lint_new"), cand.get("lint_new")
     if a is not None and b is not None and b > a:
         flags.append(f"graftlint new findings grew {a} -> {b}")
+    # new lock-order / unguarded-shared findings gate unconditionally:
+    # each is a deadlock or data-race candidate, not a style drift
+    for rule in ("lock-order", "unguarded-shared"):
+        a = (base.get("lint_new_rule_counts") or {}).get(rule, 0)
+        b_counts = cand.get("lint_new_rule_counts")
+        b = (b_counts or {}).get(rule, 0)
+        if b_counts is not None and b > a:
+            flags.append(
+                f"new {rule} findings grew {a} -> {b} "
+                f"(concurrency hazard — fix, don't baseline)")
+    # a runtime lock-order cycle is an unconditional flag
+    if cand.get("lock_order_acyclic") is False:
+        flags.append("runtime lock-order sanitizer observed a cycle "
+                     "(see lock_order telemetry rows)")
     # an exhausted retry ladder means a load path gave up — a candidate
     # run growing these has faults the resil machinery no longer absorbs
     a = base.get("faults_unrecovered") or 0

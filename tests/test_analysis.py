@@ -25,8 +25,12 @@ _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, _REPO)
 
 from nerf_replication_tpu.analysis import (  # noqa: E402
+    CONCURRENCY_RULE_IDS,
     Finding,
+    LockOrderError,
+    LockOrderRecorder,
     diff_baseline,
+    lint_paths,
     lint_source,
     load_baseline,
     save_baseline,
@@ -751,3 +755,418 @@ def test_sanitizer_allow_compiles_budget():
         jax.block_until_ready(step(jnp.ones((4,))))  # first-call compile
     assert probe.compiles == 1
     assert probe.compile_names == {"san_budget": 1}
+
+
+# --------------------------------------------------------------------------
+# R10-R13 concurrency rules (PR 18) — the interprocedural pass
+# --------------------------------------------------------------------------
+
+_CONC_PATH = "nerf_replication_tpu/fx_conc.py"
+
+
+def lint_conc(src):
+    return lint_source(src, path=_CONC_PATH)
+
+
+_SELF_DEADLOCK = """
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+
+
+def test_lock_order_self_reacquire_flagged():
+    found = [f for f in lint_conc(_SELF_DEADLOCK) if f.rule == "lock-order"]
+    assert found, "non-reentrant self-reacquire must be a finding"
+    assert "Store._lock" in found[0].message
+
+
+def test_lock_order_rlock_reentrancy_is_clean():
+    src = _SELF_DEADLOCK.replace("threading.Lock()", "threading.RLock()")
+    assert "lock-order" not in _rules_of(lint_conc(src))
+
+
+def test_lock_order_three_lock_cycle_spans_modules(tmp_path):
+    """l1 -> l2 and l2 -> l3 each cross a module boundary; l3 -> l1 closes
+    the cycle. Three disjoint call paths — no single thread self-deadlocks,
+    so only a pass that joins both modules' call graphs can see it."""
+    (tmp_path / "mod_a.py").write_text(
+        "import threading\n"
+        "from mod_b import B\n"
+        "\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._l1 = threading.Lock()\n"
+        "        self._l3 = threading.Lock()\n"
+        "        self._b = B()\n"
+        "\n"
+        "    def fwd(self):\n"
+        "        with self._l1:\n"
+        "            self._b.grab2()\n"
+        "\n"
+        "    def grab3(self):\n"
+        "        with self._l3:\n"
+        "            pass\n"
+        "\n"
+        "    def rev(self):\n"
+        "        with self._l3:\n"
+        "            with self._l1:\n"
+        "                pass\n"
+    )
+    (tmp_path / "mod_b.py").write_text(
+        "import threading\n"
+        "from mod_a import A\n"
+        "\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._l2 = threading.Lock()\n"
+        "        self._a = A()\n"
+        "\n"
+        "    def grab2(self):\n"
+        "        with self._l2:\n"
+        "            pass\n"
+        "\n"
+        "    def fwd(self):\n"
+        "        with self._l2:\n"
+        "            self._a.grab3()\n"
+    )
+    findings, errors = lint_paths(
+        [str(tmp_path / "mod_a.py"), str(tmp_path / "mod_b.py")],
+        repo_root=str(tmp_path), rules=("lock-order",),
+    )
+    assert errors == []
+    msgs = [f.message for f in findings if "cycle" in f.message]
+    assert msgs, "cross-module 3-lock cycle must be reported"
+    # all three locks are named in the cycle report
+    assert any("A._l1" in m and "B._l2" in m and "A._l3" in m for m in msgs)
+
+    # breaking one edge (rev() no longer nests l1 under l3) clears it
+    fixed = (tmp_path / "mod_a.py").read_text().replace(
+        "        with self._l3:\n            with self._l1:\n",
+        "        with self._l3:\n            if self._l1:\n")
+    (tmp_path / "mod_a.py").write_text(fixed)
+    findings, _ = lint_paths(
+        [str(tmp_path / "mod_a.py"), str(tmp_path / "mod_b.py")],
+        repo_root=str(tmp_path), rules=("lock-order",),
+    )
+    assert not [f for f in findings if "cycle" in f.message]
+
+
+_UNGUARDED = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(target=self._worker, daemon=True)
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def _worker(self):
+        while True:
+            self._n = 0
+"""
+
+
+def test_unguarded_shared_thread_target_flagged():
+    found = [f for f in lint_conc(_UNGUARDED)
+             if f.rule == "unguarded-shared"]
+    assert found
+    assert "_n" in found[0].message
+
+
+def test_unguarded_shared_negative_when_locked_everywhere():
+    src = _UNGUARDED.replace(
+        "        while True:\n            self._n = 0",
+        "        while True:\n            with self._lock:\n"
+        "                self._n = 0",
+    )
+    assert "unguarded-shared" not in _rules_of(lint_conc(src))
+
+
+_GUARDS_SRC = """
+import threading
+
+class Batcher:
+    def __init__(self):
+        {ann}self._cond = threading.Condition()
+        self._queue = []
+        self._n_cut = 0
+        self._t = threading.Thread(target=self._worker, daemon=True)
+
+    def submit(self, item):
+        with self._cond:
+            self._queue.append(item)
+            self._n_cut += 1
+
+    def _worker(self):
+        while True:
+            self._n_cut = 0
+"""
+
+
+def test_guards_annotation_pins_the_guarded_set():
+    # inferred: _cond guards {_queue, _n_cut} (both written under it), so
+    # the worker's bare _n_cut write is a finding ...
+    inferred = lint_conc(_GUARDS_SRC.format(ann=""))
+    assert "unguarded-shared" in _rules_of(inferred)
+    # ... a guards() declaration pins the set to _queue only: the counter
+    # is deliberately outside the critical section, no finding
+    pinned = lint_conc(
+        _GUARDS_SRC.format(ann="# graftlint: guards(_queue)\n        ")
+    )
+    assert "unguarded-shared" not in _rules_of(pinned)
+
+
+_BLOCKING = """
+import threading
+import time
+
+class Slow:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self):
+        with self._lock:
+            time.sleep(0.1)
+"""
+
+
+def test_blocking_under_lock_flagged_direct_and_via_call():
+    found = [f for f in lint_conc(_BLOCKING)
+             if f.rule == "blocking-under-lock"]
+    assert found and "sleep" in found[0].message
+
+    # the same blocking call one hop away (still while held) also flags
+    indirect = _BLOCKING.replace(
+        "            time.sleep(0.1)",
+        "            self._nap()\n\n    def _nap(self):\n"
+        "        time.sleep(0.1)",
+    )
+    assert "blocking-under-lock" in _rules_of(lint_conc(indirect))
+
+
+def test_blocking_under_lock_negative_outside_lock_and_allowlisted():
+    outside = _BLOCKING.replace(
+        "        with self._lock:\n            time.sleep(0.1)",
+        "        with self._lock:\n            pass\n        time.sleep(0.1)",
+    )
+    assert "blocking-under-lock" not in _rules_of(lint_conc(outside))
+
+    allowlisted = _BLOCKING.replace(
+        "            time.sleep(0.1)",
+        "            # graftlint: ok(blocking-under-lock: test allowlist)\n"
+        "            time.sleep(0.1)",
+    )
+    assert "blocking-under-lock" not in _rules_of(lint_conc(allowlisted))
+
+
+_HYGIENE = """
+import threading
+
+class Spawner:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def go(self):
+        t = threading.Thread(target=self._work)
+        t.start()
+
+    def wait_once(self):
+        with self._cond:
+            self._cond.wait()
+
+    def _work(self):
+        pass
+"""
+
+
+def test_thread_hygiene_flags_unjoined_nondaemon_and_bare_wait():
+    rules = [f.rule for f in lint_conc(_HYGIENE)]
+    assert rules.count("thread-hygiene") >= 2  # unjoined thread + bare wait
+
+
+def test_thread_hygiene_negative_daemon_and_predicate_loop():
+    src = _HYGIENE.replace(
+        "t = threading.Thread(target=self._work)",
+        "t = threading.Thread(target=self._work, daemon=True)",
+    ).replace(
+        "            self._cond.wait()",
+        "            while not self._ready:\n                self._cond.wait()",
+    )
+    assert "thread-hygiene" not in _rules_of(lint_conc(src))
+
+
+def test_concurrency_rules_registered():
+    lint_conc("x = 1")  # force rule registration
+    from nerf_replication_tpu.analysis.core import RULE_IDS, RULES
+
+    assert CONCURRENCY_RULE_IDS == (
+        "lock-order", "unguarded-shared", "blocking-under-lock",
+        "thread-hygiene",
+    )
+    assert set(CONCURRENCY_RULE_IDS) <= set(RULE_IDS)
+    for rid in CONCURRENCY_RULE_IDS:
+        assert rid in RULES and RULES[rid].doc
+
+
+def test_concurrency_baseline_identity_survives_line_shift(tmp_path):
+    findings = lint_conc(_BLOCKING)
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, findings)
+    shifted = lint_conc("# shift\n# every\n# line\n" + _BLOCKING)
+    new, accepted, n_fixed = diff_baseline(shifted, load_baseline(path))
+    assert new == [] and accepted and n_fixed == 0
+
+
+def test_repo_concurrency_rules_clean_at_committed_baseline(capsys):
+    """PR 18's self-lint gate: R10-R13 over the whole package report
+    nothing beyond the committed baseline (which holds NO concurrency
+    entries — real findings were fixed, not baselined)."""
+    cli = _load_cli()
+    rc = cli.main(["--no-telemetry", "--rules",
+                   ",".join(CONCURRENCY_RULE_IDS)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"concurrency hazards crept in:\n{out}"
+    assert "0 new finding(s)" in out
+
+
+# --------------------------------------------------------------------------
+# CLI: --changed mode + per-rule timing (PR 18)
+# --------------------------------------------------------------------------
+
+
+def test_cli_changed_mode_lints_only_the_diff(tmp_path, capsys, monkeypatch):
+    cli = _load_cli()
+    bad = tmp_path / "seeded.py"
+    bad.write_text(_BLOCKING)
+    monkeypatch.setattr(cli, "changed_paths",
+                        lambda base, root: [str(bad)])
+    rc = cli.main(["--changed", "--no-telemetry", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "blocking-under-lock" in out
+
+    monkeypatch.setattr(cli, "changed_paths", lambda base, root: [])
+    assert cli.main(["--changed", "--no-telemetry"]) == 0
+    assert "no changed" in capsys.readouterr().out
+
+
+def test_cli_changed_refuses_write_baseline():
+    cli = _load_cli()
+    with pytest.raises(SystemExit):
+        cli.main(["--changed", "--write-baseline", "--no-telemetry"])
+
+
+def test_cli_json_reports_per_rule_wall_time(tmp_path, capsys):
+    cli = _load_cli()
+    bad = tmp_path / "seeded.py"
+    bad.write_text(_BLOCKING)
+    rc = cli.main([str(bad), "--format", "json", "--no-telemetry",
+                   "--no-baseline"])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    times = report["rule_times_s"]
+    assert set(CONCURRENCY_RULE_IDS) <= set(times)
+    assert all(t >= 0 for t in times.values())
+    assert report["new_rule_counts"].get("blocking-under-lock", 0) >= 1
+
+
+# --------------------------------------------------------------------------
+# runtime lock-order sanitizer (PR 18)
+# --------------------------------------------------------------------------
+
+
+class _RowTap:
+    def __init__(self):
+        self.rows = []
+
+    def emit(self, kind, **fields):
+        self.rows.append({"kind": kind, **fields})
+
+
+def test_lock_order_recorder_detects_two_thread_inversion():
+    import threading
+
+    rec = LockOrderRecorder()
+    a = rec.wrap("A", threading.Lock())
+    b = rec.wrap("B", threading.Lock())
+
+    # sequenced (never actually deadlocks) — the DAG check still catches
+    # the order inversion that WOULD deadlock under the wrong interleave
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    for fn in (t1, t2):
+        th = threading.Thread(target=fn)
+        th.start()
+        th.join()
+
+    with pytest.raises(LockOrderError) as ei:
+        rec.assert_acyclic()
+    msg = str(ei.value)
+    assert "A -> B" in msg and "B -> A" in msg
+
+    tap = _RowTap()
+    row = rec.emit(emitter=tap, source="unit")
+    assert row["acyclic"] is False and row["cycle"]
+    assert tap.rows[0]["kind"] == "lock_order"
+
+
+def test_lock_order_recorder_rlock_reentrancy_records_no_edge():
+    import threading
+
+    rec = LockOrderRecorder()
+    r = rec.wrap("R", threading.RLock())
+    with r:
+        with r:  # re-entrant: balanced for release, no self-edge
+            pass
+    rec.assert_acyclic()
+    assert not any(src == dst for (src, dst) in rec.edges)
+
+
+def test_lock_order_instrument_names_and_emits_valid_row():
+    import threading
+
+    from nerf_replication_tpu.obs.schema import SCHEMA_VERSION, validate_row
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+
+    box = Box()
+    rec = LockOrderRecorder()
+    rec.instrument(box, "_lock", "_cond")
+    with box._lock:
+        with box._cond:
+            box._cond.notify_all()  # Condition API forwards through proxy
+    rec.assert_acyclic()
+
+    tap = _RowTap()
+    row = rec.emit(emitter=tap, source="unit")
+    assert {"Box._lock", "Box._cond"} <= set(row["locks"])
+    assert row["n_edges"] >= 1 and row["acyclic"] is True
+    full = {"v": SCHEMA_VERSION, "t": 0.0, **tap.rows[0]}
+    assert validate_row(full) == [], full

@@ -525,3 +525,80 @@ def test_scale_mode_bench_family_validates():
            "attainment_low": 0.6, "attainment_recovered": 0.97,
            "scale_outs": 2, "scale_ins": 1}
     assert validate_bench_row(row) == [], row
+
+
+# -- runtime lock-order sanitizer on the LIVE stack (PR 18) -------------------
+
+
+def test_lock_order_sanitizer_live_stack(serve_stack):
+    """PR 18's acceptance gate: a worker-threaded batcher (real clock, real
+    thread), a tiered residency ladder, and a router instrumented in ONE
+    process — steady state serves at zero recompiles AND the observed
+    lock-acquisition order is acyclic."""
+    from nerf_replication_tpu.analysis import LockOrderRecorder, sanitizer
+    from nerf_replication_tpu.fleet import (
+        SceneData,
+        SceneRecord,
+        SceneRegistry,
+        TieredResidencyManager,
+    )
+    from nerf_replication_tpu.obs import CompileTracker
+    from nerf_replication_tpu.serve import MicroBatcher, RenderEngine
+
+    cfg, network, params, grid, bbox = serve_stack
+    tracker = CompileTracker()
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=grid, bbox=bbox, tracker=tracker)
+    batcher = MicroBatcher(engine)
+    replica = InProcessReplica("r0", engine, batcher)
+    router = Router(heartbeat_timeout_s=30.0)
+    router.register(replica)
+    router.sweep()
+
+    def loader(record):
+        return SceneData(scene_id=record.scene_id,
+                         params={"w": np.full((256,), 1.0, np.float32)})
+
+    registry = SceneRegistry(SceneRecord(scene_id=s) for s in ("a", "b", "c"))
+    mgr = TieredResidencyManager(
+        registry, loader, budget_bytes=3000, staging_budget_bytes=8192,
+        verify_checksums=False)
+
+    recorder = LockOrderRecorder()
+    recorder.instrument(batcher, "_cond")
+    recorder.instrument(mgr, "_cond")
+    recorder.instrument(router, "_lock")
+
+    try:
+        # warm the single 128-ray bucket OUTSIDE the guarded region
+        router.submit(_rays(64), NEAR, FAR).result(timeout=60.0)
+        with sanitizer(tracker, transfers=None) as probe:
+            futs = [router.submit(_rays(48, seed=i), NEAR, FAR)
+                    for i in range(6)]
+            mgr.prefetch("b")
+            for i in range(4):
+                data = mgr.acquire("a" if i % 2 else "c")
+                mgr.release(data.scene_id)
+            mgr.sweep()
+            router.sweep()
+            for f in futs:
+                assert f.result(timeout=60.0)["rgb_map_f"].shape == (48, 3)
+        assert probe.compiles == 0           # zero steady-state recompiles
+    finally:
+        batcher.close(drain=False)
+
+    recorder.assert_acyclic()                # the lock-order gate
+
+    class _Tap:
+        def __init__(self):
+            self.rows = []
+
+        def emit(self, kind, **fields):
+            self.rows.append({"kind": kind, **fields})
+
+    tap = _Tap()
+    row = recorder.emit(emitter=tap, source="tier1")
+    assert row["acyclic"] is True and row["n_locks"] >= 3
+    assert {"MicroBatcher._cond", "TieredResidencyManager._cond",
+            "Router._lock"} <= set(row["locks"])
+    assert validate_row({"v": 1, "t": 0.0, **tap.rows[0]}) == [], row

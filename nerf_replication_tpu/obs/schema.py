@@ -521,10 +521,12 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     # earlier discriminator key above (bench_family is first-match).
     "coldstart": ("mode", "wall_s"),
     # scripts/bench_traversal.py rows (BENCH_TRAVERSAL.jsonl): one row per
-    # (traversal arm × occupancy regime) — flat vs hierarchical candidate
-    # stream size and throughput. NOTE: must not carry any earlier
-    # discriminator key (bench_family is first-match), hence the
-    # traversal-specific field names.
+    # (traversal arm × occupancy regime) — flat vs hierarchical vs fused
+    # (``--fused``, the ops/fused_march.py mega-kernel arm, which also
+    # carries the modeled peak_intermediate_bytes ledger and its
+    # speedup_vs_staged_x headline) candidate stream size and throughput.
+    # NOTE: must not carry any earlier discriminator key (bench_family is
+    # first-match), hence the traversal-specific field names.
     "traversal_mode": ("grid_occ", "candidates_per_ray", "rays_per_s"),
     # scripts/serve_bench.py --scenes/--churn rows (BENCH_FLEET.jsonl): one
     # row per multi-scene churn run — residency churn (evictions, prefetch

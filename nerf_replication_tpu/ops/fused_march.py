@@ -1,0 +1,658 @@
+"""Fused ray-march mega-kernel: DDA + sampling (+ MLP + compositing).
+
+The staged packed pipeline (renderer/packed_march.py) round-trips every
+ray through HBM between stages: XLA-side coarse DDA → a materialized
+``[N, K_c·r]`` candidate stream → ONE global stable sort → masked Pallas
+MLP → segmented compositing. The VDB / hierarchical-ray-traversal line
+(arXiv 2404.10272) shows the win comes from keeping the whole ray alive
+in one kernel, and NerfAcc (arXiv 2305.04966) shows early termination is
+most valuable when it short-circuits BEFORE samples are materialized —
+exactly what a staged pipeline cannot do. This module fuses the march so
+each program instance owns a BLOCK of rays end to end:
+
+* **Stage (a), ``march_rays_fused``** — partial fusion: coarse DDA +
+  fine gather in one kernel emitting a compacted per-ray sample stream
+  ``(t, voxel, valid) [B, K]``. No global sort (compaction is per-ray,
+  via the repo's broadcast-compare one-hot rank trick — no argsort, no
+  scatter), no ``[N, K_c·r]`` HBM intermediate (candidates live only in
+  the block's scratch). The MLP + log-space compositing still run
+  outside, so ANY encoder family (hashgrid included) can ride it.
+* **Stage (b), ``march_rays_fused_full``** — full fusion: the block body
+  additionally frequency-encodes the surviving samples, streams them
+  through the fused-MLP tile machinery (ops/fused_mlp.py
+  ``_forward_tile`` — same canonical flattened-weight order), and
+  composites transmittance in-kernel with early-ray-termination: the
+  K-slot stream is walked in tiles of ``k_tile`` samples and a whole
+  tile's matmul chain is skipped once every ray in the block has
+  ``T < eps``. Frequency-encoder families only (``fused_spec_for``
+  gates, same contract as the fused trunk); forward-only by design —
+  it serves the eval/serve surfaces, training keeps the staged path.
+
+Both stages keep the ``(params, rays, grid, bbox)`` executable
+signature: the coarse pyramid level is derived in-graph from the fine
+grid (occupancy.coarse_from_grid), so serve bucket×tier families, AOT
+registrations, and the NGP carved phase adopt the kernel by flipping
+``MarchOptions.march_fused`` — nothing about donation or warm-up
+changes.
+
+**Dispatch and the Mosaic gather caveat.** The block body
+(:func:`_dda_block`) is ONE jnp function executed two ways: as the
+production path, ``lax.map`` over ray blocks — a single fused XLA
+program per block that already realizes the memory win (no global sort,
+no cross-block intermediates) — and as a Pallas kernel
+(``force_pallas=True``, tier-1-covered via ``interpret=``) whose TPU
+lowering is staged behind the recorded Mosaic negative on in-kernel
+gathers (models/encoding/pallas_hash.py): the DDA's grid lookups are
+exactly such gathers, so Mosaic lowering stays off until that
+restriction lifts. Sharing one body makes kernel-vs-reference parity
+bitwise BY CONSTRUCTION — the tests pin it.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..renderer.occupancy import (
+    PYRAMID_FACTORS,
+    coarse_from_grid,
+    world_to_voxel,
+)
+from .fused_mlp import _forward_tile, _interpret, _pad_cols, _rup
+
+
+def _use_pallas(force) -> bool:
+    # Production dispatch is the XLA block-fused path on every platform;
+    # the Pallas expression of the same body is opt-in (tests run it in
+    # interpret mode) until Mosaic accepts the DDA's in-kernel gathers —
+    # see the module docstring.
+    return bool(force)
+
+
+@dataclass(frozen=True)
+class _FusedStatics:
+    """Trace-time constants of one fused-march configuration."""
+
+    resolution: int   # fine grid R
+    rc: int           # coarse grid R_c (in-graph pyramid level)
+    factor: int       # fine→coarse index divisor (PYRAMID_FACTORS[-1])
+    r: int            # fine steps per coarse block (options.coarse_block)
+    s_c: int          # coarse blocks per ray
+    k_c: int          # kept-interval budget per ray
+    n_steps: int      # fine march steps (S)
+    k_sel: int        # per-ray sample-slot budget K = min(max_samples, C)
+    compact: bool     # K < C: second per-ray compaction runs
+    step: float
+    near: float
+    far: float
+    clip: bool        # per-ray bbox-span quadrature (clip_bbox)
+    threshold: float
+    white_bkgd: bool
+
+    @property
+    def c_total(self) -> int:
+        return self.k_c * self.r
+
+
+def _statics_for(grid_res: int, rc: int, near: float, far: float,
+                 options) -> _FusedStatics:
+    from ..renderer.packed_march import hierarchical_caps
+
+    if options.coarse_block <= 0:
+        raise ValueError(
+            "march_fused requires march_coarse_block > 0 — the fused "
+            "kernel's traversal IS the hierarchical coarse DDA; a flat "
+            "fused sweep would silently march every position and "
+            "invalidate any A/B labeled with the fused knob"
+        )
+    r = options.coarse_block
+    n_steps = max(math.ceil((far - near) / options.step_size - 1e-9), 1)
+    s_c, k_c = hierarchical_caps(n_steps, options)
+    c_total = k_c * r
+    k_sel = min(options.max_samples, c_total)
+    return _FusedStatics(
+        resolution=int(grid_res), rc=int(rc),
+        factor=PYRAMID_FACTORS[-1], r=r, s_c=s_c, k_c=k_c,
+        n_steps=n_steps, k_sel=k_sel, compact=k_sel < c_total,
+        step=float(options.step_size), near=float(near), far=float(far),
+        clip=bool(options.clip_bbox),
+        threshold=float(options.transmittance_threshold),
+        white_bkgd=bool(options.white_bkgd),
+    )
+
+
+def _rank_compact(occ, n_slots: int, *payloads):
+    """First-``n_slots`` occupied entries per row, in order, no argsort.
+
+    ``occ [B, S]`` bool → ``(valid [B, n_slots], gathered payloads)``
+    via the Mosaic-friendly broadcast-compare idiom: the exclusive rank
+    ``cumsum(occ) − occ`` names each occupied entry's destination slot,
+    a one-hot ``rank == slot`` compare selects it, and a reduce-sum
+    extracts the payload — pure elementwise + reductions, the op mix the
+    fused kernels already rely on (no sort, no scatter, no gather)."""
+    occ_i = occ.astype(jnp.int32)
+    rank = jnp.cumsum(occ_i, axis=-1) - occ_i  # exclusive prefix
+    slots = jnp.arange(n_slots, dtype=jnp.int32)
+    sel = occ[:, :, None] & (rank[:, :, None] == slots[None, None, :])
+    valid = jnp.any(sel, axis=1)  # [B, n_slots]
+    outs = tuple(
+        jnp.sum(jnp.where(sel, p[:, :, None], 0), axis=1).astype(p.dtype)
+        for p in payloads
+    )
+    return (valid,) + outs
+
+
+def _dda_block(st: _FusedStatics, rays_blk, grid_flat, coarse_flat, bbox):
+    """The shared block body: coarse DDA → interval compaction → fine
+    gather → per-ray sample-slot compaction, all in one program's scratch.
+
+    ``rays_blk [B, 6]``, flattened bool-as-int8 fine/coarse grids, bbox
+    [2, 3]. Returns ``(t_sel [B, K] f32, valid [B, K] bool, flat_sel
+    [B, K] i32 fine voxel ids, n_occ [B] i32, n_blk [B] i32 occupied
+    coarse blocks, dist [B] f32 per-sample quadrature width)``.
+
+    Every float expression matches renderer/packed_march.py's
+    ``_hierarchical_sweep`` operation-for-operation (same world→voxel
+    math at the same march positions), so the admitted candidate set —
+    and with generous budgets the composited image — is parity-exact
+    against the staged pipeline."""
+    f32 = jnp.float32
+    o, d = rays_blk[:, 0:3], rays_blk[:, 3:6]
+    b = rays_blk.shape[0]
+
+    if st.clip:
+        # slab spans, exactly packed_march._ray_bbox_spans
+        inv = 1.0 / jnp.where(jnp.abs(d) < 1e-12, 1e-12, d)
+        t_lo = (bbox[0] - o) * inv
+        t_hi = (bbox[1] - o) * inv
+        tmin = jnp.max(jnp.minimum(t_lo, t_hi), axis=-1)
+        tmax = jnp.min(jnp.maximum(t_lo, t_hi), axis=-1)
+        t0 = jnp.clip(tmin, st.near, st.far)
+        t1 = jnp.maximum(jnp.clip(tmax, st.near, st.far), t0)
+        step_r = (t1 - t0) / st.n_steps  # [B]
+    else:
+        t0 = jnp.full((b,), st.near, f32)
+        step_r = jnp.full((b,), st.step, f32)
+
+    # coarse DDA: classify every padded march position's PARENT pyramid
+    # cell (fine_vox // factor — index space, preserving the superset
+    # invariant the parity contract rests on)
+    s_pad = st.s_c * st.r
+    s_idx = jnp.arange(s_pad, dtype=f32)
+    if st.clip:
+        ts = t0[:, None] + s_idx[None, :] * step_r[:, None]  # [B, S_pad]
+    else:
+        ts = st.near + s_idx * st.step
+        ts = jnp.broadcast_to(ts, (b, s_pad))
+    pts = o[:, None, :] + d[:, None, :] * ts[..., None]
+    vox = world_to_voxel(pts, bbox, st.resolution)  # [B, S_pad, 3]
+    cvox = vox // st.factor
+    cflat = (cvox[..., 0] * st.rc + cvox[..., 1]) * st.rc + cvox[..., 2]
+    coarse_occ = jnp.take(coarse_flat, cflat) > 0  # [B, S_pad]
+    real = jnp.sum(d * d, axis=-1) > 0.0  # padding rays drop out
+    in_range = jnp.arange(s_pad) < st.n_steps
+    coarse_occ = coarse_occ & real[:, None] & in_range[None, :]
+    if st.clip:
+        coarse_occ = coarse_occ & (step_r > 0)[:, None]
+
+    block_occ = coarse_occ.reshape(b, st.s_c, st.r).any(-1)  # [B, S_c]
+    n_blk = jnp.sum(block_occ, axis=-1).astype(jnp.int32)
+
+    # interval compaction: first K_c occupied blocks per ray, march order
+    s_blocks = jnp.broadcast_to(
+        jnp.arange(st.s_c, dtype=jnp.int32), (b, st.s_c)
+    )
+    bvalid, border = _rank_compact(block_occ, st.k_c, s_blocks)
+
+    # fine gather at the C = K_c·r candidate positions of kept intervals
+    s_f = border[..., None] * st.r + jnp.arange(st.r, dtype=jnp.int32)
+    s_f = s_f.reshape(b, st.c_total)  # [B, C]
+    cand = jnp.broadcast_to(
+        bvalid[..., None], (b, st.k_c, st.r)
+    ).reshape(b, st.c_total) & (s_f < st.n_steps)
+    t_cand = t0[:, None] + s_f.astype(f32) * step_r[:, None]
+    pts_c = o[:, None, :] + d[:, None, :] * t_cand[..., None]
+    vox_c = world_to_voxel(pts_c, bbox, st.resolution)
+    flat_c = (
+        vox_c[..., 0] * st.resolution + vox_c[..., 1]
+    ) * st.resolution + vox_c[..., 2]
+    occ_c = (jnp.take(grid_flat, flat_c) > 0) & cand  # [B, C]
+    n_occ = jnp.sum(occ_c, axis=-1).astype(jnp.int32)
+
+    if st.compact:
+        # second per-ray compaction: first K occupied samples per ray.
+        # Statically skipped when K ≥ C (the serving configurations size
+        # max_samples ≥ K_c·r, so the [B, C, K] one-hot never builds).
+        valid, t_sel, flat_sel = _rank_compact(
+            occ_c, st.k_sel, t_cand, flat_c
+        )
+    else:
+        valid, t_sel, flat_sel = occ_c, t_cand, flat_c
+
+    dist = step_r * jnp.sqrt(jnp.sum(d * d, axis=-1))  # [B] ‖d‖-scaled δ
+    return t_sel, valid, flat_sel.astype(jnp.int32), n_occ, n_blk, dist
+
+
+def _dda_kernel(st, rays_ref, grid_ref, coarse_ref, bbox_ref,
+                t_ref, val_ref, flat_ref, nocc_ref, nblk_ref, dist_ref):
+    t_sel, valid, flat_sel, n_occ, n_blk, dist = _dda_block(
+        st, rays_ref[...], grid_ref[...].reshape(-1),
+        coarse_ref[...].reshape(-1), bbox_ref[...],
+    )
+    t_ref[...] = t_sel
+    val_ref[...] = valid.astype(jnp.float32)
+    flat_ref[...] = flat_sel
+    nocc_ref[...] = n_occ[:, None]
+    nblk_ref[...] = n_blk[:, None]
+    dist_ref[...] = dist[:, None]
+
+
+def _dda_pallas(st: _FusedStatics, blk: int, rays_p, grid_flat,
+                coarse_flat, bbox):
+    n_pad = rays_p.shape[0]
+    k = st.k_sel
+    grid2 = grid_flat.reshape(1, -1)
+    coarse2 = coarse_flat.reshape(1, -1)
+    full = [
+        pl.BlockSpec(grid2.shape, lambda i: (0, 0)),
+        pl.BlockSpec(coarse2.shape, lambda i: (0, 0)),
+        pl.BlockSpec(bbox.shape, lambda i: (0, 0)),
+    ]
+    outs = pl.pallas_call(
+        partial(_dda_kernel, st),
+        grid=(n_pad // blk,),
+        in_specs=[pl.BlockSpec((blk, 6), lambda i: (i, 0))] + full,
+        out_specs=[
+            pl.BlockSpec((blk, k), lambda i: (i, 0)),
+            pl.BlockSpec((blk, k), lambda i: (i, 0)),
+            pl.BlockSpec((blk, k), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(rays_p, grid2, coarse2, bbox)
+    t_sel, val_f, flat_sel, nocc, nblk, dist = outs
+    return (t_sel, val_f > 0.0, flat_sel,
+            nocc[:, 0], nblk[:, 0], dist[:, 0])
+
+
+def fused_dda_gather(rays, near: float, far: float, grid, bbox, options,
+                     force_pallas=None) -> dict:
+    """Stage (a) traversal: one fused DDA + fine gather over ray blocks.
+
+    Returns the compacted per-ray sample stream ``{t_sel [N, K], valid
+    [N, K] bool, flat_sel [N, K] i32, n_occ [N], n_blk [N], dist [N]}``
+    plus the statics under ``"statics"``. The peak intermediate is the
+    [N, K] output stream itself — the staged path's [N, K_c·r] candidate
+    arrays and [N·C] global sort keys never materialize."""
+    coarse = coarse_from_grid(grid, PYRAMID_FACTORS[-1])
+    st = _statics_for(grid.shape[0], coarse.shape[0], near, far, options)
+    if rays.shape[-1] > 6:
+        raise ValueError(
+            "the fused march only supports static [N, 6] rays, got "
+            f"{rays.shape[-1]} columns — time-conditioned scenes must use "
+            "the chunked volume renderer"
+        )
+    grid_flat = grid.reshape(-1).astype(jnp.int8)
+    coarse_flat = coarse.reshape(-1).astype(jnp.int8)
+    bbox = jnp.asarray(bbox, jnp.float32)
+
+    n = rays.shape[0]
+    blk = min(int(options.fused_block), max(n, 1))
+    n_pad = _rup(n, blk)
+    rays_p = jnp.pad(rays, ((0, n_pad - n), (0, 0)))  # zero rays: unreal
+
+    if _use_pallas(force_pallas):
+        t_sel, valid, flat_sel, n_occ, n_blk, dist = _dda_pallas(
+            st, blk, rays_p, grid_flat, coarse_flat, bbox
+        )
+    else:
+        outs = jax.lax.map(
+            lambda rb: _dda_block(st, rb, grid_flat, coarse_flat, bbox),
+            rays_p.reshape(n_pad // blk, blk, 6),
+        )
+        t_sel, valid, flat_sel, n_occ, n_blk, dist = tuple(
+            a.reshape((n_pad,) + a.shape[2:]) for a in outs
+        )
+    return {
+        "t_sel": t_sel[:n], "valid": valid[:n], "flat_sel": flat_sel[:n],
+        "n_occ": n_occ[:n], "n_blk": n_blk[:n], "dist": dist[:n],
+        "statics": st,
+    }
+
+
+def _march_stats(st: _FusedStatics, n_rays: int, n_occ, n_blk) -> dict:
+    """Traversal telemetry shared by both stages (packed-march keys)."""
+    total_occ = jnp.sum(n_occ)
+    dropped = jnp.sum(jnp.maximum(n_occ - st.k_sel, 0))
+    return {
+        "overflow_frac": (
+            dropped.astype(jnp.float32)
+            / jnp.maximum(total_occ, 1).astype(jnp.float32)
+        ),
+        "march_candidates": jnp.float32(n_rays * st.c_total),
+        "march_samples_out": total_occ.astype(jnp.float32),
+        "march_coarse_occ": (
+            jnp.sum(n_blk).astype(jnp.float32) / float(n_rays * st.s_c)
+        ),
+    }
+
+
+def march_rays_fused(
+    apply_fn,
+    rays: jax.Array,
+    near: float,
+    far: float,
+    grid: jax.Array,
+    bbox: jax.Array,
+    options,
+    return_samples: bool = False,
+    force_pallas=None,
+) -> dict:
+    """Stage (a) renderer: fused DDA+gather → masked MLP → per-ray
+    log-space compositing with ERT. Output contract matches
+    ``march_rays_packed`` (maps, per-ray ``truncated``, ``overflow_frac``,
+    march telemetry keys), so every routing site swaps it in unchanged.
+
+    Truncation is PER-RAY again (like accelerated.py): a ray loses
+    samples when its occupied count exceeds the K slot budget or its
+    occupied coarse blocks exceed K_c — there is no global stream to
+    overflow. ``apply_fn``s advertising ``supports_valid_mask`` get the
+    per-slot occupancy bit streamed into the MLP kernel; unlike the
+    sorted packed stream the invalid slots are interleaved, so tile-skip
+    recovers less — the full-fusion stage is where dead slots stop
+    costing MXU work."""
+    dda = fused_dda_gather(rays, near, far, grid, bbox, options,
+                           force_pallas=force_pallas)
+    st: _FusedStatics = dda["statics"]
+    t_sel, valid, dist = dda["t_sel"], dda["valid"], dda["dist"]
+    n, k = t_sel.shape
+    rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
+    vf = valid.astype(jnp.float32)
+
+    pts = rays_o[:, None, :] + rays_d[:, None, :] * t_sel[..., None]
+    norm = jnp.linalg.norm(rays_d, axis=-1, keepdims=True)
+    viewdirs = rays_d / jnp.maximum(norm, 1e-12)  # padding rays: finite
+    if getattr(apply_fn, "supports_valid_mask", False):
+        raw = apply_fn(pts, viewdirs, "fine", valid=vf)
+    else:
+        raw = apply_fn(pts, viewdirs, "fine")  # [N, K, 4]
+
+    rgb = jax.nn.sigmoid(raw[..., :3])
+    sigma = jax.nn.relu(raw[..., 3])
+    # per-ray log-space compositing: 1 − α = exp(−σδ) makes transmittance
+    # an exclusive cumsum — exactly the packed composite minus the global
+    # segment bookkeeping
+    tau = sigma * dist[:, None] * vf  # [N, K]
+    c = jnp.cumsum(tau, axis=-1)
+    trans = jnp.exp(-(c - tau))  # T BEFORE each sample
+    alpha = 1.0 - jnp.exp(-tau)
+    weights = trans * alpha * (trans >= st.threshold)
+
+    rgb_map = jnp.sum(weights[..., None] * rgb, axis=-2)
+    depth_map = jnp.sum(weights * t_sel, axis=-1)
+    acc_map = jnp.sum(weights, axis=-1)
+    if st.white_bkgd:
+        rgb_map = rgb_map + (1.0 - acc_map[..., None])
+
+    still_alive = jnp.exp(-c[:, -1]) >= st.threshold
+    lost = (dda["n_occ"] > st.k_sel) | (dda["n_blk"] > st.k_c)
+    out = {
+        "rgb_map_f": rgb_map,
+        "depth_map_f": depth_map,
+        "acc_map_f": acc_map,
+        "truncated": lost & still_alive,
+    }
+    out.update(_march_stats(st, n, dda["n_occ"], dda["n_blk"]))
+    if return_samples:
+        # flat [N·K] arrays, the packed march's sample-stream layout
+        out["sample_flat"] = jax.lax.stop_gradient(
+            dda["flat_sel"].reshape(-1)
+        )
+        out["sample_sigma"] = jax.lax.stop_gradient(sigma.reshape(-1))
+        out["sample_valid"] = vf.reshape(-1)
+    return out
+
+
+def _full_block(st: _FusedStatics, spec, xyz_encoder, dir_encoder,
+                k_tile: int, rays_blk, grid_flat, coarse_flat, bbox,
+                flat_ws):
+    """Stage (b) block body: DDA → encode → fused-MLP tiles → in-kernel
+    compositing with early ray termination.
+
+    The K-slot stream is walked in python-static tiles of ``k_tile``
+    samples; each tile runs ops/fused_mlp.py's ``_forward_tile`` on
+    ``[B·k_tile]`` rows (the same canonical weight chain as the fused
+    trunk) and folds its weights into carried per-ray accumulators. ERT
+    is two-level: per-sample weights are zeroed the moment transmittance
+    crosses the threshold (bitwise the staged semantics), and a whole
+    tile's encode+matmul chain is skipped via ``lax.cond`` once EVERY
+    ray in the block is dead — sound because τ ≥ 0 means transmittance
+    never recovers, so the skipped tiles' weights are zero by algebra."""
+    t_sel, valid, _flat, n_occ, n_blk, dist = _dda_block(
+        st, rays_blk, grid_flat, coarse_flat, bbox
+    )
+    b, k = t_sel.shape
+    o, d = rays_blk[:, 0:3], rays_blk[:, 3:6]
+    vf = valid.astype(jnp.float32)
+
+    k_pad = _rup(k, k_tile)
+    if k_pad != k:  # pad slots are invalid ⇒ contribute exactly zero
+        t_sel = jnp.pad(t_sel, ((0, 0), (0, k_pad - k)))
+        vf = jnp.pad(vf, ((0, 0), (0, k_pad - k)))
+
+    pts = o[:, None, :] + d[:, None, :] * t_sel[..., None]
+    norm = jnp.linalg.norm(d, axis=-1, keepdims=True)
+    viewdirs = d / jnp.maximum(norm, 1e-12)
+    d_enc = _pad_cols(
+        jnp.asarray(dir_encoder(viewdirs), jnp.float32), spec.c_views_pad
+    )  # [B, c_views_pad] — one encode per ray, broadcast per sample
+
+    rgb_acc = jnp.zeros((b, 3), jnp.float32)
+    depth_acc = jnp.zeros((b,), jnp.float32)
+    acc_acc = jnp.zeros((b,), jnp.float32)
+    c_prev = jnp.zeros((b,), jnp.float32)  # Σ τ marched so far, per ray
+    ws = list(flat_ws)
+
+    for j in range(k_pad // k_tile):
+        sl = slice(j * k_tile, (j + 1) * k_tile)
+        pts_j, vf_j, t_j = pts[:, sl, :], vf[:, sl], t_sel[:, sl]
+        carry = (rgb_acc, depth_acc, acc_acc, c_prev)
+
+        def _run(carry, pts_j=pts_j, vf_j=vf_j, t_j=t_j):
+            rgb_acc, depth_acc, acc_acc, c_prev = carry
+            x = _pad_cols(
+                jnp.asarray(xyz_encoder(pts_j), jnp.float32), spec.c_in_pad
+            ).reshape(b * k_tile, spec.c_in_pad)
+            v = jnp.broadcast_to(
+                d_enc[:, None, :], (b, k_tile, spec.c_views_pad)
+            ).reshape(b * k_tile, spec.c_views_pad)
+            raw8, _ = _forward_tile(spec, x, v, ws)
+            raw = raw8.reshape(b, k_tile, 8)
+            sigma = jax.nn.relu(raw[..., 3]) * vf_j
+            rgb_j = jax.nn.sigmoid(raw[..., :3])
+            tau = sigma * dist[:, None]
+            cj = jnp.cumsum(tau, axis=-1)
+            trans = jnp.exp(-(c_prev[:, None] + (cj - tau)))
+            alpha = 1.0 - jnp.exp(-tau)
+            w = trans * alpha * (trans >= st.threshold)
+            return (
+                rgb_acc + jnp.sum(w[..., None] * rgb_j, axis=-2),
+                depth_acc + jnp.sum(w * t_j, axis=-1),
+                acc_acc + jnp.sum(w, axis=-1),
+                c_prev + cj[:, -1],
+            )
+
+        def _skip(carry):
+            return carry
+
+        any_alive = jnp.any(jnp.exp(-c_prev) >= st.threshold)
+        rgb_acc, depth_acc, acc_acc, c_prev = jax.lax.cond(
+            any_alive, _run, _skip, carry
+        )
+
+    if st.white_bkgd:
+        rgb_acc = rgb_acc + (1.0 - acc_acc[..., None])
+    still_alive = jnp.exp(-c_prev) >= st.threshold
+    return rgb_acc, depth_acc, acc_acc, still_alive, n_occ, n_blk
+
+
+def _full_kernel(conv, n_ws, rays_ref, grid_ref, coarse_ref, bbox_ref,
+                 *rest):
+    ws = rest[:n_ws]
+    consts = rest[n_ws:-6]
+    rgb_ref, depth_ref, acc_ref, alive_ref, nocc_ref, nblk_ref = rest[-6:]
+    rgb, depth, acc, alive, n_occ, n_blk = conv(
+        rays_ref[...], grid_ref[...].reshape(-1),
+        coarse_ref[...].reshape(-1), bbox_ref[...],
+        [w[...] for w in ws], [c[...] for c in consts],
+    )
+    rgb_ref[...] = rgb
+    depth_ref[...] = depth[:, None]
+    acc_ref[...] = acc[:, None]
+    alive_ref[...] = alive.astype(jnp.float32)[:, None]
+    nocc_ref[...] = n_occ[:, None]
+    nblk_ref[...] = n_blk[:, None]
+
+
+def _full_pallas(body, blk: int, rays_p, grid_flat, coarse_flat, bbox,
+                 flat_ws):
+    n_pad = rays_p.shape[0]
+    grid2 = grid_flat.reshape(1, -1)
+    coarse2 = coarse_flat.reshape(1, -1)
+    # the encoders close over trace-time arrays (the frequency bands),
+    # which a Pallas kernel cannot capture — trace the body once outside
+    # the kernel, hoist the jaxpr's array constants into explicit kernel
+    # operands, and replay the jaxpr inside with the loaded values
+    closed = jax.make_jaxpr(
+        lambda rb, gf, cf, bb, ws: body(rb, gf, cf, bb, tuple(ws))
+    )(rays_p[:blk], grid_flat, coarse_flat, bbox, list(flat_ws))
+    consts = tuple(jnp.asarray(c) for c in closed.consts)
+
+    def conv(rb, gf, cf, bb, ws, cs):
+        return tuple(
+            jax.core.eval_jaxpr(closed.jaxpr, cs, rb, gf, cf, bb, *ws)
+        )
+    full = [
+        pl.BlockSpec(grid2.shape, lambda i: (0, 0)),
+        pl.BlockSpec(coarse2.shape, lambda i: (0, 0)),
+        pl.BlockSpec(bbox.shape, lambda i: (0, 0)),
+    ] + [
+        pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd)
+        for w in list(flat_ws) + list(consts)
+    ]
+    col = lambda i: (i, 0)  # noqa: E731
+    outs = pl.pallas_call(
+        partial(_full_kernel, conv, len(flat_ws)),
+        grid=(n_pad // blk,),
+        in_specs=[pl.BlockSpec((blk, 6), col)] + full,
+        out_specs=[
+            pl.BlockSpec((blk, 3), col),
+            pl.BlockSpec((blk, 1), col),
+            pl.BlockSpec((blk, 1), col),
+            pl.BlockSpec((blk, 1), col),
+            pl.BlockSpec((blk, 1), col),
+            pl.BlockSpec((blk, 1), col),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(rays_p, grid2, coarse2, bbox, *flat_ws, *consts)
+    rgb, depth, acc, alive, nocc, nblk = outs
+    return (rgb, depth[:, 0], acc[:, 0], alive[:, 0] > 0.0,
+            nocc[:, 0], nblk[:, 0])
+
+
+def march_rays_fused_full(
+    spec,
+    xyz_encoder,
+    dir_encoder,
+    branch: dict,
+    rays: jax.Array,
+    near: float,
+    far: float,
+    grid: jax.Array,
+    bbox: jax.Array,
+    options,
+    k_tile: int | None = None,
+    force_pallas=None,
+) -> dict:
+    """Stage (b) renderer: the whole march — DDA, sampling, frequency
+    encoding, MLP trunk, compositing — in one block-fused program.
+
+    ``spec``/``xyz_encoder``/``dir_encoder`` come from
+    ``fused_spec_for(network)`` + the network's parameter-free encoders
+    (build-time; unsupported families refuse there, loudly);
+    ``branch = params["params"][model]`` is traced, flattened to the
+    canonical kernel order inside the executable — the ``(params, rays,
+    grid, bbox)`` signature is unchanged. Forward-only: eval and serve
+    surfaces; training keeps the staged path (the compositing VJP would
+    need the bwd tile machinery threaded through the carried state).
+
+    ``k_tile`` sets samples per MLP tile (default sizes B·k_tile ≈ the
+    fused trunk's 512-row tile); smaller tiles terminate earlier, larger
+    ones amortize the weight stream."""
+    coarse = coarse_from_grid(grid, PYRAMID_FACTORS[-1])
+    st = _statics_for(grid.shape[0], coarse.shape[0], near, far, options)
+    if rays.shape[-1] > 6:
+        raise ValueError(
+            "the fused march only supports static [N, 6] rays, got "
+            f"{rays.shape[-1]} columns — time-conditioned scenes must use "
+            "the chunked volume renderer"
+        )
+    grid_flat = grid.reshape(-1).astype(jnp.int8)
+    coarse_flat = coarse.reshape(-1).astype(jnp.int8)
+    bbox = jnp.asarray(bbox, jnp.float32)
+    flat_ws = tuple(spec.flatten_params(branch))
+
+    n = rays.shape[0]
+    blk = min(int(options.fused_block), max(n, 1))
+    n_pad = _rup(n, blk)
+    rays_p = jnp.pad(rays, ((0, n_pad - n), (0, 0)))
+    kt = int(k_tile) if k_tile else max(1, 512 // blk)
+
+    body = partial(_full_block, st, spec, xyz_encoder, dir_encoder, kt)
+    if _use_pallas(force_pallas):
+        rgb, depth, acc, alive, n_occ, n_blk = _full_pallas(
+            body, blk, rays_p, grid_flat, coarse_flat, bbox, flat_ws
+        )
+    else:
+        outs = jax.lax.map(
+            lambda rb: body(rb, grid_flat, coarse_flat, bbox, flat_ws),
+            rays_p.reshape(n_pad // blk, blk, 6),
+        )
+        rgb, depth, acc, alive, n_occ, n_blk = tuple(
+            a.reshape((n_pad,) + a.shape[2:]) for a in outs
+        )
+    rgb, depth, acc = rgb[:n], depth[:n], acc[:n]
+    alive, n_occ, n_blk = alive[:n], n_occ[:n], n_blk[:n]
+
+    lost = (n_occ > st.k_sel) | (n_blk > st.k_c)
+    out = {
+        "rgb_map_f": rgb,
+        "depth_map_f": depth,
+        "acc_map_f": acc,
+        "truncated": lost & alive,
+    }
+    out.update(_march_stats(st, n, n_occ, n_blk))
+    return out

@@ -627,9 +627,12 @@ def fused_mlp_raw(spec: FusedSpec, branch: dict, x_enc, d_enc, tile=512):
     return raw8[:m, :4]
 
 
-def make_fused_apply(network, cfg):
-    """Drop-in ``apply_fn(params, pts, viewdirs, model)`` running the MLP
-    through the fused kernels. Refuses unsupported families loudly."""
+def fused_spec_for(network) -> FusedSpec:
+    """Validate a network is kernel-fusable and return its FusedSpec.
+
+    Shared family gate for every surface that streams the MLP through the
+    Pallas tiles (``make_fused_apply`` and the fused ray-march mega-kernel
+    in ops/fused_march.py). Refuses unsupported families loudly."""
     import flax.linen as nn
 
     from ..models.nerf.network import Network
@@ -652,12 +655,18 @@ def make_fused_apply(network, cfg):
     skips = tuple(network.skips)
     if len(skips) != 1:
         raise ValueError("fused_trunk supports exactly one skip index")
-    tile = int(cfg.network.nerf.get("fused_tile", 512))
-    spec = FusedSpec(
+    return FusedSpec(
         D=network.D, W=network.W, skip=skips[0],
         c_in=network.input_ch, c_views=network.input_ch_views,
         compute_dtype=network.compute_dtype,
     )
+
+
+def make_fused_apply(network, cfg):
+    """Drop-in ``apply_fn(params, pts, viewdirs, model)`` running the MLP
+    through the fused kernels. Refuses unsupported families loudly."""
+    tile = int(cfg.network.nerf.get("fused_tile", 512))
+    spec = fused_spec_for(network)
 
     def apply_fn(params, pts, viewdirs, model, valid=None):
         x_enc = network.xyz_encoder(pts)

@@ -59,10 +59,32 @@ class MarchOptions:
     # ceil(S_c / 4), a 4× candidate-stream reduction at the default.
     coarse_block: int = 0
     coarse_cap: int = 0
+    # fused mega-kernel (ops/fused_march.py). "off" keeps the staged
+    # sweep→sort→MLP→composite pipeline; "gather" fuses the coarse DDA +
+    # fine gather into one per-ray-block kernel emitting a compacted
+    # sample stream (encoder-agnostic: the MLP still runs outside);
+    # "full" additionally runs the frequency-family fused MLP trunk and
+    # the transmittance compositing in-kernel with early ray termination.
+    # Both stages require coarse_block > 0 (the DDA IS the hierarchical
+    # traversal) and refuse loudly otherwise.
+    march_fused: str = "off"
+    # rays per fused-kernel program instance (one Pallas grid block owns
+    # this many rays' scratch state; chunks are padded up to a multiple)
+    fused_block: int = 256
 
     @classmethod
     def from_cfg(cls, cfg) -> "MarchOptions":
         ta = cfg.task_arg
+        raw_fused = ta.get("march_fused", False)
+        if isinstance(raw_fused, str):
+            if raw_fused not in ("off", "gather", "full"):
+                raise ValueError(
+                    "task_arg.march_fused must be one of off/gather/full "
+                    f"(or a bool; true = gather), got {raw_fused!r}"
+                )
+            fused = raw_fused
+        else:
+            fused = "gather" if raw_fused else "off"
         return cls(
             step_size=float(ta.get("render_step_size", 0.005)),
             transmittance_threshold=float(
@@ -74,6 +96,8 @@ class MarchOptions:
             clip_bbox=bool(ta.get("march_clip_bbox", False)),
             coarse_block=int(ta.get("march_coarse_block", 0)),
             coarse_cap=int(ta.get("march_coarse_cap", 0)),
+            march_fused=fused,
+            fused_block=int(ta.get("march_fused_block", 256)),
         )
 
     @classmethod
@@ -184,6 +208,14 @@ def march_rays_accelerated(
             "task_arg.ngp_packed_march true (the per-ray [N, K] march "
             "would silently run the FLAT sweep, invalidating any A/B "
             "labeled with the hierarchical knob)"
+        )
+    if options.march_fused != "off":
+        raise ValueError(
+            "march_fused is implemented only by the fused mega-kernel "
+            "(ops/fused_march.py) — callers must route through "
+            "march_rays_fused / march_rays_fused_full, not the per-ray "
+            "[N, K] march (which would silently run staged, invalidating "
+            "any A/B labeled with the fused knob)"
         )
     rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
     n_rays = rays.shape[0]

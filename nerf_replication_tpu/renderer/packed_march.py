@@ -166,63 +166,39 @@ def _hierarchical_sweep(rays, near, far, grid, bbox, options, spans):
     return flat_cand, occ_cand, s_f, n_steps, n_blk, block_frac, k_c
 
 
-def march_rays_packed(
+def _composite_stream(
     apply_fn,
-    rays: jax.Array,
-    near: float,
-    far: float,
-    grid: jax.Array,
-    bbox: jax.Array,
+    rays_o: jax.Array,
+    rays_d: jax.Array,
+    occupied: jax.Array,
+    t_cand: jax.Array,
+    dist_cand: jax.Array,
     options: MarchOptions,
-    cap_avg: int = 32,
-    return_samples: bool = False,
-) -> dict:
-    """Render a [N, 6] ray chunk with globally-packed ESS + ERT.
+    m_cap: int,
+    extra_lost: jax.Array | None = None,
+    model: str = "fine",
+    tau_clip: float | None = None,
+) -> tuple[dict, dict]:
+    """Phase 2 shared by every packed admission structure: global sort →
+    masked MLP over the compacted stream → log-space segmented compositing.
 
-    Output contract matches ``march_rays_accelerated`` (rgb/depth/acc maps,
-    per-ray ``truncated``), plus ``overflow_frac`` — the fraction of
-    occupied samples dropped by the global M = N × cap_avg cap (0.0 once
-    the grid is carved and cap_avg is sized to ~1.5× the occupied mean).
+    The admission structure (flat sweep, hierarchical DDA, or the proposal
+    resampler) only has to produce per-candidate arrays in per-ray march
+    order: ``occupied [N, C]`` bool, ``t_cand [N, C]`` sample depths and
+    ``dist_cand [N, C]`` quadrature widths (already ‖d‖-scaled).
+    ``extra_lost [N]`` ORs admission-side sample loss (e.g. the coarse
+    K_c interval clip) into the truncation flag the stream-overflow test
+    alone cannot observe. Returns ``(out, aux)``: the render/telemetry
+    dict (minus ``march_coarse_occ``, an admission-side statistic) and the
+    stream internals ``{order, valid, sigma}`` for ``return_samples``
+    consumers.
     """
-    rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
-    n_rays = rays.shape[0]
-    step = options.step_size
+    n_rays, n_cand = occupied.shape
+    m_cap = min(int(m_cap), n_rays * n_cand)
 
-    # phase 1: occupancy of every march position — ONE implementation
-    # shared with the per-ray march (exact-parity contract). clip_bbox
-    # switches the shared sweep to per-ray quadrature: the same static S
-    # covers only the ray's bbox span at a finer per-ray step. Padding
-    # rays / bbox misses come back fully unoccupied either way.
-    # coarse_block > 0 inserts the coarse-DDA stage: the flat [N, S]
-    # candidate set shrinks to the [N, K_c·r] positions inside occupied
-    # coarse-pyramid cells BEFORE the fine gather and the global sort.
-    if options.clip_bbox:
-        import math
-
-        n_est = max(math.ceil((far - near) / step - 1e-9), 1)
-        t0, t1 = _ray_bbox_spans(rays_o, rays_d, bbox, near, far)
-        step_r = (t1 - t0) / n_est  # [N]
-        spans = (t0, step_r)
-    else:
-        t0 = step_r = spans = None
-    hierarchical = options.coarse_block > 0
-    if hierarchical:
-        flat_vox, occupied, s_f, n_steps, n_blk_c, block_frac, k_c = (
-            _hierarchical_sweep(rays, near, far, grid, bbox, options, spans)
-        )
-    else:
-        _, flat_vox, occupied, n_steps = occupancy_sweep(
-            rays, near, far, grid, bbox, step, spans=spans
-        )
-        s_f = None
-        block_frac = jnp.float32(1.0)
-    n_cand = occupied.shape[-1]  # K_c·r hierarchical, S flat
-    m_cap = min(int(n_rays * cap_avg), n_rays * n_cand)
-
-    # phase 2: ONE global sort compacts every occupied (ray, t) position
-    # to the front of a flat candidate stream in (ray, t) order. In the
-    # hierarchical mode candidates are already (ray, t)-lexicographic:
-    # kept blocks ascend in march order and steps ascend within a block.
+    # ONE global sort compacts every occupied (ray, t) position to the
+    # front of a flat candidate stream in (ray, t) order (candidates are
+    # per-ray march-ordered, so idx = ray·C + c is already lexicographic).
     total = n_rays * n_cand
     occ_flat = occupied.reshape(-1)
     idx = jnp.arange(total, dtype=jnp.int32)
@@ -232,16 +208,8 @@ def march_rays_packed(
     valid = occ_flat[order]  # [M] bool (False ⇒ stream tail padding)
 
     ray_id = order // n_cand  # [M] int32, nondecreasing over valid prefix
-    if hierarchical:
-        s_id = s_f.reshape(-1)[order]  # fine march step of each candidate
-    else:
-        s_id = order % n_cand
-    if options.clip_bbox:
-        t_m = t0[ray_id] + s_id.astype(jnp.float32) * step_r[ray_id]
-        step_m = step_r[ray_id]
-    else:
-        t_m = near + s_id.astype(jnp.float32) * step
-        step_m = step
+    t_m = t_cand.reshape(-1)[order]
+    dists = dist_cand.reshape(-1)[order]
 
     o_m = rays_o[ray_id]
     d_m = rays_d[ray_id]
@@ -256,17 +224,24 @@ def march_rays_packed(
     # valid prefix first, so the padding tail costs ~no MXU work.
     if getattr(apply_fn, "supports_valid_mask", False):
         raw = apply_fn(
-            pts_m[:, None, :], viewdirs[ray_id], "fine",
+            pts_m[:, None, :], viewdirs[ray_id], model,
             valid=valid.astype(jnp.float32),
         )[:, 0, :]
     else:
-        raw = apply_fn(pts_m[:, None, :], viewdirs[ray_id], "fine")[:, 0, :]
+        raw = apply_fn(pts_m[:, None, :], viewdirs[ray_id], model)[:, 0, :]
 
     rgb = jax.nn.sigmoid(raw[..., :3])  # [M, 3]
     sigma = jax.nn.relu(raw[..., 3])  # [M]
-    dists = step_m * jnp.linalg.norm(d_m, axis=-1)
     # 1 − α = exp(−σδ): transmittance in log space is EXACT, no clamps
     tau = sigma * dists * valid.astype(jnp.float32)  # [M]
+    if tau_clip is not None:
+        # quadratures with unbounded tail widths (the proposal path's
+        # raw2outputs-parity 1e10 tail interval) would push the SHARED
+        # stream cumsum to ~1e12 per ray, and every later segment's
+        # e − e0 subtraction then cancels catastrophically in float32.
+        # τ ≥ ~80 already means α = 1 and T_after < 2e-35 — clamping
+        # there is invisible to the composite but keeps the cumsum small
+        tau = jnp.minimum(tau, tau_clip)
     c = jnp.cumsum(tau)
     e = c - tau  # exclusive prefix: Σ τ of stream-earlier samples
 
@@ -313,12 +288,8 @@ def march_rays_packed(
     c_end = c[jnp.maximum(kept_end - 1, 0)]
     t_after = jnp.where(kept_n > 0, jnp.exp(-(c_end - e0)), 1.0)
     still_alive = t_after >= options.transmittance_threshold
-    if hierarchical:
-        # the coarse DDA clipped whole intervals off rays crossing more
-        # than K_c occupied blocks BEFORE the stream ever saw them — the
-        # stream-overflow test alone cannot observe that loss, so a
-        # clipped ray must still report truncation, not silently shorten
-        lost = lost | (n_blk_c > k_c)
+    if extra_lost is not None:
+        lost = lost | extra_lost
     n_total_occ = cum_occ[-1]
     out = {
         "rgb_map_f": rgb_map,
@@ -330,18 +301,183 @@ def march_rays_packed(
             / jnp.maximum(n_total_occ, 1).astype(jnp.float32)
         ),
         # traversal telemetry (obs/schema.py "march" rows): rows entering
-        # the global sort, occupied rows surviving the fine test, and the
-        # coarse-level admission fraction (1.0 in the flat sweep)
+        # the global sort and occupied rows surviving the admission test
         "march_candidates": jnp.float32(total),
         "march_samples_out": n_total_occ.astype(jnp.float32),
-        "march_coarse_occ": block_frac,
     }
+    aux = {"order": order, "valid": valid, "sigma": sigma}
+    return out, aux
+
+
+def march_rays_packed(
+    apply_fn,
+    rays: jax.Array,
+    near: float,
+    far: float,
+    grid: jax.Array,
+    bbox: jax.Array,
+    options: MarchOptions,
+    cap_avg: int = 32,
+    return_samples: bool = False,
+) -> dict:
+    """Render a [N, 6] ray chunk with globally-packed ESS + ERT.
+
+    Output contract matches ``march_rays_accelerated`` (rgb/depth/acc maps,
+    per-ray ``truncated``), plus ``overflow_frac`` — the fraction of
+    occupied samples dropped by the global M = N × cap_avg cap (0.0 once
+    the grid is carved and cap_avg is sized to ~1.5× the occupied mean).
+    """
+    rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
+    n_rays = rays.shape[0]
+    step = options.step_size
+
+    # phase 1: occupancy of every march position — ONE implementation
+    # shared with the per-ray march (exact-parity contract). clip_bbox
+    # switches the shared sweep to per-ray quadrature: the same static S
+    # covers only the ray's bbox span at a finer per-ray step. Padding
+    # rays / bbox misses come back fully unoccupied either way.
+    # coarse_block > 0 inserts the coarse-DDA stage: the flat [N, S]
+    # candidate set shrinks to the [N, K_c·r] positions inside occupied
+    # coarse-pyramid cells BEFORE the fine gather and the global sort.
+    if options.clip_bbox:
+        import math
+
+        n_est = max(math.ceil((far - near) / step - 1e-9), 1)
+        t0, t1 = _ray_bbox_spans(rays_o, rays_d, bbox, near, far)
+        step_r = (t1 - t0) / n_est  # [N]
+        spans = (t0, step_r)
+    else:
+        t0 = step_r = spans = None
+    hierarchical = options.coarse_block > 0
+    extra_lost = None
+    if hierarchical:
+        flat_vox, occupied, s_f, n_steps, n_blk_c, block_frac, k_c = (
+            _hierarchical_sweep(rays, near, far, grid, bbox, options, spans)
+        )
+        s_ff = s_f.astype(jnp.float32)
+        if options.clip_bbox:
+            t_cand = t0[:, None] + s_ff * step_r[:, None]
+        else:
+            t_cand = near + s_ff * step
+        # the coarse DDA clipped whole intervals off rays crossing more
+        # than K_c occupied blocks BEFORE the stream ever saw them — the
+        # stream-overflow test alone cannot observe that loss, so a
+        # clipped ray must still report truncation, not silently shorten
+        extra_lost = n_blk_c > k_c
+    else:
+        ts, flat_vox, occupied, n_steps = occupancy_sweep(
+            rays, near, far, grid, bbox, step, spans=spans
+        )
+        t_cand = jnp.broadcast_to(ts, occupied.shape)
+        block_frac = jnp.float32(1.0)
+    d_norm = jnp.linalg.norm(rays_d, axis=-1)
+    dist_ray = (step_r if options.clip_bbox else step) * d_norm  # [N]
+    dist_cand = jnp.broadcast_to(dist_ray[:, None], occupied.shape)
+    m_cap = min(int(n_rays * cap_avg), n_rays * occupied.shape[-1])
+
+    out, aux = _composite_stream(
+        apply_fn, rays_o, rays_d, occupied, t_cand, dist_cand, options,
+        m_cap, extra_lost=extra_lost,
+    )
+    # coarse-level admission fraction (1.0 in the flat sweep)
+    out["march_coarse_occ"] = block_frac
     if return_samples:
         out["sample_flat"] = jax.lax.stop_gradient(
-            occ_to_flat(flat_vox, order)
+            occ_to_flat(flat_vox, aux["order"])
         )
-        out["sample_sigma"] = jax.lax.stop_gradient(sigma)
-        out["sample_valid"] = valid.astype(jnp.float32)
+        out["sample_sigma"] = jax.lax.stop_gradient(aux["sigma"])
+        out["sample_valid"] = aux["valid"].astype(jnp.float32)
+    return out
+
+
+def march_rays_proposal_packed(
+    apply_fn,
+    rays: jax.Array,
+    near: float,
+    far: float,
+    grid: jax.Array,
+    bbox: jax.Array,
+    options: MarchOptions,
+    sampling,
+    cap_avg: int = 32,
+    lindisp: bool = False,
+) -> dict:
+    """Proposal-resampler admission feeding the packed compositing stream.
+
+    The PR 11 proposal pipeline (renderer/sampling.py) still rode the
+    chunked renderer: S_p proposal evals + S_f DENSE fine evals per ray.
+    Here the resampler replaces the coarse DDA as the packed march's
+    admission structure — the deterministic eval quadrature of
+    ``proposal_render_rays`` (stratified midpoints → proposal σ →
+    histogram → det inverse-CDF resample, sorted) produces the per-ray
+    candidate depths, the occupancy grid culls resampled points that
+    landed in carved-empty space, and the shared global compaction +
+    masked fine MLP + log-space composite run on the survivors only. The
+    fine MLP therefore sees the packed stream (M = N·cap_avg rows, valid
+    prefix first) instead of a dense [N, S_f] sweep, so the proposal
+    serve tier and proposal-mode eval inherit the packed/fused-trunk
+    speedup. Quadrature widths carry raw2outputs' 1e10 tail interval, so
+    on an all-admitting grid the composite matches the chunked proposal
+    path to float tolerance (the log-space cumsum vs the 1e-10-guarded
+    cumprod is the only difference).
+
+    Eval-only by design: deterministic resampling (no key), no aux
+    histograms, no interlevel loss — training keeps the chunked path.
+    """
+    if rays.shape[-1] > 6:
+        # same contract as occupancy_sweep: a static geometry bake cannot
+        # gate time-conditioned rays
+        raise ValueError(
+            "the packed proposal march only supports static [N, 6] rays, "
+            f"got {rays.shape[-1]} columns — time-conditioned scenes must "
+            "use the chunked volume renderer"
+        )
+    from .sampling import resample_pdf, weights_from_sigma
+    from .volume import stratified_z_vals
+
+    rays_o, rays_d = rays[..., 0:3], rays[..., 3:6]
+    n_rays = rays.shape[0]
+
+    # proposal histogram — the deterministic eval quadrature of
+    # proposal_render_rays, keyless (det inverse-CDF at bin centers)
+    z_p = stratified_z_vals(
+        None, near, far, n_rays, sampling.n_proposal, 0.0, lindisp
+    )
+    pts_p = rays_o[..., None, :] + rays_d[..., None, :] * z_p[..., :, None]
+    viewdirs = rays_d / jnp.linalg.norm(rays_d, axis=-1, keepdims=True)
+    raw_p = apply_fn(pts_p, viewdirs, "proposal")
+    w_p = weights_from_sigma(raw_p[..., 0], z_p, rays_d)
+    z_mid = 0.5 * (z_p[..., 1:] + z_p[..., :-1])
+    z_f = resample_pdf(None, z_mid, w_p[..., 1:-1], sampling.n_fine, det=True)
+    z_f = jax.lax.stop_gradient(jnp.sort(z_f, axis=-1))  # [N, S_f]
+
+    # admission: the occupancy grid culls resampled points in carved space
+    # (a trained proposal puts ~no mass there, so the cull is ~free and
+    # the kept set drives the packed stream well under N·S_f)
+    resolution = grid.shape[0]
+    pts_f = rays_o[..., None, :] + rays_d[..., None, :] * z_f[..., :, None]
+    vox = world_to_voxel(pts_f, bbox, resolution)
+    flat = (vox[..., 0] * resolution + vox[..., 1]) * resolution + vox[..., 2]
+    real = jnp.sum(rays_d * rays_d, axis=-1) > 0.0  # padding rays drop out
+    occupied = jnp.take(grid.reshape(-1), flat) & real[:, None]
+
+    # raw2outputs interval widths: diff with the 1e10 tail, ‖d‖-scaled —
+    # the log-space composite then equals the chunked cumprod composite
+    d_norm = jnp.linalg.norm(rays_d, axis=-1)
+    dz = jnp.concatenate(
+        [z_f[..., 1:] - z_f[..., :-1], jnp.full_like(z_f[..., :1], 1e10)],
+        axis=-1,
+    )
+    dist_cand = dz * d_norm[:, None]
+
+    m_cap = min(int(n_rays * cap_avg), n_rays * sampling.n_fine)
+    out, _ = _composite_stream(
+        apply_fn, rays_o, rays_d, occupied, z_f, dist_cand, options, m_cap,
+        tau_clip=80.0,
+    )
+    # admission fraction: resampled points surviving the grid cull (the
+    # proposal analog of the coarse DDA's block_frac)
+    out["march_coarse_occ"] = jnp.mean(occupied.astype(jnp.float32))
     return out
 
 

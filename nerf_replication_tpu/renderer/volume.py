@@ -351,8 +351,13 @@ class Renderer:
         self._march_fns_cap = 8
         self._n_truncated = jnp.zeros((), jnp.int32)
         # last traversal diagnostics from the packed march, kept ON DEVICE
-        # (no sync on the render path); telemetry surfaces pull them
+        # (no sync on the render path); telemetry surfaces pull them.
+        # Rebuilt (not mutated) each marched render and CLEARED by chunked
+        # renders, with a monotone "sweep" stamp — a consumer can neither
+        # read a previous sweep's numbers after a chunked render nor
+        # mistake one sweep's stats for another's
         self.last_march_stats: dict = {}
+        self._march_sweep = 0
         # AOT bookkeeping: registry entry name -> local executable-cache key
         self._aot_names: dict = {}
         # fused Pallas MLP trunk (ops/fused_mlp.py): weights + activations
@@ -442,6 +447,11 @@ class Renderer:
         the XLA idiom for the reference's python chunk loop
         (volume_renderer.py:160). The jitted executable is cached per
         (n_chunks, chunk) shape, so validation doesn't re-trace per image."""
+        # a chunked render performs no occupancy march: drop the previous
+        # sweep's diagnostics so GET /stats and the telemetry "march" row
+        # can never attribute stale numbers to this render
+        self.last_march_stats = {}
+
         rays_p, n, n_chunks, chunk = _pad_to_chunks(
             batch["rays"], self.eval_options.chunk_size
         )
@@ -486,11 +496,14 @@ class Renderer:
         """Jitted occupancy-march executable for fixed bounds/options.
 
         Routing mirrors serve/engine.py exactly (full-tier parity by
-        construction): ``coarse_block > 0`` (hierarchical coarse-DDA) or
-        ``clip_bbox`` (per-ray quadrature) take the globally-packed march;
-        the plain per-ray two-phase march otherwise. Named builder so AOT
-        registration (aot_register_eval) can route it through
-        compile/AOTRegistry."""
+        construction): ``march_fused`` (ops/fused_march.py — "full" is the
+        whole-march mega-kernel, "gather" the fused DDA+gather front end)
+        wins; a proposal-mode sampler feeds the packed composite through
+        ``march_rays_proposal_packed``; otherwise ``coarse_block > 0``
+        (hierarchical coarse-DDA) or ``clip_bbox`` (per-ray quadrature)
+        take the globally-packed march, and the plain per-ray two-phase
+        march runs last. Named builder so AOT registration
+        (aot_register_eval) can route it through compile/AOTRegistry."""
         network = self.network
         options = self.march_options
         fused = self._fused_apply
@@ -499,6 +512,11 @@ class Renderer:
         def _apply(params):
             if fused is not None:
                 def apply_fn(pts, vd, model, valid=None):
+                    if model == "proposal":
+                        # the density-only sampler branch is NOT the NeRF
+                        # trunk — the fused kernel's weight chain does not
+                        # apply to it
+                        return network.apply(params, pts, vd, model=model)
                     if valid is not None:
                         return fused(params, pts, vd, model, valid=valid)
                     return fused(params, pts, vd, model)
@@ -509,10 +527,75 @@ class Renderer:
                     fused, "supports_valid_mask", False
                 )
             else:
-                apply_fn = lambda pts, vd, model: network.apply(  # noqa: E731
+                apply_fn = lambda pts, vd, model, valid=None: network.apply(  # noqa: E731
                     params, pts, vd, model=model
                 )
             return apply_fn
+
+        if options.march_fused == "full":
+            # stage (b) mega-kernel: DDA + sampling + frequency encoding +
+            # MLP + compositing in one block-fused program. The family
+            # gate (fused_spec_for) refuses unsupported networks at BUILD
+            # time, so a hashgrid config fails here, not mid-render.
+            from ..ops.fused_march import march_rays_fused_full
+            from ..ops.fused_mlp import fused_spec_for
+
+            spec = fused_spec_for(network)
+            xyz_enc, dir_enc = network.xyz_encoder, network.dir_encoder
+
+            @jax.jit
+            def fn(params, rays_p, grid, bbox):
+                branch = params["params"]["fine"]
+                return jax.lax.map(
+                    lambda rc: march_rays_fused_full(
+                        spec, xyz_enc, dir_enc, branch, rc, near, far,
+                        grid, bbox, options,
+                    ),
+                    rays_p,
+                )
+
+            return fn
+
+        if options.march_fused == "gather":
+            # stage (a): fused DDA + fine gather, MLP + compositing outside
+            # — any encoder family (hashgrid included) rides this one
+            from ..ops.fused_march import march_rays_fused
+
+            @jax.jit
+            def fn(params, rays_p, grid, bbox):
+                apply_fn = _apply(params)
+                return jax.lax.map(
+                    lambda rc: march_rays_fused(
+                        apply_fn, rc, near, far, grid, bbox, options
+                    ),
+                    rays_p,
+                )
+
+            return fn
+
+        if self.eval_options.sampling.mode == "proposal":
+            # learned-sampler checkpoint on a grid engine: the resampler
+            # is the admission structure and the grid culls its output —
+            # proposal-mode eval inherits the packed-stream speedup
+            # instead of riding the dense chunked render
+            from .packed_march import march_rays_proposal_packed
+
+            sampling = self.eval_options.sampling
+            lindisp = bool(self.eval_options.lindisp)
+            cap = self.packed_cap
+
+            @jax.jit
+            def fn(params, rays_p, grid, bbox):
+                apply_fn = _apply(params)
+                return jax.lax.map(
+                    lambda rc: march_rays_proposal_packed(
+                        apply_fn, rc, near, far, grid, bbox, options,
+                        sampling, cap_avg=cap, lindisp=lindisp,
+                    ),
+                    rays_p,
+                )
+
+            return fn
 
         if packed:
             from .packed_march import march_rays_packed
@@ -580,13 +663,20 @@ class Renderer:
         )
         # the packed march also reports per-chunk traversal diagnostics —
         # [n_chunks] vectors, NOT per-ray — park them on device for
-        # telemetry surfaces (train/ngp.py render_image emits "march" rows)
+        # telemetry surfaces (train/ngp.py render_image emits "march" rows).
+        # A FRESH dict with a monotone sweep stamp replaces the previous
+        # one wholesale: a path that reports fewer keys (or none) can
+        # never leave another sweep's values readable beside its own
+        stats: dict = {}
         for k in (
             "march_candidates", "march_samples_out", "march_coarse_occ",
             "overflow_frac",
         ):
             if k in out:
-                self.last_march_stats[k] = out.pop(k)
+                stats[k] = out.pop(k)
+        self._march_sweep += 1
+        stats["sweep"] = self._march_sweep
+        self.last_march_stats = stats
         # accumulate the truncation diagnostic ON DEVICE — a host sync here
         # would serialize per-image dispatch (ADVICE r1); callers read it
         # once per eval via report_truncation(). Summed after unpadding, so

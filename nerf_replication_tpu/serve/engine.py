@@ -281,20 +281,30 @@ class RenderEngine:
         model = "coarse" if family == "coarse" else "fine"
 
         if self.use_grid and family == "proposal":
-            # the learned sampler is its own acceleration structure: the
-            # proposal executable routes through the chunked proposal
-            # render even on a grid engine. Signature keeps (params,
-            # rays_p, grid, bbox) — grid/bbox unused — so _dispatch and
-            # the AOT warm-up treat every grid-engine family uniformly
-            options = self._family_eval_options(family)
+            # the learned sampler is the admission structure here: the
+            # deterministic resampler produces the candidate depths, the
+            # occupancy grid culls the ones in carved-empty space, and the
+            # packed compositing stream renders the survivors — the
+            # proposal tier inherits the packed speedup instead of riding
+            # the dense chunked render. Signature keeps (params, rays_p,
+            # grid, bbox) so _dispatch and the AOT warm-up treat every
+            # grid-engine family uniformly.
+            from ..renderer.packed_march import march_rays_proposal_packed
+
+            options = self._family_march_options(family)
+            eval_opts = self._family_eval_options(family)
+            sampling = eval_opts.sampling
+            lindisp = bool(eval_opts.lindisp)
+            cap = self.packed_cap
 
             def fn(params, rays_p, grid, bbox):
                 apply_fn = lambda pts, vd, m: network.apply(  # noqa: E731
                     params, pts, vd, model=m
                 )
                 return jax.lax.map(
-                    lambda rc: render_rays(
-                        apply_fn, rc, near, far, None, options
+                    lambda rc: march_rays_proposal_packed(
+                        apply_fn, rc, near, far, grid, bbox, options,
+                        sampling, cap_avg=cap, lindisp=lindisp,
                     ),
                     rays_p,
                 )
@@ -303,6 +313,48 @@ class RenderEngine:
 
         if self.use_grid:
             options = self._family_march_options(family)
+
+            if options.march_fused == "full":
+                # stage (b) mega-kernel (ops/fused_march.py): whole march
+                # in one block-fused program. Built per family, so the
+                # bf16 tier's clone yields a bf16-compute spec and the
+                # coarse tier streams the coarse branch — the family
+                # ladder is a weight/spec swap, never a new code path.
+                from ..ops.fused_march import march_rays_fused_full
+                from ..ops.fused_mlp import fused_spec_for
+
+                spec = fused_spec_for(network)
+                xyz_enc = network.xyz_encoder
+                dir_enc = network.dir_encoder
+
+                def fn(params, rays_p, grid, bbox):
+                    branch = params["params"][model]
+                    return jax.lax.map(
+                        lambda rc: march_rays_fused_full(
+                            spec, xyz_enc, dir_enc, branch, rc, near, far,
+                            grid, bbox, options,
+                        ),
+                        rays_p,
+                    )
+
+                return self._finalize_fn(fn)
+
+            if options.march_fused == "gather":
+                # stage (a): fused DDA + gather, MLP + compositing outside
+                from ..ops.fused_march import march_rays_fused
+
+                def fn(params, rays_p, grid, bbox):
+                    apply_fn = lambda pts, vd, _m, valid=None: network.apply(  # noqa: E731
+                        params, pts, vd, model=model
+                    )
+                    return jax.lax.map(
+                        lambda rc: march_rays_fused(
+                            apply_fn, rc, near, far, grid, bbox, options
+                        ),
+                        rays_p,
+                    )
+
+                return self._finalize_fn(fn)
 
             if options.coarse_block > 0 or options.clip_bbox:
                 # hierarchical (or clipped) traversal: the packed march,

@@ -703,6 +703,16 @@ class NGPTrainer:
         network, near, far = self.network, self.near, self.far
         bbox, options = self.bbox, self.eval_march
         packed, cap_eval = self.packed_march, self.packed_cap_avg_eval
+        if options.march_fused == "full":
+            # the mega-kernel's in-kernel encode is frequency-family only
+            # (ops/fused_march.py) — the hash encoder is a learnable Flax
+            # module that cannot run inside the fused body. Refuse at
+            # build time instead of silently downgrading the A/B label.
+            raise ValueError(
+                "march_fused='full' is unsupported on the NGP (hashgrid) "
+                "eval path — use march_fused='gather' (fused DDA + gather; "
+                "the MLP stays outside, so any encoder family rides it)"
+            )
 
         @jax.jit
         def render(params, rays_p, grid):
@@ -711,6 +721,13 @@ class NGPTrainer:
             )
 
             def body(chunk_rays):
+                if options.march_fused == "gather":
+                    from ..ops.fused_march import march_rays_fused
+
+                    return march_rays_fused(
+                        apply_fn, chunk_rays, near, far, grid, bbox,
+                        options,
+                    )
                 if packed:
                     from ..renderer.packed_march import march_rays_packed
 
@@ -811,7 +828,8 @@ class NGPTrainer:
                 "march",
                 surface="ngp_eval",
                 mode=(
-                    "hierarchical" if self.eval_march.coarse_block > 0
+                    "fused" if self.eval_march.march_fused != "off"
+                    else "hierarchical" if self.eval_march.coarse_block > 0
                     else "packed"
                 ),
                 candidates_in=cand,

@@ -17,12 +17,22 @@ and identical quadrature, so ``samples_out`` must agree exactly; the
 rows record candidates/ray, sort rows/s, end-to-end rays/s, and the
 hierarchical arm's reduction factor.
 
+``--fused`` adds a third arm per regime: the fused mega-kernel march
+(ops/fused_march.py) on the SAME hierarchical options — per-ray block
+traversal with NO global sort and no [N, S] candidate stream. Its rows
+additionally carry the modeled peak-intermediate-bytes ledger (every arm
+gets one) so the HBM claim is auditable next to the rays/s claim: the
+staged arms materialize sort keys over every candidate plus the packed
+MLP stream, the fused gather arm only its per-ray [N, K] sample list,
+and full fusion only per-block VMEM scratch plus the output maps
+(``peak_intermediate_bytes_full_fusion``).
+
 Timing runs K carry-dependent iterations inside ONE jitted fori_loop
 (the elision-immune pattern from bench_primitives.py — host-side
 re-dispatch loops measure impossibly fast on this machine).
 
     python scripts/bench_traversal.py [--rays 1024] [--iters 4]
-        [--out BENCH_TRAVERSAL.jsonl]
+        [--fused] [--out BENCH_TRAVERSAL.jsonl]
 """
 
 from __future__ import annotations
@@ -53,6 +63,9 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=4)
     p.add_argument("--coarse_block", type=int, default=8)
     p.add_argument("--cap_avg", type=int, default=96)
+    p.add_argument("--fused", action="store_true",
+                   help="add the fused mega-kernel arm per regime")
+    p.add_argument("--fused_block", type=int, default=256)
     p.add_argument("--force_platform", default=os.environ.get(
         "BENCH_FORCE_PLATFORM", ""))
     p.add_argument("--out", default=os.path.join(_REPO,
@@ -71,6 +84,10 @@ def main(argv=None):
     import jax.numpy as jnp
     import numpy as np
 
+    from nerf_replication_tpu.ops.fused_march import (
+        _statics_for,
+        march_rays_fused,
+    )
     from nerf_replication_tpu.renderer.accelerated import MarchOptions
     from nerf_replication_tpu.renderer.packed_march import march_rays_packed
 
@@ -102,13 +119,34 @@ def main(argv=None):
     sink = open(args.out, "a")
     platform = jax.devices()[0].platform
 
+    # Modeled peak intermediate bytes (HBM arrays live at once between the
+    # admission structure and the composite — NOT weights or outputs):
+    #   staged rows: occ(1) + t(4) + dist(4) + sort key/val(8) = 17 B per
+    #   candidate entering the global sort, plus the packed MLP stream
+    #   (pts 12 + dirs 12 + raw 16 = 40 B per kept row).
+    #   fused gather: t_sel(4) + valid(1) + flat_sel(4) = 9 B per [N, K]
+    #   slot plus the same 40 B masked-MLP row — no sort, no [N, S].
+    #   full fusion: the sample list never reaches HBM; per-block scratch
+    #   is blk·K·49 B of VMEM plus the [N] output maps (20 B/ray).
+    STREAM_ROW_B, SORT_ROW_B, FUSED_SLOT_B, OUT_ROW_B = 40, 17, 9, 20
+
+    def staged_bytes(cand_rows, m_cap_rows):
+        return int(cand_rows * SORT_ROW_B + m_cap_rows * STREAM_ROW_B)
+
     def run_arm(mode, opts, grid, regime, grid_occ):
-        fn = jax.jit(
-            lambda r, g: march_rays_packed(
-                apply_fn, r, near, far, g, bbox, opts,
-                cap_avg=args.cap_avg,
-            )
-        )
+        if mode == "fused":
+            def march(r, g):
+                return march_rays_fused(
+                    apply_fn, r, near, far, g, bbox, opts
+                )
+        else:
+            def march(r, g):
+                return march_rays_packed(
+                    apply_fn, r, near, far, g, bbox, opts,
+                    cap_avg=args.cap_avg,
+                )
+
+        fn = jax.jit(march)
         out = jax.block_until_ready(fn(rays, grid))  # compile + diagnostics
         k_iters = args.iters
 
@@ -116,10 +154,7 @@ def main(argv=None):
         def timed(r0, g):
             def body(_, carry):
                 s, r = carry
-                o = march_rays_packed(
-                    apply_fn, r, near, far, g, bbox, opts,
-                    cap_avg=args.cap_avg,
-                )
+                o = march(r, g)
                 s = s + jnp.mean(o["rgb_map_f"])
                 # carry-dependent perturbation chains the iterations so
                 # nothing can be elided; 1e-12 leaves the march unchanged
@@ -136,6 +171,25 @@ def main(argv=None):
 
         cand = float(out["march_candidates"])
         samp = float(out["march_samples_out"])
+        if mode == "fused":
+            from nerf_replication_tpu.renderer.occupancy import (
+                PYRAMID_FACTORS,
+            )
+
+            st = _statics_for(
+                res, res // PYRAMID_FACTORS[-1], near, far, opts
+            )
+            k = st.k_sel
+            peak_b = int(n_rays * k * (FUSED_SLOT_B + STREAM_ROW_B))
+            blk = min(opts.fused_block, n_rays)
+            peak_b_full = int(
+                blk * k * (FUSED_SLOT_B + STREAM_ROW_B)
+                + n_rays * OUT_ROW_B
+            )
+        else:
+            m_cap = min(n_rays * args.cap_avg, int(cand))
+            peak_b = staged_bytes(cand, m_cap)
+            peak_b_full = None
         row = {
             "traversal_mode": mode,
             "regime": regime,
@@ -148,11 +202,14 @@ def main(argv=None):
             "truncated_rays": int(np.asarray(jnp.sum(out["truncated"]))),
             "rays_per_s": n_rays * k_iters / dt,
             "sort_rows_per_s": cand * k_iters / dt,
+            "peak_intermediate_bytes": peak_b,
             "n_rays": n_rays,
             "n_steps": n_steps,
             "coarse_block": opts.coarse_block,
             "cap_avg": args.cap_avg,
         }
+        if peak_b_full is not None:
+            row["peak_intermediate_bytes_full_fusion"] = peak_b_full
         return row
 
     flat_opts = MarchOptions(
@@ -179,7 +236,25 @@ def main(argv=None):
         hier["reduction_x"] = (
             flat["candidates_per_ray"] / hier["candidates_per_ray"]
         )
-        for row in (flat, hier):
+        rows = [flat, hier]
+        if args.fused:
+            fused_opts = MarchOptions(
+                step_size=step, max_samples=n_steps, white_bkgd=True,
+                coarse_block=args.coarse_block,
+                coarse_cap=k_cap, fused_block=args.fused_block,
+            )
+            fused = run_arm("fused", fused_opts, grid, regime, grid_occ)
+            # the headline A/B: the fused march against the staged
+            # hierarchical arm it replaces, on identical admission
+            fused["speedup_vs_staged_x"] = (
+                fused["rays_per_s"] / hier["rays_per_s"]
+            )
+            fused["bytes_vs_staged_x"] = (
+                hier["peak_intermediate_bytes"]
+                / fused["peak_intermediate_bytes"]
+            )
+            rows.append(fused)
+        for row in rows:
             sink.write(json.dumps(row) + "\n")
             print(
                 f"{regime:>6} {row['traversal_mode']:>12}: "
@@ -189,6 +264,9 @@ def main(argv=None):
                 f"rays/s {row['rays_per_s']:10.0f}"
                 + (f"  reduction {row['reduction_x']:.2f}x"
                    if "reduction_x" in row else "")
+                + (f"  vs staged {row['speedup_vs_staged_x']:.2f}x "
+                   f"({row['bytes_vs_staged_x']:.2f}x fewer bytes)"
+                   if "speedup_vs_staged_x" in row else "")
             )
         # with an unclipped interval budget the coarse level is a strict
         # superset of the fine grid, so the two arms must admit the SAME

@@ -12,9 +12,12 @@ and its multi-scene fleet trail ``BENCH_FLEET.jsonl`` (family
 (families ``scale_mode`` — one full scale-out/scale-in cycle per row —
 and ``placement_mode`` — one placement-planned fleet run per row, plan
 version / hot-width attainment / budget compliance / unplanned-dispatch
-share; all written by scripts/serve_bench.py), and the learned sampler's
+share; all written by scripts/serve_bench.py), the learned sampler's
 ``BENCH_SAMPLING.jsonl`` (family ``sampling_mode``, written by
-scripts/bench_sampling.py) via the ``BENCH_*.jsonl`` pattern.
+scripts/bench_sampling.py), and the traversal ledger
+``BENCH_TRAVERSAL.jsonl`` (family ``traversal_mode`` — flat /
+hierarchical / fused mega-kernel arms, written by
+scripts/bench_traversal.py) via the ``BENCH_*.jsonl`` pattern.
 
 Files named ``telemetry*.jsonl`` are checked row-by-row against the typed
 telemetry schema (``obs/schema.py:ROW_KINDS``) — including the fleet-obs

@@ -35,6 +35,13 @@ placement moves, or unplanned-dispatch share; with
 a regression is flagged, so a bench battery can use it as its gate
 against a saved baseline run (e.g. the run behind ``BASELINE.json``).
 
+The traversal bench ledger can be fed directly (``tlm_report
+BENCH_TRAVERSAL.jsonl``): its flat/hierarchical/fused arm rows summarize
+to per-arm rays/s and the carved-regime fused-vs-staged speedup +
+intermediate-bytes ratio, and ``--diff`` flags the fused speedup
+shrinking past the gate — the fused mega-kernel's regression gate
+against a committed baseline ledger.
+
 A file holds every run ever appended to it (one ``run_meta`` row each);
 the summary covers the LAST run unless ``--all-runs`` is given. Purely
 host-side — no JAX import, safe to run anywhere.
@@ -300,6 +307,42 @@ def summarize(rows: list[dict]) -> dict:
         summary["march_modes"] = sorted(
             {r.get("mode", "packed") for r in marches}
         )
+
+    # traversal bench rows (scripts/bench_traversal.py, fed directly:
+    # ``tlm_report BENCH_TRAVERSAL.jsonl``): flat / hierarchical / fused
+    # arms per occupancy regime. The ledger file appends every run, so
+    # the LAST row per (regime, arm) is the current measurement. The
+    # headline pair is fused vs staged-hierarchical on the carved regime
+    # — the fused mega-kernel's rays/s and peak-intermediate-bytes claims
+    # (ops/fused_march.py, docs/traversal.md) — which ``--diff`` gates.
+    trav = [r for r in rows if "traversal_mode" in r]
+    if trav:
+        by_arm: dict = {}
+        for r in trav:
+            by_arm[(r.get("regime", ""), r["traversal_mode"])] = r
+        summary["traversal_arms"] = sorted(
+            f"{reg}/{mode}" for reg, mode in by_arm
+        )
+        for (reg, mode), r in by_arm.items():
+            key = f"traversal_{reg}_{mode}"
+            summary[f"{key}_rays_per_s"] = r.get("rays_per_s")
+            summary[f"{key}_candidates_per_ray"] = r.get(
+                "candidates_per_ray"
+            )
+            if r.get("peak_intermediate_bytes") is not None:
+                summary[f"{key}_peak_bytes"] = r["peak_intermediate_bytes"]
+        fus = by_arm.get(("carved", "fused"))
+        hier = by_arm.get(("carved", "hierarchical"))
+        if fus and hier and hier.get("rays_per_s"):
+            summary["traversal_fused_speedup_x"] = (
+                fus["rays_per_s"] / hier["rays_per_s"]
+            )
+            if (fus.get("peak_intermediate_bytes")
+                    and hier.get("peak_intermediate_bytes")):
+                summary["traversal_fused_bytes_x"] = (
+                    hier["peak_intermediate_bytes"]
+                    / fus["peak_intermediate_bytes"]
+                )
 
     # learned-sampling rows (renderer/sampling.py proposal resampler):
     # fine-MLP evaluations per ray — the budget the proposal network cuts
@@ -784,6 +827,14 @@ def print_summary(summary: dict, label: str = "") -> None:
               + (f"{occ * 100:.1f}%" if occ is not None else "n/a")
               + "  overflow max: "
               + (f"{over * 100:.1f}%" if over is not None else "n/a"))
+    if summary.get("traversal_arms"):
+        print(f"  traversal:     {', '.join(summary['traversal_arms'])}")
+        spd = summary.get("traversal_fused_speedup_x")
+        byt = summary.get("traversal_fused_bytes_x")
+        if spd is not None:
+            print(f"    fused vs staged (carved): {spd:.2f}x rays/s"
+                  + (f"  {byt:.2f}x fewer intermediate bytes"
+                     if byt is not None else ""))
     if summary.get("sample_rows"):
         mode = summary.get("sampling_mode") or "n/a"
         fer = summary.get("sampling_fine_evals_per_ray")
@@ -1094,6 +1145,17 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
         flags.append(
             f"march sweep efficiency dropped {a * 100:.1f}% -> "
             f"{b * 100:.1f}%"
+        )
+    # the fused mega-kernel's advantage over the staged hierarchical arm
+    # SHRINKING past the gate means the fusion stopped paying for itself
+    # — a regression in the fused path even if absolute rays/s moved for
+    # unrelated machine reasons (the staged arm is the same-run control)
+    a = base.get("traversal_fused_speedup_x")
+    b = cand.get("traversal_fused_speedup_x")
+    if a and b is not None and (a - b) / a * 100.0 > gate_pct:
+        flags.append(
+            f"fused-vs-staged traversal speedup dropped {a:.2f}x -> "
+            f"{b:.2f}x"
         )
     # fine-MLP evals/ray GROWING means the candidate spends more network
     # sweeps per ray than its baseline — the learned sampler's whole win

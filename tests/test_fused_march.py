@@ -1,0 +1,600 @@
+"""Fused ray-march mega-kernel (ops/fused_march.py): kernel-vs-reference
+bitwise parity (the shared block body run as lax.map vs Pallas interpret),
+fused-vs-staged compositing parity against the packed march, stage (a) vs
+stage (b) agreement, ERT-on-opaque-scenes correctness, all-empty and
+overflow edge cases, renderer/serve routing, march-stats freshness, and
+the zero-retrace serving contract with the fused knob on. All CPU (the
+Pallas path runs in interpret mode — the tier-1 coverage the ISSUE
+requires)."""
+
+import dataclasses
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.models import make_network
+from nerf_replication_tpu.models.nerf.network import init_params
+from nerf_replication_tpu.ops.fused_march import (
+    fused_dda_gather,
+    march_rays_fused,
+    march_rays_fused_full,
+)
+from nerf_replication_tpu.ops.fused_mlp import fused_spec_for
+from nerf_replication_tpu.renderer.accelerated import (
+    MarchOptions,
+    march_rays_accelerated,
+)
+from nerf_replication_tpu.renderer.packed_march import march_rays_packed
+
+NEAR, FAR = 2.0, 6.0
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_fused"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4, n_test=1)
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "64",
+         "task_arg.march_chunk_size", "64"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+
+    def apply_fn(pts, dirs, model, valid=None):
+        return network.apply(params, pts, dirs, model=model)
+
+    rng = np.random.default_rng(7)
+    n = 64
+    rays = np.concatenate(
+        [
+            np.tile([0.0, 0.0, 4.0], (n, 1)),
+            np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3)),
+        ],
+        -1,
+    ).astype(np.float32)
+
+    bbox = jnp.asarray(cfg.train_dataset.scene_bbox, jnp.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    return cfg, network, params, apply_fn, jnp.asarray(rays), \
+        jnp.asarray(grid), bbox
+
+
+# generous budgets: S=16, r=4 ⇒ S_c=4; K_c=3 covers the box, K=C ⇒ no
+# second compaction, so fused and staged admit identical sample sets
+OPT = MarchOptions(
+    step_size=0.25, max_samples=64, white_bkgd=True, chunk_size=64,
+    coarse_block=4, coarse_cap=3, fused_block=64,
+)
+
+
+# -- stage (a): fused DDA + gather -------------------------------------------
+
+
+def test_fused_dda_kernel_matches_reference_bitwise(setup):
+    """The block body is ONE jnp function dispatched two ways; the Pallas
+    expression (interpret on CPU) must reproduce the lax.map reference
+    EXACTLY on every output — bitwise, not to tolerance."""
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    ref = fused_dda_gather(rays, NEAR, FAR, grid, bbox, OPT,
+                           force_pallas=False)
+    ker = fused_dda_gather(rays, NEAR, FAR, grid, bbox, OPT,
+                           force_pallas=True)
+    for k in ("t_sel", "valid", "flat_sel", "n_occ", "n_blk", "dist"):
+        assert np.array_equal(np.asarray(ref[k]), np.asarray(ker[k])), k
+    # the carved box genuinely culls: some rays keep zero samples, none
+    # overflow under the generous budget
+    assert int(np.asarray(ref["n_occ"]).sum()) > 0
+    assert (np.asarray(ref["n_occ"]) <= OPT.max_samples).all()
+
+
+def test_fused_gather_matches_packed_hierarchical(setup):
+    """Fused-vs-staged parity: identical float expressions at identical
+    march positions ⇒ the same admitted samples, so the composited maps
+    agree to float tolerance and the traversal telemetry EXACTLY."""
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    staged = march_rays_packed(
+        apply_fn, rays, NEAR, FAR, grid, bbox, OPT, cap_avg=64
+    )
+    fused = march_rays_fused(apply_fn, rays, NEAR, FAR, grid, bbox, OPT)
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        np.testing.assert_allclose(
+            np.asarray(fused[k]), np.asarray(staged[k]),
+            rtol=2e-4, atol=2e-5, err_msg=k,
+        )
+    # integer-exact telemetry: same samples admitted, same blocks kept
+    assert float(fused["march_samples_out"]) == float(
+        staged["march_samples_out"]
+    )
+    assert float(fused["march_coarse_occ"]) == float(
+        staged["march_coarse_occ"]
+    )
+    assert float(fused["march_candidates"]) == float(
+        staged["march_candidates"]
+    )
+    assert float(fused["overflow_frac"]) == 0.0
+    np.testing.assert_array_equal(
+        np.asarray(fused["truncated"]), np.asarray(staged["truncated"])
+    )
+
+
+def test_fused_gather_grads_match_packed(setup):
+    """Stage (a) keeps the MLP outside the kernel, so the whole render
+    differentiates; grads wrt the network params must match the staged
+    path to tolerance (same samples, same composite — only the stream
+    bookkeeping differs)."""
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    gt = jnp.full((rays.shape[0], 3), 0.5)
+
+    def loss_staged(p):
+        out = march_rays_packed(
+            lambda pts, d, m: network.apply(p, pts, d, model=m),
+            rays, NEAR, FAR, grid, bbox, OPT, cap_avg=64,
+        )
+        return jnp.mean((out["rgb_map_f"] - gt) ** 2)
+
+    def loss_fused(p):
+        out = march_rays_fused(
+            lambda pts, d, m: network.apply(p, pts, d, model=m),
+            rays, NEAR, FAR, grid, bbox, OPT,
+        )
+        return jnp.mean((out["rgb_map_f"] - gt) ** 2)
+
+    gs = jax.grad(loss_staged)(params)
+    gf = jax.grad(loss_fused)(params)
+    leaves_s = jax.tree_util.tree_leaves(gs)
+    leaves_f = jax.tree_util.tree_leaves(gf)
+    assert leaves_f and all(bool(jnp.isfinite(x).all()) for x in leaves_f)
+    assert sum(float(jnp.abs(x).sum()) for x in leaves_f) > 0.0
+    for a, b in zip(leaves_f, leaves_s):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=1e-6
+        )
+
+
+# -- stage (b): full fusion ---------------------------------------------------
+
+
+def test_fused_full_matches_gather_and_kernel_bitwise(setup):
+    """Stage (b) runs the SAME canonical weight chain (_forward_tile) on
+    the same samples as stage (a)'s network.apply — the maps must agree
+    tightly; and the Pallas expression of the full body must match its
+    lax.map reference bitwise."""
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    spec = fused_spec_for(network)
+    branch = params["params"]["fine"]
+    a = march_rays_fused(apply_fn, rays, NEAR, FAR, grid, bbox, OPT)
+    b = march_rays_fused_full(
+        spec, network.xyz_encoder, network.dir_encoder, branch,
+        rays, NEAR, FAR, grid, bbox, OPT,
+    )
+    k = march_rays_fused_full(
+        spec, network.xyz_encoder, network.dir_encoder, branch,
+        rays, NEAR, FAR, grid, bbox, OPT, force_pallas=True,
+    )
+    for key in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        np.testing.assert_allclose(
+            np.asarray(b[key]), np.asarray(a[key]),
+            rtol=2e-5, atol=2e-5, err_msg=key,
+        )
+        assert np.array_equal(np.asarray(b[key]), np.asarray(k[key])), key
+    np.testing.assert_array_equal(
+        np.asarray(b["truncated"]), np.asarray(a["truncated"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b["truncated"]), np.asarray(k["truncated"])
+    )
+    for key in ("march_samples_out", "march_coarse_occ", "overflow_frac"):
+        assert float(b[key]) == float(a[key]) == float(k[key]), key
+
+
+def test_fused_ert_terminated_rays_match_full_composite(setup):
+    """ERT soundness on an opaque scene: τ ≥ 0 means transmittance never
+    recovers, so zeroing dead samples' weights (and skipping whole dead
+    tiles in stage (b)) must not change the composite vs a no-threshold
+    march."""
+    cfg, network, params, _, rays, grid, bbox = setup
+
+    def opaque_apply(pts, dirs, model, valid=None):
+        # σ = 50 ⇒ α per 0.25-step ≈ 1 − e^-12.5: rays die on the first
+        # occupied sample; rgb varies with position so a wrongly-kept
+        # tail sample would visibly shift the composite
+        rgb_raw = pts  # pre-sigmoid, position-dependent
+        sigma = jnp.full(pts.shape[:-1] + (1,), 50.0)
+        return jnp.concatenate([rgb_raw, sigma], axis=-1)
+
+    ert = dataclasses.replace(OPT, transmittance_threshold=1e-4)
+    no_ert = dataclasses.replace(OPT, transmittance_threshold=0.0)
+    out_e = march_rays_fused(opaque_apply, rays, NEAR, FAR, grid, bbox, ert)
+    out_n = march_rays_fused(
+        opaque_apply, rays, NEAR, FAR, grid, bbox, no_ert
+    )
+    # ERT drops exactly the contributions carried by transmittance below
+    # the threshold, so the composite shift is bounded by it
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        np.testing.assert_allclose(
+            np.asarray(out_e[k]), np.asarray(out_n[k]), atol=2e-4,
+            err_msg=k,
+        )
+    # terminated ≠ truncated: opaque rays finished by ERT are NOT flagged
+    assert not bool(out_e["truncated"].any())
+    hit = np.asarray(out_e["acc_map_f"]) > 0.5
+    assert hit.any()
+
+
+def test_fused_all_empty_grid(setup):
+    """An all-carved grid admits nothing: pure background, zero samples,
+    no truncation — on both stages and both dispatches."""
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    empty = jnp.zeros_like(grid)
+    spec = fused_spec_for(network)
+    branch = params["params"]["fine"]
+    outs = [
+        march_rays_fused(apply_fn, rays, NEAR, FAR, empty, bbox, OPT),
+        march_rays_fused(apply_fn, rays, NEAR, FAR, empty, bbox, OPT,
+                         force_pallas=True),
+        march_rays_fused_full(
+            spec, network.xyz_encoder, network.dir_encoder, branch,
+            rays, NEAR, FAR, empty, bbox, OPT,
+        ),
+    ]
+    for out in outs:
+        np.testing.assert_allclose(np.asarray(out["rgb_map_f"]), 1.0)
+        np.testing.assert_allclose(np.asarray(out["acc_map_f"]), 0.0)
+        assert float(out["march_samples_out"]) == 0.0
+        assert float(out["march_coarse_occ"]) == 0.0
+        assert not bool(out["truncated"].any())
+
+
+def test_fused_overflow_and_compact_edge_cases(setup):
+    """Starved budgets: K < C runs the second per-ray compaction and
+    reports overflow_frac; K_c=1 clips occupied coarse blocks (n_blk >
+    K_c) — both must flag ``truncated`` on still-transparent rays, and
+    the kernel must stay bitwise with the reference on these paths."""
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    # K=4 < C=12: the compact path (the serving configs never hit) runs
+    starved = dataclasses.replace(OPT, max_samples=4)
+    dda_r = fused_dda_gather(rays, NEAR, FAR, grid, bbox, starved)
+    dda_k = fused_dda_gather(rays, NEAR, FAR, grid, bbox, starved,
+                             force_pallas=True)
+    for k in ("t_sel", "valid", "flat_sel", "n_occ", "n_blk", "dist"):
+        assert np.array_equal(np.asarray(dda_r[k]), np.asarray(dda_k[k])), k
+    # the first-K-in-march-order contract: each ray's kept samples are
+    # the K nearest valid samples of the generous (K=C, uncompacted) run
+    full = fused_dda_gather(rays, NEAR, FAR, grid, bbox, OPT)
+    ts_s, va_s = np.asarray(dda_r["t_sel"]), np.asarray(dda_r["valid"])
+    ts_g, va_g = np.asarray(full["t_sel"]), np.asarray(full["valid"])
+    for i in range(ts_s.shape[0]):
+        kept = np.sort(ts_s[i][va_s[i]])
+        want = np.sort(ts_g[i][va_g[i]])[: kept.size]
+        np.testing.assert_array_equal(kept, want)
+        assert kept.size == min(int(va_g[i].sum()), 4)
+    out = march_rays_fused(apply_fn, rays, NEAR, FAR, grid, bbox, starved)
+    assert float(out["overflow_frac"]) > 0.0
+    assert bool(out["truncated"].any())
+
+    # K_c=1: rays crossing ≥2 occupied coarse blocks lose whole intervals
+    clipped = dataclasses.replace(OPT, coarse_cap=1)
+    out_c = march_rays_fused(apply_fn, rays, NEAR, FAR, grid, bbox, clipped)
+    n_blk = np.asarray(fused_dda_gather(
+        rays, NEAR, FAR, grid, bbox, clipped
+    )["n_blk"])
+    assert (n_blk > 1).any()
+    assert bool(out_c["truncated"].any())
+
+
+def test_fused_pad_rays_are_inert(setup):
+    """Zero-direction padding rays (the chunk/bucket convention) must
+    admit nothing and leave real rays' outputs untouched."""
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    base = march_rays_fused(apply_fn, rays, NEAR, FAR, grid, bbox, OPT)
+    padded = jnp.concatenate([rays, jnp.zeros((32, 6), jnp.float32)], 0)
+    out = march_rays_fused(apply_fn, padded, NEAR, FAR, grid, bbox, OPT)
+    n = rays.shape[0]
+    np.testing.assert_allclose(
+        np.asarray(out["rgb_map_f"][:n]), np.asarray(base["rgb_map_f"]),
+        rtol=1e-6, atol=1e-6,
+    )
+    assert not bool(out["truncated"][n:].any())
+    assert float(out["march_samples_out"]) == float(
+        base["march_samples_out"]
+    )
+
+
+def test_fused_return_samples_feed_grid_maintenance(setup):
+    """return_samples exposes the flat [N·K] sample stream the NGP
+    live-grid scatter-max consumes — every valid sample's voxel must be
+    occupied."""
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    out = march_rays_fused(
+        apply_fn, rays, NEAR, FAR, grid, bbox, OPT, return_samples=True
+    )
+    m = rays.shape[0] * min(OPT.max_samples, 3 * 4)  # K = min(K, K_c·r)
+    assert out["sample_flat"].shape == (m,)
+    assert out["sample_sigma"].shape == (m,)
+    assert out["sample_valid"].shape == (m,)
+    flat = np.asarray(out["sample_flat"])
+    valid = np.asarray(out["sample_valid"]) > 0
+    assert valid.any()
+    assert np.asarray(grid).reshape(-1)[flat[valid]].all()
+
+
+# -- options plumbing and refusals -------------------------------------------
+
+
+def test_march_options_fused_parsing_and_guards(setup):
+    cfg, network, params, apply_fn, rays, grid, bbox = setup
+    root = cfg.train_dataset.data_root
+    # bool sugar: true ⇒ the encoder-agnostic gather stage
+    c = tiny_cfg(root, ["task_arg.march_fused", "true",
+                        "task_arg.march_coarse_block", "4"])
+    assert MarchOptions.from_cfg(c).march_fused == "gather"
+    c = tiny_cfg(root, ["task_arg.march_fused", "full",
+                        "task_arg.march_fused_block", "128"])
+    opt = MarchOptions.from_cfg(c)
+    assert opt.march_fused == "full" and opt.fused_block == 128
+    with pytest.raises(ValueError, match="off/gather/full"):
+        MarchOptions.from_cfg(
+            tiny_cfg(root, ["task_arg.march_fused", "mega"])
+        )
+    # the per-ray [N, K] march must refuse the knob, not silently ignore it
+    with pytest.raises(ValueError, match="fused"):
+        march_rays_accelerated(
+            apply_fn, rays, NEAR, FAR, grid, bbox,
+            dataclasses.replace(
+                MarchOptions(), march_fused="gather"
+            ),
+        )
+    # the fused kernel IS the hierarchical DDA — flat configs refuse
+    with pytest.raises(ValueError, match="march_coarse_block"):
+        march_rays_fused(
+            apply_fn, rays, NEAR, FAR, grid, bbox,
+            dataclasses.replace(OPT, coarse_block=0),
+        )
+    # static-geometry contract: time-conditioned rays cannot ride a bake
+    rays7 = jnp.concatenate([rays, jnp.zeros((rays.shape[0], 1))], -1)
+    with pytest.raises(ValueError, match="6"):
+        march_rays_fused(apply_fn, rays7, NEAR, FAR, grid, bbox, OPT)
+
+
+# -- renderer routing + march-stats freshness --------------------------------
+
+
+def _fused_renderer(root, mode):
+    from nerf_replication_tpu.renderer.volume import make_renderer
+
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "64",
+         "task_arg.march_chunk_size", "64",
+         "task_arg.march_coarse_block", "4",
+         "task_arg.march_coarse_cap", "3",
+         "task_arg.march_fused", mode,
+         "task_arg.march_fused_block", "64"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    renderer = make_renderer(cfg, network)
+    return cfg, network, params, renderer
+
+
+def test_renderer_routes_fused_and_stamps_fresh_march_stats(setup):
+    """Both fused stages route through Renderer.render_accelerated with
+    the (params, rays, grid, bbox) signature; a marched render stamps a
+    monotone sweep id, and a chunked render CLEARS the stats — the
+    staleness satellite's contract."""
+    cfg0, _, _, _, rays, grid, bbox = setup
+    root = cfg0.train_dataset.data_root
+    batch = {"rays": rays, "near": np.float32(NEAR), "far": np.float32(FAR)}
+
+    ref = None
+    for mode in ("gather", "full"):
+        cfg, network, params, renderer = _fused_renderer(root, mode)
+        assert renderer.march_options.march_fused == mode
+        renderer.occupancy_grid = grid
+        renderer.grid_bbox = bbox
+        out = renderer.render_accelerated(params, batch)
+        assert np.isfinite(np.asarray(out["rgb_map_f"])).all()
+        # fresh stats, stamped
+        stats = renderer.last_march_stats
+        assert stats["sweep"] == 1
+        assert "march_candidates" in stats
+        # the two stages agree on the same scene
+        if ref is None:
+            ref = np.asarray(out["rgb_map_f"])
+        else:
+            np.testing.assert_allclose(
+                np.asarray(out["rgb_map_f"]), ref, rtol=2e-5, atol=2e-5
+            )
+        # second marched render advances the stamp...
+        renderer.render_accelerated(params, batch)
+        assert renderer.last_march_stats["sweep"] == 2
+        # ...and a chunked render clears the dict entirely: no consumer
+        # can read the previous sweep's numbers after it
+        renderer.render_chunked(params, batch)
+        assert renderer.last_march_stats == {}
+
+
+def test_ngp_eval_refuses_full_fusion(setup):
+    """The hashgrid family cannot run inside the frequency-encode kernel:
+    the NGP eval builder must refuse march_fused='full' at build time
+    rather than silently downgrade."""
+    cfg0, *_ = setup
+    root = cfg0.train_dataset.data_root
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.ngp_training", "true",
+         "task_arg.ngp_grid_res", "16",
+         "task_arg.ngp_packed_march", "true",
+         "task_arg.march_coarse_block", "4",
+         "task_arg.march_fused", "full",
+         "task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "64"],
+    )
+    from nerf_replication_tpu.train.ngp import make_ngp_trainer
+
+    net = make_network(cfg)
+    trainer = make_ngp_trainer(cfg, net)
+    with pytest.raises(ValueError, match="gather"):
+        trainer._build_render(1, 64)
+
+
+# -- serving: zero retrace across tiers with the fused knob ------------------
+
+
+def test_serve_fused_zero_retrace_and_matches_renderer(setup):
+    """The acceptance criterion's serving half: an engine with
+    march_fused=full warms every bucket×tier executable, a mixed tier
+    stream never recompiles, and the full tier matches
+    Renderer.render_accelerated bitwise (identical routing on both
+    sides)."""
+    from nerf_replication_tpu.renderer.volume import make_renderer
+    from nerf_replication_tpu.serve import RenderEngine
+
+    cfg0, _, _, _, _, grid, bbox = setup
+    root = cfg0.train_dataset.data_root
+    cfg = tiny_cfg(
+        root,
+        ["task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "64",
+         "task_arg.march_chunk_size", "64",
+         "task_arg.march_coarse_block", "4",
+         "task_arg.march_coarse_cap", "3",
+         "task_arg.march_fused", "full",
+         "task_arg.march_fused_block", "64",
+         "serve.buckets", "[64]",
+         "serve.max_batch_rays", "64"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    engine = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                          grid=np.asarray(grid), bbox=np.asarray(bbox))
+    assert engine.march_options.march_fused == "full"
+    assert engine.warmup_compiles > 0
+
+    renderer = make_renderer(cfg, network)
+    renderer.occupancy_grid = grid
+    renderer.grid_bbox = bbox
+
+    rng = np.random.default_rng(3)
+    rays = np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (50, 1)),
+         np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (50, 3))],
+        -1,
+    ).astype(np.float32)
+    ref = renderer.render_accelerated(
+        params,
+        {"rays": jnp.asarray(rays), "near": np.float32(NEAR),
+         "far": np.float32(FAR)},
+    )
+    before = engine.tracker.total_compiles()
+    out = engine.render_request(rays, NEAR, FAR, tier="full", emit=False)
+    # compositing parity is exact; depth is allowed one float32 ulp —
+    # the engine and renderer are DIFFERENT jitted programs and XLA:CPU
+    # may reassociate the depth accumulation differently between them
+    for k in ("rgb_map_f", "acc_map_f"):
+        assert np.array_equal(np.asarray(ref[k]), out[k]), k
+    np.testing.assert_allclose(
+        np.asarray(ref["depth_map_f"]), out["depth_map_f"], atol=3e-7
+    )
+    # tier switches ride pre-warmed executables: zero steady-state
+    # recompiles across the whole ladder
+    for tier in ("full", "bf16", "proposal", "reduced_k", "coarse",
+                 "half_res"):
+        out = engine.render_request(rays, NEAR, FAR, tier=tier, emit=False)
+        assert np.isfinite(out["rgb_map_f"]).all(), tier
+    assert engine.tracker.total_compiles() == before
+    # the fused march's traversal diagnostics reach GET /stats
+    march = engine.stats()["march"]
+    assert march is not None and march["chunks"] >= 1
+    assert march["candidates_per_chunk"] > 0
+
+
+# -- proposal resampler fed into the packed path (satellite) -----------------
+
+
+def test_proposal_packed_matches_chunked_proposal(tmp_path_factory):
+    """On an all-admitting grid the proposal-packed march must reproduce
+    the chunked proposal render to float tolerance: same deterministic
+    quadrature (stratified midpoints → det inverse-CDF), raw2outputs'
+    1e10 tail interval, log-space composite vs guarded cumprod."""
+    from nerf_replication_tpu.renderer.packed_march import (
+        march_rays_proposal_packed,
+    )
+    from nerf_replication_tpu.renderer.sampling import proposal_render_rays
+    from nerf_replication_tpu.renderer.volume import RenderOptions
+
+    root = str(tmp_path_factory.mktemp("scene_prop_packed"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=4,
+                   n_test=1)
+    cfg = tiny_cfg(
+        root,
+        ["sampling.mode", "proposal",
+         "sampling.n_proposal", "16",
+         "sampling.n_fine", "8",
+         "task_arg.render_step_size", "0.25",
+         "task_arg.max_march_samples", "16",
+         "task_arg.march_chunk_size", "64"],
+    )
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    options = RenderOptions.from_cfg(cfg, train=False)
+    assert options.sampling.mode == "proposal"
+
+    def apply_fn(pts, dirs, model, valid=None):
+        return network.apply(params, pts, dirs, model=model)
+
+    rng = np.random.default_rng(5)
+    rays = jnp.asarray(np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (32, 1)),
+         np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (32, 3))],
+        -1,
+    ).astype(np.float32))
+    bbox = jnp.asarray(cfg.train_dataset.scene_bbox, jnp.float32)
+    all_grid = jnp.ones((16, 16, 16), bool)
+
+    chunked = proposal_render_rays(
+        apply_fn, rays, NEAR, FAR, None, options
+    )
+    # threshold 0 ⇒ no ERT weight zeroing (raw2outputs composites every
+    # sample); cap = n_fine ⇒ the stream never overflows
+    m_opt = MarchOptions(
+        step_size=0.25, max_samples=16, white_bkgd=True, chunk_size=64,
+        transmittance_threshold=0.0,
+    )
+    packed = march_rays_proposal_packed(
+        apply_fn, rays, NEAR, FAR, all_grid, bbox, m_opt,
+        options.sampling, cap_avg=8, lindisp=False,
+    )
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        np.testing.assert_allclose(
+            np.asarray(packed[k]), np.asarray(chunked[k]),
+            rtol=2e-4, atol=2e-5, err_msg=k,
+        )
+    assert float(packed["overflow_frac"]) == 0.0
+    assert not bool(packed["truncated"].any())
+    # a CARVED grid culls resampled points: fewer composited samples, and
+    # the march telemetry reports the cull
+    carved = jnp.zeros((16, 16, 16), bool).at[4:12, 4:12, 4:12].set(True)
+    culled = march_rays_proposal_packed(
+        apply_fn, rays, NEAR, FAR, carved, bbox, m_opt,
+        options.sampling, cap_avg=8, lindisp=False,
+    )
+    assert float(culled["march_samples_out"]) < float(
+        packed["march_samples_out"]
+    )
+    assert 0.0 < float(culled["march_coarse_occ"]) < 1.0

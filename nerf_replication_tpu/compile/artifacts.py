@@ -57,15 +57,27 @@ def default_artifact_dir() -> str:
 
 
 def _signature(tree) -> str:
-    """Stable fingerprint of a pytree of abstract values (or arrays)."""
+    """Stable fingerprint of a pytree of abstract values (or arrays).
+
+    Mesh-sharded leaves (model-parallel serving) fold their partition
+    spec + mesh shape into the fingerprint: the same shapes lowered
+    under a different sharding are a DIFFERENT executable, and the two
+    must never collide in the artifact store. Unsharded/single-device
+    leaves contribute nothing extra, so every pre-sharding key is
+    unchanged (warm restarts across this change still hit)."""
     import jax
+    from jax.sharding import NamedSharding
 
     leaves, treedef = jax.tree.flatten(tree)
     parts = [str(treedef)]
     for leaf in leaves:
         shape = tuple(getattr(leaf, "shape", ()))
         dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
-        parts.append(f"{shape}:{dtype}")
+        sig = f"{shape}:{dtype}"
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            sig += f":{sharding.spec}@{dict(sharding.mesh.shape)}"
+        parts.append(sig)
     return "|".join(parts)
 
 

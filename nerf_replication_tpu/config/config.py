@@ -305,6 +305,13 @@ def default_cfg() -> ConfigNode:
             # when >1 device; "force" = mesh even on one device (the
             # CPU parity-test configuration)
             "mesh": "off",
+            # 2-D serving mesh [data, model] (None -> all devices on
+            # data). model > 1 = model-parallel serving: params shard by
+            # parallel/sharding rules (hash tables row-sharded, MLP
+            # width column-parallel), so each device holds ~1/model of
+            # the scene — scenes bigger than one chip's HBM budget
+            # become servable (docs/scaleout.md "Model-parallel serving")
+            "mesh_shape": None,
             # scene placement planner (scale/placement.py): which replica
             # holds which scene. Disabled -> the router's passive
             # affinity/least-loaded dispatch is bitwise unchanged.

@@ -31,6 +31,15 @@ Demote -> re-promote is bitwise: the staged arrays are the SAME host
 buffers the original load produced, and re-promotion device_puts them
 unchanged (tests/test_control_plane.py pins this, and that a
 re-promotion never recompiles).
+
+Sharded scenes (model-parallel serving mesh) ride the same ladder as a
+UNIT: the HBM tier accounts the per-device shard bytes
+(``SceneData.nbytes``), while staging accounts the TOTAL host bytes —
+host RAM holds the whole unsharded scene, so a demotion parks every
+shard's source buffer and a re-promotion re-places all shards from it
+in one ``placer`` call (still bitwise: same host buffers, same
+partition specs). There is no per-shard demote; a scene is resident
+everywhere or nowhere (docs/fleet.md "Per-shard byte accounting").
 """
 
 from __future__ import annotations

@@ -56,7 +56,10 @@ _TOUCH = 0
 class SceneData:
     """One scene's render inputs (host- or device-side; same fields the
     engine's executables take at dispatch). ``nbytes`` is filled by the
-    manager from the real leaf sizes once known."""
+    manager from the real leaf sizes once known — it is the PER-DEVICE
+    figure (per-shard under a model-parallel serving mesh, where each
+    device holds ~1/M of the params); ``total_nbytes`` is the whole
+    scene across shards. The two coincide for replicated scenes."""
 
     scene_id: str
     params: object
@@ -65,6 +68,7 @@ class SceneData:
     near: float = 2.0
     far: float = 6.0
     nbytes: int = 0
+    total_nbytes: int = 0
 
 
 class _Resident:
@@ -125,6 +129,16 @@ class ResidencyManager:
         self.cache_entries = int(cache_entries)
         self.pose_decimals = int(pose_decimals)
         self.validate = validate
+        # sharded-placement hooks (engine.attach_fleet installs them):
+        # ``placer`` maps a host (params, grid, bbox) tree onto the
+        # serving mesh by the partition rules; ``shard_nbytes`` returns
+        # the per-device bytes that placement will occupy (the figure
+        # the HBM budget checks — admission is all-shards-or-none, so
+        # the max per-device shard is what must fit). None keeps the
+        # classic replicated behavior: plain device_put, real leaf bytes.
+        self.placer = None
+        self.shard_nbytes = None
+        self.param_shards = 1
         self.retry_kw = dict(retry_kw or {})
         self._cond = threading.Condition()
         self._resident: OrderedDict[str, _Resident] = OrderedDict()
@@ -302,20 +316,35 @@ class ResidencyManager:
             host = self._load_host(record)
             if self.validate is not None:
                 self.validate(host)   # SceneCompatError on mismatch
-        nbytes = _tree_nbytes(host)
+        total = _tree_nbytes(host)
+        # per-device bytes: what one device must actually hold once the
+        # scene is placed. Under a model-parallel mesh that is the shard
+        # figure from the partition specs; replicated, it IS the total.
+        host_tree = (host.params, host.grid, host.bbox)
+        nbytes = (
+            int(self.shard_nbytes(host_tree))
+            if self.shard_nbytes is not None else total
+        )
         if nbytes > self.budget_bytes:
+            shards = int(self.param_shards)
+            sharded = (
+                f"{nbytes} bytes/device over {shards} param shard(s) "
+                f"({total} bytes total)" if shards > 1
+                else f"{nbytes} bytes"
+            )
             raise ResidencyOverloadError(
                 scene_id,
-                f"scene {scene_id!r} needs {nbytes} bytes, over the whole "
-                f"fleet budget ({self.budget_bytes})",
+                f"scene {scene_id!r} needs {sharded}, over the whole "
+                f"fleet budget ({self.budget_bytes} bytes/device)",
             )
         self._admit(scene_id, nbytes)
         try:
             import jax
 
-            device = jax.tree.map(jax.device_put, (
-                host.params, host.grid, host.bbox
-            ))
+            if self.placer is not None:
+                device = self.placer(host_tree)
+            else:
+                device = jax.tree.map(jax.device_put, host_tree)
         except BaseException:
             with self._cond:
                 self._reserved -= nbytes
@@ -323,7 +352,7 @@ class ResidencyManager:
             raise
         params, grid, bbox = device
         data = replace(host, params=params, grid=grid, bbox=bbox,
-                       nbytes=nbytes)
+                       nbytes=nbytes, total_nbytes=total)
         with self._cond:
             self._reserved -= nbytes
             self._cond.notify_all()
@@ -337,14 +366,16 @@ class ResidencyManager:
             self.bytes_loaded += nbytes
             # write-through to the host-RAM staging tier (no-op in the
             # one-level manager): a later HBM eviction demotes instead of
-            # dropping because the host copy is already staged
-            self._stage_host(scene_id, host, nbytes)
+            # dropping because the host copy is already staged. Staged at
+            # TOTAL bytes — host RAM holds the whole unsharded scene.
+            self._stage_host(scene_id, host, total)
             n_res, res_bytes = len(self._resident), self._resident_bytes()
             tier_fields = self._tier_fields()
         # staging write-through may have queued evict rows under the lock
         self._flush_rows()
         get_emitter().emit(
             "scene_load", scene=scene_id, bytes=nbytes, source=source,
+            total_bytes=total, param_shards=int(self.param_shards),
             load_s=round(time.perf_counter() - t0, 4),
             resident=n_res, resident_bytes=res_bytes, **tier_fields,
         )
@@ -427,7 +458,7 @@ class ResidencyManager:
                         raise ResidencyOverloadError(
                             scene_id,
                             f"cannot admit scene {scene_id!r} "
-                            f"({nbytes} bytes): all "
+                            f"({nbytes} bytes/device): all "
                             f"{len(self._resident)} resident scenes are "
                             "pinned by in-flight batches",
                         )
@@ -519,6 +550,9 @@ class ResidencyManager:
                 "pinned": [s for s, r in self._resident.items() if r.refcount],
                 "resident_bytes": self._resident_bytes(),
                 "budget_bytes": self.budget_bytes,
+                # 1 = replicated params; >1 = model-parallel serving,
+                # where resident/budget bytes are per-device shard figures
+                "param_shards": int(self.param_shards),
                 "loads": loads,
                 "cold_loads": cold,
                 "warm_hits": self.warm_hits,

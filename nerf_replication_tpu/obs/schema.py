@@ -117,10 +117,15 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
     # swap), the REAL byte footprint charged against fleet.hbm_budget_mb,
     # and the residency set after commit. staging/staging_bytes: host-RAM
     # tier occupancy after commit (tiered ladder only, fleet/ladder.py)
+    # total_bytes/param_shards: model-parallel serving (scale.mesh_shape
+    # with M > 1) — ``bytes`` is then the per-device shard figure and
+    # ``total_bytes`` the whole scene across its ``param_shards`` shards
+    # (the two coincide and param_shards == 1 for replicated scenes)
     "scene_load": (
         {"scene": (str,), "bytes": _NUM, "source": (str,)},
         {"load_s": _NUM, "resident": _NUM, "resident_bytes": _NUM,
-         "staging": _NUM, "staging_bytes": _NUM},
+         "staging": _NUM, "staging_bytes": _NUM,
+         "total_bytes": _NUM, "param_shards": _NUM},
     ),
     # one per eviction at either residency tier. reason: budget (one-level
     # manager, drop to admit), demoted (HBM -> host-RAM staging, the
@@ -131,6 +136,15 @@ ROW_KINDS: dict[str, tuple[dict, dict]] = {
         {"scene": (str,), "bytes": _NUM},
         {"reason": (str,), "resident": _NUM, "resident_bytes": _NUM,
          "tier": (str,), "staging": _NUM, "staging_bytes": _NUM},
+    ),
+    # one per ray-bank placement onto the data-parallel mesh
+    # (parallel/sharding.py shard_bank): the bank truncates to a
+    # mesh-divisible size, and the dropped-tail count rides a row — the
+    # "no silent caps" rule. n_dropped == 0 rows are emitted too, so the
+    # report can prove the cap never bit.
+    "bank_shard": (
+        {"n_rays": _NUM, "n_kept": _NUM, "n_dropped": _NUM},
+        {"n_shards": _NUM},
     ),
     # one per load-shed decision: the backlog that triggered a degraded
     # tier (tenant: the per-tenant breaker forced the degrade, fleet/qos.py)
@@ -574,6 +588,17 @@ _BENCH_FAMILIES: dict[str, tuple[str, ...]] = {
     "placement_mode": ("plan_version", "hot_width_target",
                        "hot_width_achieved", "over_budget_replicas",
                        "unplanned_share", "kill_repair_failed"),
+    # scripts/bench_traversal.py --mesh-shape rows (BENCH_TRAVERSAL.jsonl):
+    # one row per (replicated | sharded) arm of the model-parallel serving
+    # bench — rays/s through the mesh_jit path next to the MEASURED
+    # per-device peak param bytes (max over each leaf's addressable
+    # shards), with the sharded arm carrying its byte-reduction headline
+    # vs the replicated baseline and the allclose check against the
+    # single-device render. NOTE: must not carry any earlier
+    # discriminator key (bench_family is first-match), hence shard_mode
+    # and the shard-specific field names.
+    "shard_mode": ("mesh_shape", "rays_per_s", "param_bytes_per_device",
+                   "param_bytes_total"),
 }
 
 

@@ -62,6 +62,39 @@ def tree_shardings(tree, mesh):
     )
 
 
+def _leaf_shard_nbytes(spec: P, leaf, mesh) -> int:
+    """Per-device bytes of one leaf under ``spec`` over ``mesh``.
+
+    Derived from the partition spec alone (no placement needed): each
+    sharded dim is split into ``ceil(dim / axis_size)`` blocks, so the
+    largest shard of the leaf holds the product of the rounded-up block
+    sizes. This is the figure HBM admission must check — the max, not
+    the mean, because residency is all-shards-or-none."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+    n = int(itemsize)
+    for dim, axes in zip(shape, tuple(spec) + (None,) * len(shape)):
+        size = 1
+        if axes is not None:
+            for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                size *= int(mesh.shape[ax])
+        n *= -(-int(dim) // size)
+    return n
+
+
+def tree_shard_nbytes(tree, mesh) -> int:
+    """Per-device peak bytes of ``tree`` sharded by the TP rules.
+
+    Sums, over all leaves, the largest single shard each leaf
+    contributes to one device. With ``model=1`` every spec degenerates
+    to replication and this equals the plain whole-tree byte count, so
+    callers can use it unconditionally."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        total += _leaf_shard_nbytes(spec_for_path(path, leaf), leaf, mesh)
+    return total
+
+
 def data_sharding(mesh) -> NamedSharding:
     """Batch/bank sharding: leading dim over the data axis."""
     return NamedSharding(mesh, P(DATA_AXIS))
@@ -108,9 +141,27 @@ def shard_index_pool(pool, bank_n: int, mesh):
 def shard_bank(bank_rays, bank_rgbs, mesh):
     """Place the ray bank sharded over the data axis (each chip holds
     1/n of the rays — memory scaling the reference's full-bank-per-GPU
-    precompute lacks, blender.py:105-108). Truncates to a divisible size."""
-    n_data = mesh.shape[DATA_AXIS]
-    n = (bank_rays.shape[0] // n_data) * n_data
+    precompute lacks, blender.py:105-108). Truncates to a divisible
+    size, and says so: any dropped tail is announced on stdout and as a
+    ``bank_shard`` telemetry row (the "no silent caps" rule)."""
+    n_data = int(mesh.shape[DATA_AXIS])
+    total = int(bank_rays.shape[0])
+    n = (total // n_data) * n_data
+    dropped = total - n
+    if dropped:
+        print(
+            f"[shard_bank] bank of {total} rays truncated to {n} "
+            f"({dropped} dropped) to divide over {n_data} data shards"
+        )
+    from ..obs import get_emitter
+
+    get_emitter().emit(
+        "bank_shard",
+        n_rays=total,
+        n_kept=n,
+        n_dropped=dropped,
+        n_shards=n_data,
+    )
     sh = data_sharding(mesh)
     return (
         jax.device_put(bank_rays[:n], sh),

@@ -228,7 +228,16 @@ def render_rays(
     point coordinate, so ``xyz_encoder`` receives the ``(x, y, z, t)`` the
     dynamic encoder family (models/encoding/dynamic.py) consumes. Static
     3-D encoders must be paired with 6-column rays — the extra coordinate
-    is a shape-static trace-time property, never a runtime branch."""
+    is a shape-static trace-time property, never a runtime branch.
+
+    Under model-parallel serving (``scale.mesh_shape`` with M > 1,
+    scale/mesh_dispatch.py) ``apply_fn`` closes over a params tree
+    sharded by parallel/sharding.py's partition rules. This body must
+    stay placement-agnostic: XLA inserts the model-axis collectives
+    inside ``apply_fn``, and everything downstream of the raw network
+    outputs (weights, compositing) sees replicated activations — so the
+    serve path reuses these exact bodies sharded, and any future edit
+    that branches on concrete array placement here would break them."""
     if options.sampling.mode == "proposal":
         return proposal_render_rays(
             apply_fn, rays, near, far, key, options, step=step

@@ -49,7 +49,12 @@ from .mesh_dispatch import (
     mesh_jit,
     validate_mesh_buckets,
 )
-from .options import PlacementOptions, ScaleOptions
+from .options import (
+    MeshShapeError,
+    PlacementOptions,
+    ScaleOptions,
+    parse_mesh_shape,
+)
 from .placement import (
     PlacementExecutor,
     PlacementMove,
@@ -71,6 +76,7 @@ __all__ = [
     "InProcessReplica",
     "LaunchError",
     "MeshDispatchError",
+    "MeshShapeError",
     "NoCapableReplicaError",
     "NoReplicaAvailableError",
     "PlacementExecutor",
@@ -91,5 +97,6 @@ __all__ = [
     "merge_scrapes",
     "mesh_from_scale_cfg",
     "mesh_jit",
+    "parse_mesh_shape",
     "validate_mesh_buckets",
 ]

@@ -17,9 +17,25 @@ free and the parity exact. The cost is a divisibility constraint:
 ``bucket // chunk`` must divide by the mesh's data size
 (:func:`validate_mesh_buckets` rejects a config that would silently
 pad or gather at engine construction, not at request time).
+
+**Model parallelism** (``scale.mesh_shape: [D, M]`` with ``M > 1``)
+switches :func:`mesh_jit` from the collective-free ``shard_map`` path to
+GSPMD: the param tree is sharded by ``parallel/sharding.py``'s partition
+rules (hash/embedding tables row-sharded over ``model``, MLP hidden
+width column-parallel, output heads replicated) and XLA inserts the
+collectives — an all-gather (or psum of partial features) at the sharded
+encoder table lookup, all-gathers around the column-parallel matmuls.
+Ray chunks still split whole-chunks over ``data``. Collectives reorder
+float math, so the M>1 path promises allclose, not bitwise; ``M == 1``
+keeps the exact shard_map path, which is tier-1's parity bar. The win is
+capacity: each device holds ~1/M of the scene's params, so a scene
+larger than one chip's HBM budget becomes servable (docs/scaleout.md
+"Model-parallel serving").
 """
 
 from __future__ import annotations
+
+from .options import MeshShapeError  # noqa: F401  (re-export; raised here too)
 
 
 class MeshDispatchError(ValueError):
@@ -30,32 +46,64 @@ def validate_mesh_buckets(buckets, chunk: int, mesh) -> None:
     """Reject bucket sets whose chunk counts don't divide over the mesh.
 
     Called at engine construction (install time), so a bad
-    ``serve.buckets`` / ``scale.mesh`` combination fails loudly before
-    warm-up instead of as a mid-request reshard."""
-    from ..parallel.mesh import DATA_AXIS
+    ``serve.buckets`` / ``scale.mesh``/``mesh_shape`` combination fails
+    loudly before warm-up instead of as a mid-request reshard. Only the
+    DATA axis constrains the ray layout — the model axis shards params,
+    not chunks — but the error names the full 2-D shape so the operator
+    sees which mesh the layout failed against."""
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
 
-    n_dev = int(mesh.shape[DATA_AXIS])
-    bad = [int(b) for b in buckets if (int(b) // int(chunk)) % n_dev]
+    n_data = int(mesh.shape[DATA_AXIS])
+    n_model = int(mesh.shape.get(MODEL_AXIS, 1))
+    bad = [int(b) for b in buckets if (int(b) // int(chunk)) % n_data]
     if bad:
         raise MeshDispatchError(
-            f"buckets {bad} have chunk counts not divisible by the mesh "
-            f"data size {n_dev} (chunk={chunk}); adjust serve.buckets so "
-            f"every bucket holds a multiple of {n_dev} chunks"
+            f"buckets {bad} have chunk counts not divisible by the data "
+            f"size {n_data} of the ({n_data}, {n_model}) mesh "
+            f"(chunk={chunk}); adjust serve.buckets so every bucket "
+            f"holds a multiple of {n_data} chunks"
         )
 
 
-def mesh_jit(body, mesh, has_grid: bool):
+def model_size(mesh) -> int:
+    """The mesh's model-axis extent (1 when absent or mesh is None)."""
+    if mesh is None:
+        return 1
+    from ..parallel.mesh import MODEL_AXIS
+
+    return int(mesh.shape.get(MODEL_AXIS, 1))
+
+
+def mesh_jit(body, mesh, has_grid: bool, params_template=None):
     """``jax.jit`` of ``body`` with its chunk axis sharded over ``mesh``.
 
     ``body`` is the engine's UN-jitted executable body — signature
     ``(params, chunks[, grid, bbox]) -> dict`` with every output leaf
-    carrying the ``n_chunks`` leading axis. Params/grid/bbox replicate
-    (``P()``); chunks and outputs shard over the data axis."""
+    carrying the ``n_chunks`` leading axis.
+
+    With a size-1 model axis, params/grid/bbox replicate (``P()``) and
+    chunks/outputs shard over the data axis under ``shard_map`` — the
+    collective-free, bitwise path. With ``model > 1``,
+    ``params_template`` (any pytree with the executable's param
+    shapes/dtypes — abstract leaves fine) selects the GSPMD path: params
+    carry the TP-rule shardings and the body is vmapped over
+    data-axis-sized groups of chunks so ``lax.map`` stays per-device
+    sequential instead of serializing across the sharded chunk axis.
+    """
     import jax
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS
+
+    if model_size(mesh) > 1:
+        if params_template is None:
+            raise MeshDispatchError(
+                "mesh_jit needs a params_template to derive partition "
+                "specs when the mesh has a model axis > 1"
+            )
+        return _mesh_jit_sharded(body, mesh, has_grid, params_template)
+
+    from ..parallel.compat import shard_map
 
     rep, data = P(), P(DATA_AXIS)
     in_specs = (rep, data) + ((rep, rep) if has_grid else ())
@@ -63,9 +111,45 @@ def mesh_jit(body, mesh, has_grid: bool):
     # chunks shard; params replicate), and the replication checker costs
     # trace time without adding safety here
     mapped = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=data,
-                       check_rep=False)
+                       check_vma=False)
     # graftlint: ok(aot: the engine warm path registers every finalized executable with AOTRegistry)
     return jax.jit(mapped)
+
+
+def _mesh_jit_sharded(body, mesh, has_grid: bool, params_template):
+    """The GSPMD model-parallel finalizer (``mesh_shape`` M > 1).
+
+    The body's ``lax.map`` over the chunk axis is a scan — under plain
+    GSPMD jit, a scan over a sharded axis would serialize and replicate.
+    So the wrapper reshapes ``[n, chunk, C] -> [D, n/D, chunk, C]`` and
+    ``vmap``s the body over the leading data-group axis: the vmapped
+    dimension shards cleanly over ``data`` (each device group runs its
+    own sequential ``lax.map`` over n/D chunks, exactly shard_map's
+    schedule), while inside the body XLA places the model-axis
+    collectives the sharded params demand. ``validate_mesh_buckets``
+    guarantees ``D | n`` at engine construction."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.sharding import tree_shardings
+
+    n_data = int(mesh.shape[DATA_AXIS])
+    param_sh = tree_shardings(params_template, mesh)
+    rep = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(DATA_AXIS))
+    in_sh = (param_sh, data) + ((rep, rep) if has_grid else ())
+
+    def wrapped(params, chunks, *rest):
+        n = chunks.shape[0]
+        groups = chunks.reshape((n_data, n // n_data) + chunks.shape[1:])
+        out = jax.vmap(lambda ch: body(params, ch, *rest))(groups)
+        return jax.tree.map(
+            lambda a: a.reshape((n,) + a.shape[2:]), out
+        )
+
+    # graftlint: ok(aot: the engine warm path registers every finalized executable with AOTRegistry)
+    return jax.jit(wrapped, in_shardings=in_sh, out_shardings=data)
 
 
 def mesh_from_scale_cfg(cfg):
@@ -75,10 +159,14 @@ def mesh_from_scale_cfg(cfg):
     builds the data-parallel mesh only when more than one device is
     visible (so CPU tier-1 and single-chip serving keep the default
     path); ``"force"`` builds it even on one device — the parity-test
-    and bring-up configuration."""
+    and bring-up configuration. ``scale.mesh_shape: [D, M]`` picks an
+    explicit 2-D layout (``D = -1`` means all remaining devices); it
+    must factor over the visible devices or :class:`MeshShapeError`
+    says exactly what didn't fit."""
     from .options import ScaleOptions
 
-    mode = ScaleOptions.from_cfg(cfg).mesh
+    opts = ScaleOptions.from_cfg(cfg)
+    mode = opts.mesh
     if mode not in ("off", "auto", "force"):
         raise MeshDispatchError(
             f"scale.mesh must be off|auto|force, got {mode!r}"
@@ -87,10 +175,31 @@ def mesh_from_scale_cfg(cfg):
         return None
     import jax
 
-    if mode == "auto" and len(jax.devices()) <= 1:
+    n_dev = len(jax.devices())
+    if mode == "auto" and n_dev <= 1:
         return None
     from ..parallel.mesh import make_mesh
 
-    # data-parallel only: every device on the data axis (model_axis=1),
-    # matching the replicated-params partition rules the serve path uses
-    return make_mesh(data_axis=-1, model_axis=1)
+    if opts.mesh_shape is None:
+        # data-parallel only: every device on the data axis (model_axis=1),
+        # matching the replicated-params partition rules the serve path uses
+        return make_mesh(data_axis=-1, model_axis=1)
+    d, m = opts.mesh_shape
+    if n_dev % m:
+        raise MeshShapeError(
+            f"scale.mesh_shape ({d}, {m}): model size {m} does not "
+            f"divide the {n_dev} visible devices"
+        )
+    want = (n_dev // m if d == -1 else d) * m
+    if want > n_dev:
+        raise MeshShapeError(
+            f"scale.mesh_shape ({d}, {m}) needs {want} devices, only "
+            f"{n_dev} visible"
+        )
+    try:
+        return make_mesh(data_axis=d, model_axis=m)
+    except ValueError as e:
+        raise MeshShapeError(
+            f"scale.mesh_shape ({d}, {m}) does not factor over the "
+            f"{n_dev} visible devices: {e}"
+        ) from None

@@ -6,6 +6,54 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 
+class MeshShapeError(ValueError):
+    """``scale.mesh_shape`` is malformed or doesn't factor over devices.
+
+    Defined here (not mesh_dispatch) so config parsing can raise it
+    without importing the dispatch layer; mesh_dispatch re-exports it."""
+
+
+def parse_mesh_shape(raw) -> "tuple[int, int] | None":
+    """Parse a ``scale.mesh_shape: [D, M]`` value.
+
+    ``None``/missing means "let ``scale.mesh`` decide" (the pre-2-D
+    behaviour: all devices on data, model=1). ``D`` may be ``-1`` for
+    "all remaining devices after carving M-wide model groups". Every
+    malformed value raises :class:`MeshShapeError` naming the offence —
+    a sharded-serving misconfiguration must never quietly fall back to
+    replication."""
+    if raw is None:
+        return None
+    if isinstance(raw, str):
+        parts = [p for p in raw.replace(",", " ").split() if p]
+    elif isinstance(raw, (list, tuple)):
+        parts = list(raw)
+    else:
+        raise MeshShapeError(
+            f"scale.mesh_shape must be a [D, M] pair, got {type(raw).__name__} {raw!r}"
+        )
+    if len(parts) != 2:
+        raise MeshShapeError(
+            f"scale.mesh_shape must have exactly 2 entries [data, model], got {raw!r}"
+        )
+    try:
+        d, m = (int(p) for p in parts)
+    except (TypeError, ValueError):
+        raise MeshShapeError(
+            f"scale.mesh_shape entries must be integers, got {raw!r}"
+        ) from None
+    if m < 1:
+        raise MeshShapeError(
+            f"scale.mesh_shape model size must be >= 1, got {m} (from {raw!r})"
+        )
+    if d != -1 and d < 1:
+        raise MeshShapeError(
+            f"scale.mesh_shape data size must be -1 (all remaining) or >= 1, "
+            f"got {d} (from {raw!r})"
+        )
+    return (d, m)
+
+
 @dataclass(frozen=True)
 class PlacementOptions:
     """The ``scale.placement:`` sub-block: the planner's policy knobs.
@@ -77,6 +125,11 @@ class ScaleOptions:
     # "force" builds the mesh path even on one device (the parity/test
     # configuration); "off" keeps plain jax.jit.
     mesh: str = "off"
+    # 2-D mesh shape [data, model] for model-parallel serving. None
+    # keeps the 1-D default (all devices on data). model > 1 shards the
+    # param tree by parallel/sharding._TP_RULES: embedding/hash tables
+    # row-sharded, MLP width column-parallel, heads replicated.
+    mesh_shape: "tuple[int, int] | None" = None
     # scene placement planner (scale/placement.py)
     placement: PlacementOptions = field(default_factory=PlacementOptions)
 
@@ -98,6 +151,7 @@ class ScaleOptions:
             heartbeat_timeout_s=float(s.get("heartbeat_timeout_s", 10.0)),
             drain_timeout_s=float(s.get("drain_timeout_s", 60.0)),
             mesh=str(s.get("mesh", "off")),
+            mesh_shape=parse_mesh_shape(s.get("mesh_shape", None)),
             placement=PlacementOptions.from_cfg_block(
                 s.get("placement", {})),
         )

@@ -242,6 +242,12 @@ class PlacementPlanner:
         order = sorted(scenes, key=lambda s: (-rps(s), s))
         used = {r: 0.0 for r in rids}
         budget = {r: self._budget(states[r]) for r in rids}
+        # a mesh-backed replica serving model-parallel (param_shards > 1)
+        # holds only ~1/shards of a scene's params per device, so its
+        # budget packs that fraction — a scene a replicated copy would
+        # overflow can still be planned onto a sharded replica
+        shards = {r: max(1, int(states[r].get("param_shards", 1)))
+                  for r in rids}
         assignments: dict[str, tuple] = {}
         for s in order:
             nbytes = self._scene_bytes(s, states)
@@ -253,9 +259,10 @@ class PlacementPlanner:
             for r in ranked:
                 if len(chosen) >= width:
                     break
-                if used[r] + nbytes <= budget[r]:
+                eff = -(-nbytes // shards[r])
+                if used[r] + eff <= budget[r]:
                     chosen.append(r)
-                    used[r] += nbytes
+                    used[r] += eff
             if chosen:
                 assignments[s] = tuple(sorted(chosen))
         moves = self._moves(order, assignments, resident, staged, publishes)

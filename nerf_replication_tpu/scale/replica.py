@@ -139,6 +139,9 @@ class InProcessReplica:
                 staging_bytes=int(fs.get("staging_bytes", 0)),
                 hbm_budget_bytes=int(fs.get("budget_bytes", 0)),
                 staging_budget_bytes=int(fs.get("staging_budget_bytes", 0)),
+                # model-parallel serving (scale.mesh_shape): the planner
+                # packs 1/shards of each scene's bytes onto this replica
+                param_shards=int(fs.get("param_shards", 1)),
             )
         return beat
 
@@ -327,6 +330,7 @@ class ProcessReplica:
             "staging_bytes": int(rep.get("staging_bytes", 0)),
             "hbm_budget_bytes": int(rep.get("hbm_budget_bytes", 0)),
             "staging_budget_bytes": int(rep.get("staging_budget_bytes", 0)),
+            "param_shards": int(rep.get("param_shards", 1)),
             # tracing health rides the heartbeat for free (spans emitted,
             # sink drops, remote-parented count) — serve.py /healthz
             "trace": dict(rep.get("trace", {})),

@@ -403,6 +403,7 @@ class Router:
                 "hbm_budget_bytes": int(b.get("hbm_budget_bytes", 0)),
                 "staging_budget_bytes": int(
                     b.get("staging_budget_bytes", 0)),
+                "param_shards": int(b.get("param_shards", 1)),
             }
         return out
 

@@ -154,12 +154,35 @@ class RenderEngine:
         # forced) keeps the plain single-device jit path
         self.mesh = mesh
         self._chunks_sharding = None
+        self._model_parallel = False
         if mesh is not None:
             from ..parallel.sharding import chunk_sharding
-            from ..scale.mesh_dispatch import validate_mesh_buckets
+            from ..scale.mesh_dispatch import model_size, validate_mesh_buckets
 
             validate_mesh_buckets(self.buckets, self.chunk, mesh)
             self._chunks_sharding = chunk_sharding(mesh)
+            # model-parallel serving (mesh_shape [D, M] with M > 1): the
+            # param tree shards by the TP rules, so placement must follow
+            # the specs — set_params / the fleet placer do the device_put
+            self._model_parallel = model_size(mesh) > 1
+            if self._model_parallel:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel.sharding import tree_shardings
+
+                # place the engine's own checkpoint by the partition
+                # rules NOW: leaving it whole would hold the full
+                # replicated copy on device 0 and re-shard on every
+                # dispatch — per-device peak bytes must be the shard
+                self.params = jax.device_put(
+                    params, tree_shardings(params, mesh)
+                )
+                rep = NamedSharding(mesh, P())
+                if self.grid is not None:
+                    self.grid = jax.device_put(self.grid, rep)
+                if self.bbox is not None:
+                    self.bbox = jax.device_put(self.bbox, rep)
         self.tracker = tracker or CompileTracker()
         self.cache = PoseCache(
             capacity=self.options.cache_entries,
@@ -255,10 +278,13 @@ class RenderEngine:
 
     def _finalize_fn(self, fn):
         """Jit an executable body: plain ``jax.jit`` on the single-device
-        path, or the mesh-sharded wrapper (chunks over the data axis,
-        params/grid replicated) when a serving mesh is installed — the
-        body is identical either way, which is why the mesh render stays
-        bitwise-equal to the single-device one."""
+        path, or the mesh-sharded wrapper when a serving mesh is
+        installed. With a size-1 model axis, chunks shard over the data
+        axis and params/grid replicate — the body is identical either
+        way, which is why that mesh render stays bitwise-equal to the
+        single-device one. With model > 1, the params template routes
+        mesh_jit onto the GSPMD path (TP-rule-sharded params, XLA-placed
+        collectives; allclose, not bitwise)."""
         import jax
 
         if self.mesh is None:
@@ -266,7 +292,8 @@ class RenderEngine:
             return jax.jit(fn)
         from ..scale.mesh_dispatch import mesh_jit
 
-        return mesh_jit(fn, self.mesh, has_grid=self.use_grid)
+        return mesh_jit(fn, self.mesh, has_grid=self.use_grid,
+                        params_template=self.params)
 
     def _build_fn(self, bucket: int, family: str):
         import jax
@@ -403,12 +430,27 @@ class RenderEngine:
 
         return self._finalize_fn(fn)
 
+    def _fn_name(self, bucket: int, family: str) -> str:
+        """Registry/tracker name for one executable. A model-parallel
+        mesh bakes its shape into the name: a sharded lowering is a
+        DIFFERENT artifact from the replicated one (different layouts,
+        different collectives), so the two must never share an AOT
+        artifact-store slot."""
+        base = f"serve/{family}/b{bucket}"
+        if self._model_parallel:
+            from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+            d = int(self.mesh.shape[DATA_AXIS])
+            m = int(self.mesh.shape[MODEL_AXIS])
+            return f"{base}/mesh{d}x{m}"
+        return base
+
     def _get_fn(self, bucket: int, family: str):
         key = (bucket, family)
         fn = self._fns.get(key)
         if fn is None:
             fn = self.tracker.wrap(
-                f"serve/{family}/b{bucket}", self._build_fn(bucket, family)
+                self._fn_name(bucket, family), self._build_fn(bucket, family)
             )
             self._fns[key] = fn
         return fn
@@ -444,13 +486,37 @@ class RenderEngine:
                 (abstract_like(self.grid), abstract_like(self.bbox))
                 if self.use_grid else ()
             )
+            chunks_sh = None
+            if self._model_parallel:
+                # sharded warm-up signatures: the abstract leaves carry
+                # the SAME shardings runtime placement uses (set_params /
+                # the fleet placer), so the AOT-compiled layout is the
+                # one requests hit — zero steady-state recompiles with
+                # sharding on, same bar as the replicated path
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel.sharding import tree_shardings
+
+                params_abs = jax.tree.map(
+                    lambda a, s: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype, sharding=s
+                    ),
+                    params_abs, tree_shardings(params_abs, self.mesh),
+                )
+                rep = NamedSharding(self.mesh, P())
+                static_abs = tuple(
+                    jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep)
+                    for a in static_abs
+                )
+                chunks_sh = self._chunks_sharding
             names = {}
             for bucket in self.buckets:
                 chunks_abs = jax.ShapeDtypeStruct(
-                    (bucket // self.chunk, self.chunk, 6), jnp.float32
+                    (bucket // self.chunk, self.chunk, 6), jnp.float32,
+                    sharding=chunks_sh,
                 )
                 for family in families:
-                    name = f"serve/{family}/b{bucket}"
+                    name = self._fn_name(bucket, family)
                     names[(bucket, family)] = name
                     self.aot.register(
                         name, self._build_fn(bucket, family),
@@ -484,10 +550,73 @@ class RenderEngine:
     def set_params(self, params) -> None:
         """Install real checkpoint weights — engine_from_cfg calls this
         AFTER warm-up, so a disk-cache-hit restart is serving-ready before
-        the model finishes loading."""
+        the model finishes loading. Under a model-parallel mesh the
+        weights land directly in their TP-rule shards (one placement; the
+        executables' in_shardings then match without any reshard)."""
         import jax
 
-        self.params = jax.device_put(params)
+        if self._model_parallel:
+            from ..parallel.sharding import tree_shardings
+
+            self.params = jax.device_put(
+                params, tree_shardings(params, self.mesh)
+            )
+        else:
+            self.params = jax.device_put(params)
+
+    def place_scene_tree(self, tree):
+        """Place a scene's ``(params, grid, bbox)`` host tree on the
+        serving mesh: params by the TP partition rules, grid/bbox
+        replicated. The fleet residency manager calls this (installed by
+        :meth:`attach_fleet`) so admitted scenes land in the SAME layout
+        the warmed executables were compiled for. Without a
+        model-parallel mesh this is a plain ``device_put`` — the
+        single-device fleet path is bitwise-unchanged."""
+        import jax
+
+        if not self._model_parallel:
+            return jax.tree.map(jax.device_put, tree)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import tree_shardings
+
+        params, grid, bbox = tree
+        rep = NamedSharding(self.mesh, P())
+        params = jax.device_put(params, tree_shardings(params, self.mesh))
+        grid = None if grid is None else jax.device_put(grid, rep)
+        bbox = None if bbox is None else jax.device_put(bbox, rep)
+        return (params, grid, bbox)
+
+    def scene_shard_nbytes(self, tree) -> int:
+        """Per-device peak bytes ``tree`` will occupy once placed by
+        :meth:`place_scene_tree` — the figure HBM admission checks.
+        Derived from the partition specs (no placement happens here)."""
+        import jax
+
+        if not self._model_parallel:
+            return sum(
+                leaf.nbytes for leaf in jax.tree.leaves(tree)
+                if hasattr(leaf, "nbytes")
+            )
+        from ..parallel.sharding import tree_shard_nbytes
+
+        params, grid, bbox = tree
+        replicated = sum(
+            leaf.nbytes for leaf in jax.tree.leaves((grid, bbox))
+            if hasattr(leaf, "nbytes")
+        )
+        return tree_shard_nbytes(params, self.mesh) + replicated
+
+    @property
+    def param_shards(self) -> int:
+        """How many ways scene params split across devices (1 =
+        replicated). Reported in stats/heartbeats so the placement
+        planner can budget-pack with per-shard bytes."""
+        if not self._model_parallel:
+            return 1
+        from ..scale.mesh_dispatch import model_size
+
+        return model_size(self.mesh)
 
     # -- multi-scene residency (fleet/) --------------------------------------
 
@@ -500,6 +629,12 @@ class RenderEngine:
         rejected at load, so the zero-steady-state-recompile invariant
         holds across arbitrary scene churn."""
         residency.validate = self._check_scene_compat
+        # sharded placement: scenes land by the engine's partition rules
+        # and admission budgets against per-shard (not replicated) bytes;
+        # on a mesh-less engine both hooks reduce to the classic behavior
+        residency.placer = self.place_scene_tree
+        residency.shard_nbytes = self.scene_shard_nbytes
+        residency.param_shards = self.param_shards
         self.fleet = residency
         self.default_scene = str(default_scene)
 
@@ -867,6 +1002,8 @@ class RenderEngine:
             "mesh": None if self.mesh is None else {
                 "devices": int(self.mesh.size),
                 "axes": dict(self.mesh.shape),
+                "model_parallel": self._model_parallel,
+                "param_shards": self.param_shards,
             },
             "cache": self.cache.stats(),
             # multi-scene residency (None = single-tenant serving)

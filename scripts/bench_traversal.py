@@ -33,6 +33,13 @@ re-dispatch loops measure impossibly fast on this machine).
 
     python scripts/bench_traversal.py [--rays 1024] [--iters 4]
         [--fused] [--out BENCH_TRAVERSAL.jsonl]
+
+``--mesh-shape D,M`` switches the script to the model-parallel serving
+A/B instead (``shard_mode`` rows): a replicated arm on a ``(D*M, 1)``
+data-only mesh vs the sharded arm on ``(D, M)`` over the same devices,
+recording rays/s and the REAL per-device peak param bytes measured from
+placement — the capacity claim (docs/scaleout.md) next to the
+throughput claim.
 """
 
 from __future__ import annotations
@@ -56,6 +63,140 @@ def build_grid(xp, resolution: int, radius: float):
     return (x * x + y * y + z * z) < radius * radius
 
 
+def _run_shard_bench(args, sink, platform) -> int:
+    """The ``--mesh-shape`` arm: model-parallel hash-grid serving A/B.
+
+    The params tree is synthetic but hash-table-dominated with leaf
+    names matching ``parallel/sharding.py``'s partition rules, so the
+    sharded arm exercises the REAL serve-path collectives: the
+    row-sharded table gather at the encoder lookup and the
+    column-parallel trunk matmuls. Both arms finalize their executable
+    through the production :func:`scale.mesh_dispatch.mesh_jit` — the
+    replicated arm takes the collective-free shard_map path (``M=1``),
+    the sharded arm the GSPMD path. Per-device peak param bytes are
+    measured from placement (largest addressable shard per leaf), not
+    modeled."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nerf_replication_tpu.parallel.mesh import make_mesh
+    from nerf_replication_tpu.parallel.sharding import tree_shardings
+    from nerf_replication_tpu.scale.mesh_dispatch import mesh_jit
+    from nerf_replication_tpu.scale.options import parse_mesh_shape
+
+    d, m = parse_mesh_shape(args.mesh_shape)
+    n_dev = len(jax.devices())
+    if d == -1:
+        d = max(1, n_dev // m)
+    if d * m > n_dev:
+        print(f"error: --mesh-shape {d},{m} needs {d * m} devices, "
+              f"only {n_dev} visible", file=sys.stderr)
+        return 2
+
+    # hash/embedding table dominates the byte budget (the part model
+    # parallelism exists to split); trunk + head ride the same TP rules
+    # the engine's real checkpoints hit
+    T, F, W = args.table_rows, 8, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 6)
+    params = {"params": {
+        "table": {"embeddings": jax.random.normal(ks[0], (T, F), jnp.float32)},
+        "pts_linear_0": {
+            "kernel": jax.random.normal(ks[1], (F + 3, W), jnp.float32) * 0.1,
+            "bias": jnp.zeros((W,), jnp.float32)},
+        "pts_linear_1": {
+            "kernel": jax.random.normal(ks[2], (W, W), jnp.float32) * 0.1,
+            "bias": jnp.zeros((W,), jnp.float32)},
+        "rgb_linear": {
+            "kernel": jax.random.normal(ks[3], (W, 4), jnp.float32) * 0.1,
+            "bias": jnp.zeros((4,), jnp.float32)},
+    }}
+    total_b = int(sum(a.nbytes for a in jax.tree.leaves(params)))
+
+    def body(p, chunks):
+        table = p["params"]["table"]["embeddings"]
+
+        def one(ch):  # [chunk, 6] rays -> [chunk, 4] radiance
+            pts = ch[:, :3]
+            # integer spatial hash on quantized coords (the NGP idiom):
+            # float-domain hashes truncate at ~1e5 magnitude where a
+            # 1-ulp fusion difference between the sharded and reference
+            # lowerings flips the index — integer ops are exact
+            xi = jnp.round(pts * 512.0).astype(jnp.int32)
+            idx = ((xi[:, 0] * 73856093) ^ (xi[:, 1] * 19349663)
+                   ^ (xi[:, 2] * 83492791)) % T
+            h = jnp.concatenate([table[idx], pts], axis=-1)
+            for name in ("pts_linear_0", "pts_linear_1"):
+                lin = p["params"][name]
+                h = jax.nn.relu(h @ lin["kernel"] + lin["bias"])
+            head = p["params"]["rgb_linear"]
+            return h @ head["kernel"] + head["bias"]
+
+        return {"rgb": jax.lax.map(one, chunks)}
+
+    chunk = 64
+    group = d * m  # both arms need the chunk count divisible by their D
+    n_chunks = max(group, (args.rays // chunk // group) * group)
+    n_rays = n_chunks * chunk
+    rays = jax.random.normal(ks[4], (n_rays, 6), jnp.float32)
+    chunks = np.asarray(rays).reshape(n_chunks, chunk, 6)
+
+    ref = np.asarray(jax.block_until_ready(
+        jax.jit(body)(params, jnp.asarray(chunks)))["rgb"])
+
+    def per_device_bytes(tree):
+        return int(sum(max(s.data.nbytes for s in leaf.addressable_shards)
+                       for leaf in jax.tree.leaves(tree)))
+
+    rows = []
+    for mode, shape in (("replicated", (group, 1)), ("sharded", (d, m))):
+        mesh = make_mesh(data_axis=shape[0], model_axis=shape[1])
+        if shape[1] > 1:
+            placed = jax.device_put(params, tree_shardings(params, mesh))
+        else:
+            placed = jax.device_put(params, NamedSharding(mesh, P()))
+        fn = mesh_jit(body, mesh, has_grid=False, params_template=placed)
+        ch = jax.device_put(jnp.asarray(chunks),
+                            NamedSharding(mesh, P("data")))
+        out = jax.block_until_ready(fn(placed, ch))  # compile
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(placed, ch)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        rows.append({
+            "shard_mode": mode,
+            "mesh_shape": list(shape),
+            "rays_per_s": n_rays * args.iters / dt,
+            "param_bytes_per_device": per_device_bytes(placed),
+            "param_bytes_total": total_b,
+            "allclose": bool(np.allclose(np.asarray(out["rgb"]), ref,
+                                         atol=1e-5, rtol=1e-5)),
+            "platform": platform,
+            "n_rays": int(n_rays),
+            "table_rows": int(T),
+            "iters": int(args.iters),
+        })
+    rows[1]["bytes_reduction_x"] = (
+        rows[0]["param_bytes_per_device"] / rows[1]["param_bytes_per_device"]
+    )
+    rc = 0
+    for row in rows:
+        sink.write(json.dumps(row) + "\n")
+        print(
+            f"{row['shard_mode']:>10} mesh {tuple(row['mesh_shape'])}: "
+            f"rays/s {row['rays_per_s']:10.0f}  "
+            f"bytes/device {row['param_bytes_per_device']:>9}"
+            + (f"  reduction {row['bytes_reduction_x']:.2f}x"
+               if "bytes_reduction_x" in row else "")
+            + ("" if row["allclose"] else "  ALLCLOSE FAILED")
+        )
+        if not row["allclose"]:
+            rc = 1
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--rays", type=int, default=1024)
@@ -66,6 +207,12 @@ def main(argv=None):
     p.add_argument("--fused", action="store_true",
                    help="add the fused mega-kernel arm per regime")
     p.add_argument("--fused_block", type=int, default=256)
+    p.add_argument("--mesh-shape", dest="mesh_shape", default="",
+                   help="D,M: run the model-parallel serving A/B "
+                        "(shard_mode rows) instead of the traversal arms")
+    p.add_argument("--table-rows", dest="table_rows", type=int,
+                   default=1 << 15,
+                   help="rows of the synthetic hash table (shard bench)")
     p.add_argument("--force_platform", default=os.environ.get(
         "BENCH_FORCE_PLATFORM", ""))
     p.add_argument("--out", default=os.path.join(_REPO,
@@ -118,6 +265,12 @@ def main(argv=None):
 
     sink = open(args.out, "a")
     platform = jax.devices()[0].platform
+
+    if args.mesh_shape:
+        rc = _run_shard_bench(args, sink, platform)
+        sink.close()
+        print(f"wrote {args.out}")
+        return rc
 
     # Modeled peak intermediate bytes (HBM arrays live at once between the
     # admission structure and the composite — NOT weights or outputs):

@@ -15,8 +15,9 @@ version / hot-width attainment / budget compliance / unplanned-dispatch
 share; all written by scripts/serve_bench.py), the learned sampler's
 ``BENCH_SAMPLING.jsonl`` (family ``sampling_mode``, written by
 scripts/bench_sampling.py), and the traversal ledger
-``BENCH_TRAVERSAL.jsonl`` (family ``traversal_mode`` — flat /
-hierarchical / fused mega-kernel arms, written by
+``BENCH_TRAVERSAL.jsonl`` (families ``traversal_mode`` — flat /
+hierarchical / fused mega-kernel arms — and ``shard_mode``, the
+model-parallel serving A/B written by ``--mesh-shape``, both from
 scripts/bench_traversal.py) via the ``BENCH_*.jsonl`` pattern.
 
 Files named ``telemetry*.jsonl`` are checked row-by-row against the typed

@@ -344,6 +344,33 @@ def summarize(rows: list[dict]) -> dict:
                     / fus["peak_intermediate_bytes"]
                 )
 
+    # model-parallel serving A/B rows (bench_traversal.py --mesh-shape,
+    # same ledger file): replicated vs sharded params over the same
+    # devices. The last row per shard_mode is the current measurement;
+    # the per-device param byte reduction is the capacity claim --diff
+    # gates on (docs/scaleout.md "Model-parallel serving").
+    shard = [r for r in rows if "shard_mode" in r]
+    if shard:
+        by_mode: dict = {}
+        for r in shard:
+            by_mode[r["shard_mode"]] = r
+        for mode, r in by_mode.items():
+            summary[f"shard_{mode}_rays_per_s"] = r.get("rays_per_s")
+            summary[f"shard_{mode}_bytes_per_device"] = r.get(
+                "param_bytes_per_device"
+            )
+            summary[f"shard_{mode}_mesh_shape"] = r.get("mesh_shape")
+        sh, rep = by_mode.get("sharded"), by_mode.get("replicated")
+        if sh is not None:
+            red = sh.get("bytes_reduction_x")
+            if (red is None and rep is not None
+                    and rep.get("param_bytes_per_device")
+                    and sh.get("param_bytes_per_device")):
+                red = (rep["param_bytes_per_device"]
+                       / sh["param_bytes_per_device"])
+            summary["shard_bytes_reduction_x"] = red
+            summary["shard_allclose"] = sh.get("allclose")
+
     # learned-sampling rows (renderer/sampling.py proposal resampler):
     # fine-MLP evaluations per ray — the budget the proposal network cuts
     # — next to the PSNR it bought. Keys present only when the run emitted
@@ -835,6 +862,14 @@ def print_summary(summary: dict, label: str = "") -> None:
             print(f"    fused vs staged (carved): {spd:.2f}x rays/s"
                   + (f"  {byt:.2f}x fewer intermediate bytes"
                      if byt is not None else ""))
+    if summary.get("shard_sharded_bytes_per_device") is not None:
+        shape = summary.get("shard_sharded_mesh_shape")
+        red = summary.get("shard_bytes_reduction_x")
+        ok = summary.get("shard_allclose")
+        print(f"  model-parallel: mesh {shape}  "
+              f"bytes/device {_fmt_bytes(summary['shard_sharded_bytes_per_device'])}"
+              + (f"  reduction {red:.2f}x" if red is not None else "")
+              + ("" if ok is not False else "  ALLCLOSE FAILED"))
     if summary.get("sample_rows"):
         mode = summary.get("sampling_mode") or "n/a"
         fer = summary.get("sampling_fine_evals_per_ray")
@@ -1011,6 +1046,22 @@ def diff(base: dict, cand: dict, gate_pct: float) -> list[str]:
         flags.append(
             f"peak device memory grew {pct(a, b):+.1f}% "
             f"({_fmt_bytes(a)} -> {_fmt_bytes(b)})"
+        )
+    # model-parallel serving: the per-device param byte reduction IS the
+    # capacity claim — a candidate sharding less effectively re-inflates
+    # every device's resident bytes until big scenes stop fitting; a
+    # sharded arm that stopped matching the reference is wrong, not slow
+    a = base.get("shard_bytes_reduction_x")
+    b = cand.get("shard_bytes_reduction_x")
+    if a and b is not None and (a - b) / a * 100.0 > gate_pct:
+        flags.append(
+            f"model-parallel per-device byte reduction shrank "
+            f"{a:.2f}x -> {b:.2f}x"
+        )
+    if cand.get("shard_allclose") is False:
+        flags.append(
+            "model-parallel sharded arm diverged from the single-device "
+            "reference (allclose failed)"
         )
     a, b = base.get("final_psnr"), cand.get("final_psnr")
     if a is not None and b is not None and b < a - 0.1:

@@ -193,6 +193,7 @@ def make_server(engine, batcher, host: str = "127.0.0.1",
                     "hbm_budget_bytes": int(fs.get("budget_bytes", 0)),
                     "staging_budget_bytes": int(
                         fs.get("staging_budget_bytes", 0)),
+                    "param_shards": int(fs.get("param_shards", 1)),
                     # tracing health, surfaced to the router's heartbeat:
                     # spans emitted, sink drops, and how many spans
                     # parented under a propagated (router) ctx

@@ -1,0 +1,442 @@
+"""Model-parallel hash-grid sharding (PR 20): the 2-D ``(data, model)``
+mesh serving path. Covers the ``scale.mesh_shape`` knob end to end —
+typed config parsing, mesh construction, 2-D bucket validation — then
+the acceptance matrix: forced ``(D, M)`` CPU meshes render allclose to
+the single-device engine across executable families (bitwise for an
+``M=1`` shape, which must reproduce today's collective-free path), a
+scene whose replicated bytes exceed the HBM budget is admitted when
+sharded (and rejected when not), demote→re-promote through the
+residency ladder is bitwise with zero steady-state recompiles, the
+``shard_bank`` truncation telemetry, the ``shard_mode`` bench family,
+and the placement planner's per-shard budget packing. All CPU — the
+conftest's 8-device emulation makes every shard real."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from test_train import tiny_cfg
+
+from nerf_replication_tpu.datasets.procedural import generate_scene
+from nerf_replication_tpu.obs import validate_row
+from nerf_replication_tpu.scale import (
+    MeshDispatchError,
+    MeshShapeError,
+    ScaleOptions,
+    mesh_from_scale_cfg,
+    parse_mesh_shape,
+    validate_mesh_buckets,
+)
+from nerf_replication_tpu.scale.mesh_dispatch import model_size
+
+NEAR, FAR = 2.0, 6.0
+
+# chunk 16 so the 128-ray bucket holds 8 chunks — divisible by every
+# data-axis size exercised below (1, 4, 8)
+_TINY = [
+    "task_arg.render_step_size", "0.25",
+    "task_arg.max_march_samples", "16",
+    "task_arg.march_chunk_size", "16",
+    "serve.buckets", "[128]",
+    "serve.max_batch_rays", "128",
+    "compile.aot", "False",
+]
+
+
+@pytest.fixture(scope="module")
+def scene_root(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("scene_mp"))
+    generate_scene(root, scene="procedural", H=16, W=16, n_train=2, n_test=1)
+    return root
+
+
+def _grid_bbox(cfg):
+    bbox = np.asarray(cfg.train_dataset.scene_bbox, np.float32)
+    grid = np.zeros((16, 16, 16), bool)
+    grid[4:12, 4:12, 4:12] = True
+    return grid, bbox
+
+
+def _rays(n: int, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.concatenate(
+        [np.tile([0.0, 0.0, 4.0], (n, 1)),
+         np.array([0.0, 0.0, -1.0]) + rng.normal(0, 0.15, (n, 3))],
+        -1,
+    ).astype(np.float32)
+
+
+def _per_device_param_bytes(engine) -> int:
+    """REAL per-device peak param bytes, measured from placement (the
+    largest addressable shard of each leaf), not computed from specs."""
+    return sum(
+        max(s.data.nbytes for s in leaf.addressable_shards)
+        for leaf in jax.tree.leaves(engine.params)
+    )
+
+
+# -- mesh_shape parsing (satellite: ScaleOptions.from_cfg) -------------------
+
+
+def test_parse_mesh_shape_accepts_every_documented_spelling():
+    assert parse_mesh_shape(None) is None
+    assert parse_mesh_shape([1, 2]) == (1, 2)
+    assert parse_mesh_shape((4, 2)) == (4, 2)
+    assert parse_mesh_shape("4,2") == (4, 2)
+    assert parse_mesh_shape("4 2") == (4, 2)
+    assert parse_mesh_shape([-1, 2]) == (-1, 2)  # -1 = all remaining on data
+
+
+def test_parse_mesh_shape_raises_typed_errors():
+    with pytest.raises(MeshShapeError, match="pair"):
+        parse_mesh_shape(3)
+    with pytest.raises(MeshShapeError, match="exactly 2"):
+        parse_mesh_shape([1, 2, 3])
+    with pytest.raises(MeshShapeError, match="integers"):
+        parse_mesh_shape("a,b")
+    with pytest.raises(MeshShapeError, match="model size"):
+        parse_mesh_shape([4, 0])
+    with pytest.raises(MeshShapeError, match="data size"):
+        parse_mesh_shape([-2, 2])
+    assert issubclass(MeshShapeError, ValueError)  # config edge contract
+
+
+def test_scale_options_parse_mesh_shape_from_cfg(scene_root):
+    cfg = tiny_cfg(scene_root)
+    assert ScaleOptions.from_cfg(cfg).mesh_shape is None  # default off
+    cfg = tiny_cfg(scene_root, ["scale.mesh_shape", "[1, 2]"])
+    assert ScaleOptions.from_cfg(cfg).mesh_shape == (1, 2)
+
+
+def test_mesh_from_scale_cfg_honors_mesh_shape(scene_root):
+    n_dev = len(jax.devices())
+    cfg = tiny_cfg(scene_root, ["scale.mesh", "force",
+                                "scale.mesh_shape", "[1, 2]"])
+    mesh = mesh_from_scale_cfg(cfg)
+    assert dict(mesh.shape) == {"data": 1, "model": 2}
+    assert model_size(mesh) == 2
+    # -1 on data: all remaining devices after the model carve
+    mesh = mesh_from_scale_cfg(
+        tiny_cfg(scene_root, ["scale.mesh", "force",
+                              "scale.mesh_shape", "[-1, 2]"]))
+    assert dict(mesh.shape) == {"data": n_dev // 2, "model": 2}
+    # oversubscribed (D*M > devices) and indivisible model sizes are
+    # loud errors, never a quiet fallback to replication
+    bad_shapes = [f"[{n_dev}, 2]"]
+    if n_dev % 3:
+        bad_shapes.append("[-1, 3]")
+    for shape in bad_shapes:
+        with pytest.raises(MeshShapeError):
+            mesh_from_scale_cfg(
+                tiny_cfg(scene_root, ["scale.mesh", "force",
+                                      "scale.mesh_shape", shape]))
+
+
+def test_validate_mesh_buckets_checks_the_data_axis_of_2d_meshes():
+    class FakeMesh:
+        def __init__(self, d, m):
+            self.shape = {"data": d, "model": m}
+
+    validate_mesh_buckets([128], 16, FakeMesh(4, 2))   # 8 chunks % 4: fine
+    validate_mesh_buckets([128], 16, FakeMesh(8, 1))
+    with pytest.raises(MeshDispatchError) as ei:
+        validate_mesh_buckets([128], 16, FakeMesh(3, 2))  # 8 chunks % 3
+    assert "(3, 2)" in str(ei.value)  # the error names the 2-D mesh
+
+
+def test_tree_shard_nbytes_follows_the_partition_rules(scene_root):
+    from nerf_replication_tpu.parallel.sharding import tree_shard_nbytes
+
+    mesh = mesh_from_scale_cfg(
+        tiny_cfg(scene_root, ["scale.mesh", "force",
+                              "scale.mesh_shape", "[1, 2]"]))
+    tree = {
+        "params": {
+            "table": {"embeddings": np.zeros((64, 8), np.float32)},
+            "pts_linear_0": {"kernel": np.zeros((8, 16), np.float32),
+                             "bias": np.zeros((16,), np.float32)},
+            "rgb_linear": {"kernel": np.zeros((16, 3), np.float32)},
+        }
+    }
+    # table rows halve, trunk hidden width halves (kernel cols + bias),
+    # the head stays replicated
+    expect = (32 * 8 + 8 * 8 + 8 + 16 * 3) * 4
+    assert tree_shard_nbytes(tree, mesh) == expect
+    total = sum(a.nbytes for a in jax.tree.leaves(tree))
+    assert tree_shard_nbytes(tree, mesh) < total
+
+
+# -- parity matrix: sharded vs single-device ---------------------------------
+
+
+def test_mesh_shape_parity_matrix_and_byte_reduction(scene_root):
+    """The tentpole contract: forced ``(1, 2)`` and ``(4, 2)`` CPU meshes
+    render allclose to the single-device engine across families; a
+    forced ``M=1`` mesh_shape reproduces today's collective-free path
+    BITWISE; sharding holds zero steady-state recompiles; and the
+    per-device peak param bytes drop ~2x vs the replicated engine."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest 8-device CPU emulation")
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.models.nerf.network import init_params
+    from nerf_replication_tpu.serve import RenderEngine
+
+    cfg = tiny_cfg(scene_root, _TINY)
+    grid, bbox = _grid_bbox(cfg)
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    fams = ("full", "bf16")
+
+    plain = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                         grid=grid, bbox=bbox, warmup_families=fams)
+    sharded = {}
+    for shape in ("[1, 2]", "[4, 2]"):
+        mcfg = tiny_cfg(scene_root, _TINY + ["scale.mesh", "force",
+                                             "scale.mesh_shape", shape])
+        mesh = mesh_from_scale_cfg(mcfg)
+        assert model_size(mesh) == 2
+        sharded[shape] = RenderEngine(mcfg, network, params, near=NEAR,
+                                      far=FAR, grid=grid, bbox=bbox,
+                                      mesh=mesh, warmup_families=fams)
+        st = sharded[shape].stats()["mesh"]
+        assert st["model_parallel"] is True and st["param_shards"] == 2
+
+    # M=1 forced shape: today's shard_map path, must stay bitwise
+    m1cfg = tiny_cfg(scene_root, _TINY + ["scale.mesh", "force",
+                                          "scale.mesh_shape", "[8, 1]"])
+    m1mesh = mesh_from_scale_cfg(m1cfg)
+    assert model_size(m1mesh) == 1
+    m1 = RenderEngine(m1cfg, network, params, near=NEAR, far=FAR,
+                      grid=grid, bbox=bbox, mesh=m1mesh,
+                      warmup_families=("full",))
+    assert m1.stats()["mesh"]["model_parallel"] is False
+
+    for n in (37, 128):
+        rays = _rays(n)
+        for tier in fams:
+            a = plain.render_request(rays, NEAR, FAR, tier=tier, emit=False)
+            for shape, eng in sharded.items():
+                b = eng.render_request(rays, NEAR, FAR, tier=tier,
+                                       emit=False)
+                for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+                    assert np.allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                       atol=1e-5, rtol=1e-5), (shape, tier,
+                                                               k, n)
+        c = m1.render_request(rays, NEAR, FAR, tier="full", emit=False)
+        a = plain.render_request(rays, NEAR, FAR, tier="full", emit=False)
+        for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+            assert np.array_equal(np.asarray(a[k]), np.asarray(c[k])), (k, n)
+
+    # zero steady-state recompiles with sharding on
+    eng = sharded["[1, 2]"]
+    before = eng.tracker.total_compiles()
+    for n in (1, 64, 128, 200):
+        eng.render_request(np.tile(_rays(1), (n, 1)), NEAR, FAR,
+                           tier="full", emit=False)
+    assert eng.tracker.total_compiles() == before
+
+    # the acceptance bar: >= 1.8x lower per-device peak param bytes
+    rep = _per_device_param_bytes(plain)
+    shd = _per_device_param_bytes(sharded["[1, 2]"])
+    assert rep / shd >= 1.8, (rep, shd)
+
+
+def test_proposal_family_parity_on_a_sharded_mesh(scene_root):
+    """The learned-sampler family crosses the same collectives (its
+    params ride the replicated fallback spec) — allclose too."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.models.nerf.network import init_params
+    from nerf_replication_tpu.serve import RenderEngine
+
+    opts = _TINY + ["sampling.mode", "proposal",
+                    "sampling.n_proposal", "16", "sampling.n_fine", "8"]
+    cfg = tiny_cfg(scene_root, opts)
+    grid, bbox = _grid_bbox(cfg)
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    mcfg = tiny_cfg(scene_root, opts + ["scale.mesh", "force",
+                                        "scale.mesh_shape", "[1, 2]"])
+    plain = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                         grid=grid, bbox=bbox,
+                         warmup_families=("proposal",))
+    eng = RenderEngine(mcfg, network, params, near=NEAR, far=FAR,
+                       grid=grid, bbox=bbox, mesh=mesh_from_scale_cfg(mcfg),
+                       warmup_families=("proposal",))
+    for n in (64, 128):
+        rays = _rays(n)
+        a = plain.render_request(rays, NEAR, FAR, tier="proposal", emit=False)
+        b = eng.render_request(rays, NEAR, FAR, tier="proposal", emit=False)
+        assert a["tier"] == b["tier"] == "proposal"
+        for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+            assert np.allclose(np.asarray(a[k]), np.asarray(b[k]),
+                               atol=1e-5, rtol=1e-5), (k, n)
+
+
+# -- residency: over-budget-unless-sharded + bitwise ladder round-trip -------
+
+
+def test_sharded_scene_rides_the_ladder_and_overbudget_admission(scene_root):
+    """The acceptance scenario: a scene whose replicated param bytes
+    exceed the HBM budget is rejected by a plain engine's fleet but
+    admitted — rendered, demoted, re-promoted bitwise, zero recompiles —
+    when the engine shards it over a forced ``(1, 2)`` mesh."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from nerf_replication_tpu.fleet import (
+        ResidencyOverloadError,
+        SceneData,
+        SceneRecord,
+        SceneRegistry,
+        TieredResidencyManager,
+    )
+    from nerf_replication_tpu.models import make_network
+    from nerf_replication_tpu.models.nerf.network import init_params
+    from nerf_replication_tpu.serve import RenderEngine
+
+    cfg = tiny_cfg(scene_root, _TINY)
+    grid, bbox = _grid_bbox(cfg)
+    network = make_network(cfg)
+    params = init_params(network, jax.random.PRNGKey(0))
+    mcfg = tiny_cfg(scene_root, _TINY + ["scale.mesh", "force",
+                                         "scale.mesh_shape", "[1, 2]"])
+    eng = RenderEngine(mcfg, network, params, near=NEAR, far=FAR,
+                       grid=grid, bbox=bbox, mesh=mesh_from_scale_cfg(mcfg),
+                       warmup_families=("full",))
+
+    host_params = jax.tree.map(
+        lambda a: np.asarray(a) * np.float32(1.01), params)
+
+    def _loader(rec):
+        return SceneData(scene_id=rec.scene_id, params=host_params,
+                         grid=grid, bbox=bbox, near=NEAR, far=FAR)
+
+    total = (sum(a.nbytes for a in jax.tree.leaves(host_params))
+             + grid.nbytes + bbox.nbytes)
+    shard = eng.scene_shard_nbytes((host_params, grid, bbox))
+    assert shard < total  # the whole point of model-parallel serving
+    budget = (shard + total) // 2  # fits ONLY when sharded
+
+    def _ladder(budget_bytes):
+        return TieredResidencyManager(
+            SceneRegistry([SceneRecord(scene_id="big")]), _loader,
+            budget_bytes=int(budget_bytes),
+            staging_budget_bytes=int(4 * total), verify_checksums=False)
+
+    # plain engine: the same budget rejects the scene outright
+    plain = RenderEngine(cfg, network, params, near=NEAR, far=FAR,
+                         grid=grid, bbox=bbox, warmup_families=("full",))
+    plain.attach_fleet(_ladder(budget))
+    with pytest.raises(ResidencyOverloadError):
+        plain.render_request(_rays(32), NEAR, FAR, tier="full",
+                             scene="big", emit=False)
+
+    # sharded engine: admitted, rendered
+    mgr = _ladder(budget)
+    eng.attach_fleet(mgr)
+    rays = _rays(64)
+    out1 = eng.render_request(rays, NEAR, FAR, tier="full",
+                              scene="big", emit=False)
+    assert mgr.resident_ids() == ["big"]
+    st = mgr.stats()
+    assert st["param_shards"] == 2
+    assert st["resident_bytes"] == shard  # HBM ledger holds per-shard bytes
+
+    # demote to staging, then re-promote by rendering again: bitwise,
+    # served from host RAM (no disk), zero new compiles
+    assert mgr.evict("big")
+    assert mgr.resident_ids() == [] and mgr.staged_ids() == ["big"]
+    before = eng.tracker.total_compiles()
+    out2 = eng.render_request(rays, NEAR, FAR, tier="full",
+                              scene="big", emit=False)
+    assert mgr.repromotions == 1 and mgr.resident_ids() == ["big"]
+    assert eng.tracker.total_compiles() == before
+    for k in ("rgb_map_f", "depth_map_f", "acc_map_f"):
+        assert np.array_equal(np.asarray(out1[k]), np.asarray(out2[k])), k
+
+    # a budget below even one shard still rejects — and the error names
+    # BOTH the per-shard and the total figure
+    eng.attach_fleet(_ladder(shard // 2))
+    with pytest.raises(ResidencyOverloadError) as ei:
+        eng.render_request(rays, NEAR, FAR, tier="full",
+                           scene="big", emit=False)
+    msg = str(ei.value)
+    assert "param shard" in msg and str(total) in msg
+
+
+# -- shard_bank telemetry (satellite: no silent truncation) ------------------
+
+
+def test_shard_bank_truncation_is_announced(scene_root, tmp_path,
+                                            monkeypatch, capsys):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from nerf_replication_tpu.obs import emit as emit_mod
+    from nerf_replication_tpu.parallel.mesh import make_mesh
+    from nerf_replication_tpu.parallel.sharding import shard_bank
+
+    path = str(tmp_path / "telemetry.jsonl")
+    em = emit_mod.Emitter(path, chief=True)
+    monkeypatch.setattr(emit_mod, "_active", em)
+    mesh = make_mesh()
+    n_data = int(mesh.shape["data"])
+    total = 3 * n_data + 1  # forces a 1-ray tail drop
+    rays, rgbs = shard_bank(np.zeros((total, 6), np.float32),
+                            np.zeros((total, 3), np.float32), mesh)
+    em.close()
+    assert rays.shape[0] == rgbs.shape[0] == 3 * n_data
+    assert "(1 dropped)" in capsys.readouterr().out
+    rows = [json.loads(line) for line in open(path) if line.strip()]
+    bank = [r for r in rows if r.get("kind") == "bank_shard"]
+    assert len(bank) == 1
+    assert bank[0]["n_rays"] == total and bank[0]["n_dropped"] == 1
+    assert bank[0]["n_kept"] == 3 * n_data
+    assert validate_row(bank[0]) == []
+
+
+# -- bench schema + placement packing (satellites) ---------------------------
+
+
+def test_shard_mode_bench_family_validates():
+    from nerf_replication_tpu.obs.schema import validate_bench_row
+
+    row = {"shard_mode": "sharded", "mesh_shape": [1, 2],
+           "rays_per_s": 1234.5, "param_bytes_per_device": 81696,
+           "param_bytes_total": 162080, "bytes_reduction_x": 1.98,
+           "allclose": True}
+    assert validate_bench_row(row) == [], row
+    bad = {"shard_mode": "replicated", "mesh_shape": [2, 1]}
+    assert validate_bench_row(bad) != []  # rays/bytes fields are required
+
+
+def test_placement_planner_packs_per_shard_bytes():
+    """A scene too big for a replica's budget when replicated packs once
+    the replica reports ``param_shards > 1`` (its heartbeat figure)."""
+    from test_placement import FakeCatalog, FakeClock, _heat, _state
+
+    from nerf_replication_tpu.scale.placement import (
+        PlacementOptions,
+        PlacementPlanner,
+    )
+
+    def _planner():
+        return PlacementPlanner(
+            FakeCatalog("big"),
+            options=PlacementOptions(enabled=True, hot_width=1, max_width=1),
+            scene_bytes_fn=lambda sid: 1000, clock=FakeClock())
+
+    replicated = {"r0": _state(hbm_budget=600)}
+    plan = _planner().plan(replicated, _heat(big=0.1))
+    assert plan.replicas_for("big") == ()  # 1000 > 600: fits nowhere
+
+    sharded = {"r0": dict(_state(hbm_budget=600), param_shards=2)}
+    plan = _planner().plan(sharded, _heat(big=0.1))
+    assert plan.replicas_for("big") == ("r0",)  # ceil(1000/2) <= 600
